@@ -1,0 +1,29 @@
+# aiko_services_tpu: a TPU-native distributed service and dataflow framework
+# with the capabilities of aiko_services (see SURVEY.md for the reference
+# analysis).  Control plane (actors, discovery, shares) is pure Python;
+# compute plane (models, pipeline elements) is jax/XLA/pallas — imported
+# lazily so control-plane-only processes never pay the jax import cost.
+
+__version__ = "0.1.0"
+
+from . import utils                                         # noqa: F401
+from . import event                                         # noqa: F401
+from .connection import Connection, ConnectionState         # noqa: F401
+from .event import EventEngine, RealClock, VirtualClock     # noqa: F401
+from .lease import Lease                                    # noqa: F401
+from .process import ProcessRuntime                         # noqa: F401
+from .service import (                                      # noqa: F401
+    Service, ServiceFields, ServiceFilter, ServiceProtocol, ServiceTags,
+    ServiceTopicPath, Services,
+)
+from .state import StateMachine, StateMachineError          # noqa: F401
+from .share import ECConsumer, ECProducer, ServicesCache    # noqa: F401
+from .actor import (                                        # noqa: F401
+    Actor, ActorDiscovery, ActorMessage, get_public_methods,
+    get_remote_proxy,
+)
+from .registrar import Registrar                            # noqa: F401
+from .transport import (                                    # noqa: F401
+    MemoryBroker, MemoryMessage, Message, MQTT_AVAILABLE, default_broker,
+    topic_matches,
+)

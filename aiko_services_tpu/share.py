@@ -1,0 +1,365 @@
+# Eventual-consistency shared state over the control plane.
+#
+# Capability parity with the reference share layer
+# (reference: aiko_services/share.py:70-656):
+#   * ECProducer — owns a (≤2-level) dict, serves "(share response_topic
+#     lease_time filter)" snapshot requests with "(item_count N)" +
+#     "(add k v)"…, then streams "(add/update/remove)" deltas to every
+#     leaseholder whose filter matches; accepts remote add/update/remove
+#     commands (dashboard mutation path); local get/update/remove API with
+#     change-handler fan-out.
+#   * ECConsumer — mirrors a producer's filtered share into a local cache,
+#     auto-extends its lease at 0.8x by re-requesting the share.
+#   * ServicesCache — client-side replica of the registrar's service table
+#     with add/remove handler fan-out per ServiceFilter.
+#
+# Simplification vs the reference: a lease re-request doubles as both
+# extension and resync, so there is a single code path for join/extend.
+
+from __future__ import annotations
+
+import itertools
+
+from .connection import ConnectionState
+from .lease import Lease
+from .service import ServiceFields, ServiceFilter, Services, ServiceTopicPath
+from .utils import generate, generate_sexpr, parse, parse_int, parse_sexpr
+
+__all__ = ["ECProducer", "ECConsumer", "ServicesCache",
+           "EC_LEASE_TIME", "filter_matches_item"]
+
+EC_LEASE_TIME = 300.0     # seconds (reference: share.py:86)
+_consumer_counter = itertools.count()
+
+
+def filter_matches_item(item_filter, name: str) -> bool:
+    """Share filters select top-level item names; "*" selects all.
+    "a.b" items match a filter entry "a" (whole-branch selection)."""
+    if item_filter in ("*", None) or item_filter == ["*"]:
+        return True
+    if isinstance(item_filter, str):
+        item_filter = [item_filter]
+    top = name.split(".")[0]
+    return any(f == name or f == top for f in item_filter)
+
+
+def _flatten(share: dict) -> dict:
+    """{"a": 1, "b": {"c": 2}} → {"a": 1, "b.c": 2}"""
+    flat = {}
+    for key, value in share.items():
+        if isinstance(value, dict):
+            for sub, leaf in value.items():
+                flat[f"{key}.{sub}"] = leaf
+        else:
+            flat[key] = value
+    return flat
+
+
+def _set_path(share: dict, name: str, value) -> None:
+    if "." in name:
+        top, sub = name.split(".", 1)
+        share.setdefault(top, {})[sub] = value
+    else:
+        share[name] = value
+
+
+def _del_path(share: dict, name: str) -> None:
+    if "." in name:
+        top, sub = name.split(".", 1)
+        branch = share.get(top)
+        if isinstance(branch, dict):
+            branch.pop(sub, None)
+            if not branch:
+                share.pop(top, None)
+    else:
+        share.pop(name, None)
+
+
+class ECProducer:
+    def __init__(self, service, share: dict | None = None):
+        self.service = service
+        self.runtime = service.runtime
+        self.share = share if share is not None else {}
+        self._handlers = []       # handler(command, name, value)
+        # response_topic → {"lease": Lease, "filter": ...}
+        self._consumers: dict[str, dict] = {}
+        self.runtime.add_message_handler(
+            self._control_handler, service.topic_control)
+
+    # -- local API ---------------------------------------------------------
+    def get(self, name: str, default=None):
+        flat = _flatten(self.share)
+        if name in flat:
+            return flat[name]
+        return self.share.get(name, default)
+
+    def update(self, name: str, value) -> None:
+        exists = name in _flatten(self.share) or name in self.share
+        _set_path(self.share, name, value)
+        command = "update" if exists else "add"
+        self._notify(command, name, value)
+
+    def remove(self, name: str) -> None:
+        _del_path(self.share, name)
+        self._notify("remove", name, None)
+
+    def keys(self):
+        return list(_flatten(self.share).keys())
+
+    def add_handler(self, handler) -> None:
+        self._handlers.append(handler)
+
+    def remove_handler(self, handler) -> None:
+        if handler in self._handlers:
+            self._handlers.remove(handler)
+
+    def terminate(self) -> None:
+        """Detach from the control topic and drop all consumer leases."""
+        self.runtime.remove_message_handler(self._control_handler,
+                                            self.service.topic_control)
+        for consumer in self._consumers.values():
+            consumer["lease"].terminate()
+        self._consumers.clear()
+        self._handlers.clear()
+
+    # -- wire protocol -----------------------------------------------------
+    def _control_handler(self, _topic, payload) -> None:
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command == "share" and len(params) >= 2:
+            response_topic = params[0]
+            lease_time = parse_int(params[1], int(EC_LEASE_TIME))
+            item_filter = params[2] if len(params) > 2 else "*"
+            if len(params) > 3:
+                item_filter = params[2:]
+            self._handle_share(response_topic, lease_time, item_filter)
+        elif command in ("add", "update") and len(params) >= 2:
+            value = _decode_value(params[1])
+            self.update(params[0], value)
+        elif command == "remove" and params:
+            self.remove(params[0])
+
+    def _handle_share(self, response_topic, lease_time, item_filter) -> None:
+        existing = self._consumers.get(response_topic)
+        if existing:
+            existing["lease"].extend(lease_time)
+            existing["filter"] = item_filter
+        else:
+            lease = Lease(self.runtime.event, lease_time, response_topic,
+                          lease_expired_handler=self._lease_expired)
+            self._consumers[response_topic] = {
+                "lease": lease, "filter": item_filter}
+        self._synchronize(response_topic, item_filter)
+
+    def _lease_expired(self, response_topic) -> None:
+        self._consumers.pop(response_topic, None)
+
+    def _synchronize(self, response_topic, item_filter) -> None:
+        items = [(k, v) for k, v in _flatten(self.share).items()
+                 if filter_matches_item(item_filter, k)]
+        publish = self.runtime.publish
+        publish(response_topic, generate("item_count", [str(len(items))]))
+        for name, value in items:
+            publish(response_topic,
+                    generate("add", [name, generate_sexpr(value)]))
+        publish(self.service.topic_out,
+                generate("sync", [response_topic]))
+
+    def _notify(self, command, name, value) -> None:
+        for handler in list(self._handlers):
+            handler(command, name, value)
+        for response_topic, consumer in list(self._consumers.items()):
+            if filter_matches_item(consumer["filter"], name):
+                params = [name] if command == "remove" else \
+                    [name, generate_sexpr(value)]
+                self.runtime.publish(response_topic,
+                                     generate(command, params))
+
+
+def _decode_value(value):
+    """Wire values arrive as strings or parsed lists; fold scalars back."""
+    if isinstance(value, str):
+        if value == "true":
+            return True
+        if value == "false":
+            return False
+        for cast in (int, float):
+            try:
+                return cast(value)
+            except ValueError:
+                continue
+    return value
+
+
+class ECConsumer:
+    def __init__(self, runtime, cache: dict, producer_topic_control: str,
+                 item_filter="*", lease_time: float = EC_LEASE_TIME):
+        self.runtime = runtime
+        self.cache = cache
+        self.producer_topic_control = producer_topic_control
+        self.item_filter = item_filter
+        self.lease_time = lease_time
+        self.synchronized = False
+        self._handlers = []       # handler(command, item_name, value)
+        self._expected = None
+        self._lease = None
+        self.response_topic = (f"{runtime.topic_path}/0/ec/"
+                               f"{next(_consumer_counter)}")
+        runtime.add_message_handler(self._consumer_handler,
+                                    self.response_topic)
+        runtime.connection.add_handler(self._connection_handler)
+
+    def _connection_handler(self, _connection, state) -> None:
+        if state >= ConnectionState.TRANSPORT and self._lease is None:
+            self._lease = Lease(
+                self.runtime.event, self.lease_time, self.response_topic,
+                lease_extend_handler=lambda *_: self._share_request(),
+                automatic_extend=True)
+            self._share_request()
+
+    def _share_request(self) -> None:
+        item_filter = self.item_filter
+        params = [self.response_topic, str(int(self.lease_time))]
+        if isinstance(item_filter, (list, tuple)):
+            params.extend(item_filter)
+        else:
+            params.append(item_filter)
+        self.runtime.publish(self.producer_topic_control,
+                             generate("share", params))
+
+    def _consumer_handler(self, _topic, payload) -> None:
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command == "item_count" and params:
+            self._expected = parse_int(params[0])
+        elif command in ("add", "update") and len(params) >= 2:
+            self.cache[params[0]] = _decode_value(params[1])
+            self._fire(command, params[0], self.cache[params[0]])
+            if self._expected is not None:
+                self._expected -= 1
+                if self._expected <= 0:
+                    self._expected = None
+                    self.synchronized = True
+                    self._fire("sync", None, None)
+        elif command == "remove" and params:
+            self.cache.pop(params[0], None)
+            self._fire("remove", params[0], None)
+        if command == "item_count" and self._expected == 0:
+            self._expected = None
+            self.synchronized = True
+            self._fire("sync", None, None)
+
+    def _fire(self, command, name, value) -> None:
+        for handler in list(self._handlers):
+            handler(command, name, value)
+
+    def add_handler(self, handler) -> None:
+        self._handlers.append(handler)
+
+    def terminate(self) -> None:
+        if self._lease:
+            self._lease.terminate()
+        self.runtime.remove_message_handler(self._consumer_handler,
+                                            self.response_topic)
+
+
+class ServicesCache:
+    """Local replica of the registrar's service table."""
+
+    def __init__(self, runtime, history_limit: int = 64):
+        self.runtime = runtime
+        self.services = Services()
+        self.history: list[ServiceFields] = []
+        self.history_limit = history_limit
+        self.synchronized = False
+        self._handlers = []       # (handler, ServiceFilter)
+        self._expected = None
+        self._registrar_out = None
+        self.response_topic = (f"{runtime.topic_path}/0/cache/"
+                               f"{next(_consumer_counter)}")
+        runtime.add_message_handler(self._response_handler,
+                                    self.response_topic)
+        runtime.add_registrar_handler(self._registrar_handler)
+
+    def _registrar_handler(self, registrar) -> None:
+        if registrar is None:
+            self.synchronized = False
+            return
+        registrar_out = f"{registrar['topic_path']}/out"
+        if self._registrar_out != registrar_out:
+            if self._registrar_out:
+                self.runtime.remove_message_handler(self._event_handler,
+                                                    self._registrar_out)
+            self._registrar_out = registrar_out
+            self.runtime.add_message_handler(self._event_handler,
+                                             registrar_out)
+        self.runtime.publish(
+            f"{registrar['topic_path']}/in",
+            generate("share", [self.response_topic, str(int(EC_LEASE_TIME)),
+                               "*"]))
+
+    def _response_handler(self, _topic, payload) -> None:
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command == "item_count" and params:
+            self._expected = parse_int(params[0])
+            if self._expected == 0:
+                self._expected = None
+                self.synchronized = True
+        elif command == "add" and params:
+            self._add_record(params[0])
+            if self._expected is not None:
+                self._expected -= 1
+                if self._expected <= 0:
+                    self._expected = None
+                    self.synchronized = True
+
+    def _event_handler(self, _topic, payload) -> None:
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command == "add" and params:
+            self._add_record(params[0])
+        elif command == "remove" and params:
+            fields = self.services.remove(params[0])
+            if fields is not None:
+                self._remember(fields)
+                self._fire("remove", fields)
+
+    def _add_record(self, record) -> None:
+        if isinstance(record, str):
+            record = parse_sexpr(record)
+        try:
+            fields = ServiceFields.from_record(record)
+        except Exception:
+            return
+        self.services.add(fields)
+        self._fire("add", fields)
+
+    def _remember(self, fields) -> None:
+        self.history.insert(0, fields)
+        del self.history[self.history_limit:]
+
+    def _fire(self, command, fields) -> None:
+        for handler, service_filter in list(self._handlers):
+            if service_filter.matches(fields):
+                handler(command, fields)
+
+    def add_handler(self, handler, service_filter: ServiceFilter) -> None:
+        """handler(command, ServiceFields); replays current matches."""
+        self._handlers.append((handler, service_filter))
+        for fields in self.services.filter(service_filter):
+            handler("add", fields)
+
+    def remove_handler(self, handler) -> None:
+        self._handlers = [(h, f) for h, f in self._handlers if h != handler]
+
+    def get_services(self) -> Services:
+        return self.services

@@ -1,0 +1,3 @@
+from .message import Message, topic_matches                 # noqa: F401
+from .memory import MemoryBroker, MemoryMessage, default_broker  # noqa: F401
+from .mqtt import MQTT_AVAILABLE, MQTTMessage               # noqa: F401

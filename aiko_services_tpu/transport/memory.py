@@ -1,0 +1,146 @@
+# In-memory broker: full pub/sub semantics without a network.
+#
+# The reference has no test transport (its only impl is paho-mqtt,
+# aiko_services/message/mqtt.py:64); this broker is the designed-in seam the
+# survey calls for (SURVEY.md §4): retained messages, +/# wildcards, and
+# last-will-and-testament, so an entire multi-"process" distributed system —
+# registrar failover included — runs deterministically inside one pytest.
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .message import Message, topic_matches
+
+__all__ = ["MemoryBroker", "MemoryMessage"]
+
+
+class MemoryBroker:
+    """A process-local mosquitto: routes, retains, and fires LWTs."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._clients: list[MemoryMessage] = []
+        self._retained: dict[str, object] = {}
+
+    # -- client management -------------------------------------------------
+    def attach(self, client: "MemoryMessage") -> None:
+        with self._lock:
+            if client not in self._clients:
+                self._clients.append(client)
+
+    def detach(self, client: "MemoryMessage", fire_lwt: bool = True) -> None:
+        with self._lock:
+            if client in self._clients:
+                self._clients.remove(client)
+        if fire_lwt:
+            for topic, payload, retain in list(client.wills):
+                self.route(topic, payload, retain=retain)
+
+    # -- routing -----------------------------------------------------------
+    def route(self, topic: str, payload, retain: bool = False) -> None:
+        if retain:
+            with self._lock:
+                if payload in ("", b"", None):
+                    self._retained.pop(topic, None)   # clear retained
+                else:
+                    self._retained[topic] = payload
+        with self._lock:
+            clients = list(self._clients)
+        for client in clients:
+            client._deliver(topic, payload)
+
+    def deliver_retained(self, client: "MemoryMessage",
+                         pattern: str) -> None:
+        with self._lock:
+            matches = [(t, p) for t, p in self._retained.items()
+                       if topic_matches(pattern, t)]
+        for topic, payload in matches:
+            client._deliver(topic, payload)
+
+    def retained(self, topic: str):
+        with self._lock:
+            return self._retained.get(topic)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._clients.clear()
+            self._retained.clear()
+
+
+_default_broker = MemoryBroker()
+
+
+def default_broker() -> MemoryBroker:
+    return _default_broker
+
+
+class MemoryMessage(Message):
+    """Message transport backed by a MemoryBroker."""
+
+    def __init__(self, on_message: Callable | None = None, subscriptions=(),
+                 broker: MemoryBroker | None = None,
+                 lwt_topic: str | None = None, lwt_payload=None,
+                 lwt_retain: bool = False):
+        super().__init__(on_message, subscriptions)
+        self.broker = broker or _default_broker
+        self.wills: list[tuple[str, object, bool]] = []
+        if lwt_topic is not None:
+            self.wills.append((lwt_topic, lwt_payload, lwt_retain))
+        self._connected = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> None:
+        self.broker.attach(self)
+        self._connected = True
+        for pattern in list(self.subscriptions):
+            self.broker.deliver_retained(self, pattern)
+
+    def disconnect(self, fire_lwt: bool = False) -> None:
+        """Graceful disconnect does not fire the LWT (like MQTT DISCONNECT);
+        pass fire_lwt=True to simulate a crash / broken session."""
+        self.broker.detach(self, fire_lwt=fire_lwt)
+        self._connected = False
+
+    def crash(self) -> None:
+        """Simulate abrupt process death: broker fires the LWT."""
+        self.disconnect(fire_lwt=True)
+
+    def connected(self) -> bool:
+        return self._connected
+
+    # -- pub/sub -----------------------------------------------------------
+    def publish(self, topic, payload, retain=False, wait=False) -> None:
+        self.broker.route(topic, payload, retain)
+
+    def subscribe(self, topic) -> None:
+        new = topic not in self.subscriptions
+        self.subscriptions.add(topic)
+        if self._connected and new:
+            self.broker.deliver_retained(self, topic)
+
+    def unsubscribe(self, topic) -> None:
+        self.subscriptions.discard(topic)
+
+    def set_last_will_and_testament(self, topic, payload,
+                                    retain=False) -> None:
+        self.wills = [(topic, payload, retain)]
+
+    def add_last_will_and_testament(self, topic, payload,
+                                    retain=False) -> None:
+        """Additional will (real MQTT allows one will per connection; a
+        registrar over MQTT uses a dedicated connection for this)."""
+        self.wills.append((topic, payload, retain))
+
+    def remove_last_will_and_testament(self, topic) -> None:
+        self.wills = [w for w in self.wills if w[0] != topic]
+
+    # -- delivery ----------------------------------------------------------
+    def _deliver(self, topic: str, payload) -> None:
+        if not self._connected or self.on_message is None:
+            return
+        for pattern in self.subscriptions:
+            if topic_matches(pattern, topic):
+                self.on_message(topic, payload)
+                return

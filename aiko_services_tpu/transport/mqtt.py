@@ -1,0 +1,97 @@
+# MQTT transport (optional): real-broker interop for multi-host control.
+#
+# Capability parity with the reference MQTT wrapper
+# (reference: aiko_services/message/mqtt.py:64-284): connect with LWT,
+# TLS/credentials, subscribe/unsubscribe, wait-for-publish.  Gated on
+# paho-mqtt being importable; the in-memory broker is the default transport
+# so nothing in the framework requires paho.
+
+from __future__ import annotations
+
+import threading
+
+from .message import Message
+
+__all__ = ["MQTT_AVAILABLE", "MQTTMessage"]
+
+try:
+    import paho.mqtt.client as _paho
+    MQTT_AVAILABLE = True
+except ImportError:        # pragma: no cover - environment without paho
+    _paho = None
+    MQTT_AVAILABLE = False
+
+
+class MQTTMessage(Message):   # pragma: no cover - needs a live broker
+    def __init__(self, on_message=None, subscriptions=(),
+                 host="localhost", port=1883, username=None, password=None,
+                 tls=False, lwt_topic=None, lwt_payload=None,
+                 lwt_retain=False):
+        if not MQTT_AVAILABLE:
+            raise ImportError(
+                "paho-mqtt is not installed; use the memory transport or "
+                "install paho-mqtt for multi-host control planes")
+        super().__init__(on_message, subscriptions)
+        self.host, self.port = host, port
+        self._connected_event = threading.Event()
+        self._client = _paho.Client(
+            callback_api_version=_paho.CallbackAPIVersion.VERSION2)
+        if username:
+            self._client.username_pw_set(username, password)
+        if tls:
+            self._client.tls_set()
+        if lwt_topic is not None:
+            self._client.will_set(lwt_topic, lwt_payload, retain=lwt_retain)
+        self._client.on_connect = self._on_connect
+        self._client.on_disconnect = self._on_disconnect
+        self._client.on_message = self._on_paho_message
+
+    def _on_connect(self, client, userdata, flags, reason_code, properties):
+        for topic in self.subscriptions:
+            client.subscribe(topic)
+        self._connected_event.set()
+
+    def _on_disconnect(self, client, userdata, flags, reason_code,
+                       properties):
+        self._connected_event.clear()
+
+    def _on_paho_message(self, client, userdata, message):
+        if self.on_message is not None:
+            payload = message.payload
+            try:
+                payload = payload.decode("utf-8")
+            except UnicodeDecodeError:
+                pass    # binary topic: hand bytes through
+            self.on_message(message.topic, payload)
+
+    def connect(self, timeout=5.0) -> None:
+        self._client.connect(self.host, self.port)
+        self._client.loop_start()
+        self._connected_event.wait(timeout)
+
+    def disconnect(self) -> None:
+        self._client.loop_stop()
+        self._client.disconnect()
+        self._connected_event.clear()
+
+    def connected(self) -> bool:
+        return self._connected_event.is_set()
+
+    def publish(self, topic, payload, retain=False, wait=False) -> None:
+        info = self._client.publish(topic, payload, retain=retain)
+        if wait:
+            info.wait_for_publish(timeout=2.0)
+
+    def subscribe(self, topic) -> None:
+        self.subscriptions.add(topic)
+        if self.connected():
+            self._client.subscribe(topic)
+
+    def unsubscribe(self, topic) -> None:
+        self.subscriptions.discard(topic)
+        if self.connected():
+            self._client.unsubscribe(topic)
+
+    def set_last_will_and_testament(self, topic, payload,
+                                    retain=False) -> None:
+        self._client.will_set(topic, payload, retain=retain)

@@ -1,0 +1,75 @@
+# Environment-variable configuration system.
+#
+# Capability parity with the reference configuration module
+# (reference: aiko_services/utilities/configuration.py:73-162): namespace,
+# hostname/pid/username identity, message-transport selection and host/port
+# resolution.  Env vars use the AIKO_TPU_ prefix; the reference's AIKO_ names
+# are honoured as fallbacks so operators can migrate without re-tooling.
+
+from __future__ import annotations
+
+import os
+import socket
+import getpass
+import dataclasses
+
+__all__ = [
+    "get_namespace", "get_hostname", "get_pid", "get_username",
+    "TransportConfig", "get_transport_configuration",
+]
+
+_DEFAULT_NAMESPACE = "aiko"
+_DEFAULT_MQTT_PORT = 1883
+
+
+def _env(name: str, default=None):
+    return os.environ.get(f"AIKO_TPU_{name}", os.environ.get(
+        f"AIKO_{name}", default))
+
+
+def get_namespace() -> str:
+    return _env("NAMESPACE", _DEFAULT_NAMESPACE)
+
+
+def get_hostname() -> str:
+    return socket.gethostname().split(".")[0]
+
+
+def get_pid() -> str:
+    return str(os.getpid())
+
+
+def get_username() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:
+        return _env("USERNAME", "unknown")
+
+
+@dataclasses.dataclass
+class TransportConfig:
+    transport: str = "memory"        # "memory" | "mqtt"
+    host: str = "localhost"
+    port: int = _DEFAULT_MQTT_PORT
+    username: str | None = None
+    password: str | None = None
+    tls: bool = False
+
+
+def get_transport_configuration() -> TransportConfig:
+    """Resolve the control-plane transport from the environment.
+
+    Default is the in-memory broker (single-host, test-friendly).  Setting
+    AIKO_TPU_MQTT_HOST selects the MQTT transport, mirroring how the
+    reference bootstraps from AIKO_MQTT_HOST.
+    """
+    host = _env("MQTT_HOST")
+    transport = _env("MESSAGE_TRANSPORT", "mqtt" if host else "memory")
+    return TransportConfig(
+        transport=transport,
+        host=host or "localhost",
+        port=int(_env("MQTT_PORT", _DEFAULT_MQTT_PORT)),
+        username=_env("USERNAME_MQTT", _env("USERNAME")),
+        password=_env("PASSWORD"),
+        tls=str(_env("MQTT_TLS", "")).lower() in ("1", "true", "yes"),
+    )

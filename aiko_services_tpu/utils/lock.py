@@ -1,0 +1,39 @@
+# Diagnostic named lock: records holder location, warns on contention.
+# (capability parity: aiko_services/utilities/lock.py:20-29)
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Lock"]
+
+
+class Lock:
+    def __init__(self, name: str, logger=None):
+        self.name = name
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._holder: str | None = None
+
+    def acquire(self, location: str):
+        if self._holder is not None and self._logger:
+            self._logger.warning(
+                "Lock %s: %s waiting on holder %s",
+                self.name, location, self._holder)
+        self._lock.acquire()
+        self._holder = location
+
+    def release(self):
+        self._holder = None
+        self._lock.release()
+
+    def in_use(self) -> bool:
+        return self._holder is not None
+
+    def __enter__(self):
+        self.acquire("context-manager")
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False

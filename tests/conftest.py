@@ -1,0 +1,56 @@
+# Test configuration: force jax onto a virtual 8-device CPU mesh BEFORE any
+# jax import, so multi-chip sharding tests run without TPU hardware
+# (SURVEY.md §4: TPU-less CI via the jax CPU backend).
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from aiko_services_tpu.event import EventEngine, VirtualClock  # noqa: E402
+from aiko_services_tpu.transport.memory import MemoryBroker  # noqa: E402
+from aiko_services_tpu.process import ProcessRuntime  # noqa: E402
+from aiko_services_tpu.transport.memory import MemoryMessage  # noqa: E402
+
+
+@pytest.fixture
+def engine():
+    """A shared deterministic event engine (virtual clock)."""
+    return EventEngine(VirtualClock())
+
+
+@pytest.fixture
+def broker():
+    """A fresh in-memory broker per test."""
+    return MemoryBroker()
+
+
+@pytest.fixture
+def make_runtime(engine, broker):
+    """Factory for logical processes sharing one engine + broker, so a whole
+    distributed system is driven deterministically by engine.step()."""
+    created = []
+
+    def factory(name=None, **kwargs):
+        def transport_factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+            return MemoryMessage(
+                on_message=on_message, broker=broker, lwt_topic=lwt_topic,
+                lwt_payload=lwt_payload, lwt_retain=lwt_retain)
+        runtime = ProcessRuntime(
+            name=name, engine=engine, transport_factory=transport_factory,
+            **kwargs)
+        created.append(runtime)
+        return runtime
+
+    yield factory
+    for runtime in created:
+        try:
+            if runtime.message is not None and runtime.message.connected():
+                runtime.terminate()
+        except Exception:
+            pass

@@ -1,0 +1,142 @@
+from aiko_services_tpu.event import EventEngine, VirtualClock
+
+
+def make_engine():
+    return EventEngine(VirtualClock())
+
+
+class TestTimers:
+    def test_periodic_timer(self):
+        engine = make_engine()
+        fired = []
+        engine.add_timer_handler(lambda: fired.append(engine.clock.now()),
+                                 period=1.0)
+        for _ in range(35):
+            engine.step()
+            engine.clock.advance(0.1)
+        assert len(fired) == 3
+
+    def test_immediate_timer(self):
+        engine = make_engine()
+        fired = []
+        engine.add_timer_handler(lambda: fired.append(1), period=10.0,
+                                 immediate=True)
+        engine.step()
+        assert fired == [1]
+
+    def test_oneshot(self):
+        engine = make_engine()
+        fired = []
+        engine.add_oneshot_handler(lambda: fired.append(1), delay=0.5)
+        engine.step()
+        assert fired == []
+        engine.clock.advance(0.6)
+        engine.step()
+        engine.step()
+        assert fired == [1]    # fires exactly once
+
+    def test_remove_by_handle(self):
+        engine = make_engine()
+        fired = []
+        handle = engine.add_timer_handler(lambda: fired.append(1), 1.0)
+        engine.remove_timer_handler(handle)
+        engine.clock.advance(5.0)
+        engine.step()
+        assert fired == []
+
+    def test_two_timers_same_handler(self):
+        # reference bug: removal by handler identity killed both timers —
+        # handles fix that
+        engine = make_engine()
+        fired = []
+        handler = lambda: fired.append(1)  # noqa: E731
+        h1 = engine.add_timer_handler(handler, 1.0)
+        engine.add_timer_handler(handler, 1.0)
+        engine.remove_timer_handler(h1)
+        engine.clock.advance(1.1)
+        engine.step()
+        assert fired == [1]
+
+
+class TestMailboxes:
+    def test_fifo(self):
+        engine = make_engine()
+        seen = []
+        engine.add_mailbox_handler(
+            lambda name, item, t: seen.append(item), "mb")
+        engine.mailbox_put("mb", "a")
+        engine.mailbox_put("mb", "b")
+        engine.step()
+        assert seen == ["a", "b"]
+
+    def test_priority_order(self):
+        # earliest-registered mailbox preempts later ones
+        engine = make_engine()
+        seen = []
+        engine.add_mailbox_handler(
+            lambda n, item, t: seen.append(("control", item)), "control")
+        def data_handler(n, item, t):
+            seen.append(("data", item))
+            # control item arriving mid-drain must be handled next
+            engine.mailbox_put("control", "urgent")
+        engine.add_mailbox_handler(data_handler, "data")
+        engine.mailbox_put("data", 1)
+        engine.mailbox_put("data", 2)
+        # budget = 2 items present at drain start: data 1 is handled, the
+        # urgent control item it spawned preempts data 2 within the step
+        engine.step()
+        assert seen == [("data", 1), ("control", "urgent")]
+        engine.step()
+        assert seen == [("data", 1), ("control", "urgent"), ("data", 2)]
+        engine.step()
+        assert seen[-1] == ("control", "urgent")
+
+    def test_put_to_missing_mailbox_ignored(self):
+        engine = make_engine()
+        engine.mailbox_put("ghost", 1)   # no exception
+
+
+class TestQueuesAndFlatout:
+    def test_queue_one_item_per_step(self):
+        engine = make_engine()
+        seen = []
+        engine.add_queue_handler(lambda n, item, t: seen.append(item), "q")
+        engine.queue_put("q", 1)
+        engine.queue_put("q", 2)
+        engine.step()
+        assert seen == [1]
+        engine.step()
+        assert seen == [1, 2]
+
+    def test_flatout_every_step(self):
+        engine = make_engine()
+        count = []
+        engine.add_flatout_handler(lambda: count.append(1))
+        engine.step()
+        engine.step()
+        assert len(count) == 2
+        engine.remove_flatout_handler
+        engine._flatout.clear()
+
+
+class TestLoop:
+    def test_loop_exits_when_no_handlers(self):
+        engine = make_engine()
+        engine.loop()     # returns immediately
+
+    def test_terminate_before_loop(self):
+        # reference bug: terminate() before loop() was lost
+        engine = make_engine()
+        engine.add_flatout_handler(lambda: None)
+        engine.terminate()
+        engine.loop()     # must return
+
+    def test_run_until(self):
+        engine = make_engine()
+        fired = []
+        engine.add_oneshot_handler(lambda: fired.append(1), delay=1.0)
+        assert engine.run_until(lambda: fired, timeout=5.0)
+
+    def test_run_until_timeout(self):
+        engine = make_engine()
+        assert not engine.run_until(lambda: False, timeout=0.1)

@@ -1,0 +1,88 @@
+import pytest
+
+from aiko_services_tpu.utils.graph import Graph, GraphError
+
+
+class TestGraphBasics:
+    def test_add_and_edges(self):
+        g = Graph()
+        g.add("a")
+        g.add("b")
+        g.add_edge("a", "b")
+        assert g.successors("a") == ["b"]
+        assert g.predecessors("b") == ["a"]
+        assert "a" in g and len(g) == 2
+
+    def test_duplicate_node(self):
+        g = Graph()
+        g.add("a")
+        with pytest.raises(GraphError):
+            g.add("a")
+
+    def test_remove(self):
+        g = Graph()
+        g.add("a"), g.add("b")
+        g.add_edge("a", "b")
+        g.remove("b")
+        assert g.successors("a") == []
+
+
+class TestTopologicalOrder:
+    def test_diamond(self):
+        # the reference's canonical pipeline graph: (a (b d) (c d))
+        g = Graph.traverse("(a (b d) (c d))")
+        order = [n.name for n in g.topological_order()]
+        assert order[0] == "a" and order[-1] == "d"
+        assert set(order[1:3]) == {"b", "c"}
+
+    def test_cycle_detection(self):
+        g = Graph()
+        g.add("a"), g.add("b")
+        g.add_edge("a", "b")
+        g.add_edge("b", "a")
+        with pytest.raises(GraphError, match="cycle"):
+            g.topological_order()
+
+    def test_stable_insertion_order(self):
+        g = Graph()
+        for name in ["z", "m", "a"]:
+            g.add(name)
+        assert [n.name for n in g.topological_order()] == ["z", "m", "a"]
+
+
+class TestTraverseDSL:
+    def test_linear(self):
+        g = Graph.traverse("(a b c)")
+        # a -> b, a -> c (successors of head, per the reference DSL)
+        assert g.successors("a") == ["b", "c"]
+
+    def test_chain(self):
+        g = Graph.traverse("(a (b (c d)))")
+        assert g.successors("a") == ["b"]
+        assert g.successors("b") == ["c"]
+        assert g.successors("c") == ["d"]
+
+    def test_reference_example(self):
+        # "(PE_1 (PE_2 PE_4) (PE_3 PE_4) PE_Metrics)"
+        g = Graph.traverse("(PE_1 (PE_2 PE_4) (PE_3 PE_4) PE_Metrics)")
+        assert set(g.successors("PE_1")) == {"PE_2", "PE_3", "PE_Metrics"}
+        assert g.successors("PE_2") == ["PE_4"]
+        assert g.successors("PE_3") == ["PE_4"]
+        assert g.predecessors("PE_4") == ["PE_2", "PE_3"]
+
+    def test_edge_properties(self):
+        captured = []
+        g = Graph.traverse(
+            "(PE_1 (PE_2 (a: x)))",
+            node_properties_callback=lambda t, h, p: captured.append(
+                (t, h, p)))
+        assert captured == [("PE_1", "PE_2", {"a": "x"})]
+        assert g.node("PE_1").properties["PE_2"] == {"a": "x"}
+
+    def test_head_names(self):
+        g = Graph.traverse(["(a b)", "(c d)"])
+        assert g.head_names == ["a", "c"]
+
+    def test_single_node(self):
+        g = Graph.traverse("(only)")
+        assert g.node_names() == ["only"]

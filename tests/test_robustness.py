@@ -1,0 +1,123 @@
+# Regression tests for defects found by end-to-end driving + review:
+# handler fault isolation, graceful-primary shutdown, proxy argument
+# encoding, mailbox livelock bound, sexpr round-trip edge cases, actor
+# teardown.
+
+from aiko_services_tpu.actor import Actor, get_remote_proxy
+from aiko_services_tpu.event import EventEngine, VirtualClock
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.utils.sexpr import generate_sexpr, parse_sexpr
+
+from test_system import AlohaHonua, settle
+
+
+class TestFaultIsolation:
+    def test_malformed_boot_payload_does_not_kill_engine(
+            self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        registrar = Registrar(r)
+        settle(engine, 3.0)
+        r.publish(r.topic_registrar_boot, "(")          # malformed
+        r.publish(r.topic_registrar_boot, "(primary found)")  # too short
+        settle(engine, 0.5)
+        assert registrar.is_primary                     # still alive
+        # engine still schedules: a timer must fire
+        fired = []
+        engine.add_oneshot_handler(lambda: fired.append(1), 0.1)
+        settle(engine, 0.5)
+        assert fired == [1]
+
+    def test_handler_exception_isolated(self):
+        engine = EventEngine(VirtualClock())
+        seen = []
+        def bad(name, item, t):
+            raise RuntimeError("boom")
+        engine.add_mailbox_handler(bad, "bad")
+        engine.add_mailbox_handler(
+            lambda n, item, t: seen.append(item), "good")
+        engine.mailbox_put("bad", 1)
+        engine.mailbox_put("good", 2)
+        engine.step()
+        assert seen == [2]
+
+
+class TestGracefulShutdown:
+    def test_primary_terminate_clears_boot_record(
+            self, engine, broker, make_runtime):
+        r = make_runtime("registrar").initialize()
+        registrar = Registrar(r)
+        settle(engine, 3.0)
+        assert registrar.is_primary
+        assert broker.retained(r.topic_registrar_boot) is not None
+        r.terminate()
+        settle(engine, 0.5)
+        assert broker.retained(r.topic_registrar_boot) is None
+
+    def test_secondary_promotes_after_graceful_primary_exit(
+            self, engine, make_runtime):
+        r1 = make_runtime("reg1").initialize()
+        reg1 = Registrar(r1)
+        settle(engine, 3.0)
+        r2 = make_runtime("reg2").initialize()
+        reg2 = Registrar(r2)
+        settle(engine, 3.0)
+        r1.terminate()
+        settle(engine, 3.0)
+        assert reg2.is_primary
+
+
+class TestProxyEncoding:
+    def test_structured_arguments_roundtrip(self, engine, make_runtime):
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        c = make_runtime("client").initialize()
+        settle(engine, 0.2)
+        proxy = get_remote_proxy(c, actor.topic_in, AlohaHonua)
+        proxy.aloha(["x", "y"])
+        settle(engine, 0.2)
+        assert actor.greetings == [["x", "y"]]
+
+
+class TestMailboxLivelockBound:
+    def test_self_posting_handler_does_not_livelock(self):
+        engine = EventEngine(VirtualClock())
+        count = []
+        def ping(name, item, t):
+            count.append(item)
+            engine.mailbox_put("mb", item + 1)   # always reposts
+        engine.add_mailbox_handler(ping, "mb")
+        engine.mailbox_put("mb", 0)
+        engine.step()               # must return despite repost
+        assert len(count) == 1
+        engine.step()
+        assert len(count) == 2
+
+
+class TestSexprEdgeCases:
+    def test_colon_atom_roundtrip(self):
+        data = ["a:", "b"]
+        assert parse_sexpr(generate_sexpr(data)) == data
+
+    def test_unsafe_dict_keys_preserved_as_list(self):
+        encoded = generate_sexpr({"a b": "1"})
+        assert parse_sexpr(encoded) == ["a b", "1"]
+
+
+class TestActorTeardown:
+    def test_stopped_actor_share_is_dead(self, engine, make_runtime):
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        settle(engine, 0.2)
+        control = actor.topic_control
+        actor.stop()
+        settle(engine, 0.2)
+        w.publish(control, "(update log_level ERROR)")
+        settle(engine, 0.2)
+        assert actor.share["log_level"] == "INFO"   # zombie share untouched
+
+    def test_stop_removes_runtime_handlers(self, engine, make_runtime):
+        w = make_runtime("worker").initialize()
+        before = len(w._message_handlers)
+        actor = AlohaHonua(w)
+        actor.stop()
+        assert len(w._message_handlers) == before

@@ -1,0 +1,121 @@
+import pytest
+
+from aiko_services_tpu.utils.sexpr import (
+    ParseError, dict_to_list, generate, generate_sexpr, list_to_dict,
+    parse, parse_float, parse_int, parse_number, parse_sexpr,
+)
+
+
+class TestParse:
+    def test_simple_command(self):
+        assert parse("(aloha Pele)") == ("aloha", ["Pele"])
+
+    def test_bare_atom(self):
+        assert parse("aloha") == ("aloha", [])
+
+    def test_empty(self):
+        assert parse("") == ("", [])
+        assert parse("()") == ("", [])
+
+    def test_no_params(self):
+        assert parse("(terminate)") == ("terminate", [])
+
+    def test_nested_list(self):
+        command, params = parse("(add topic name (a b c))")
+        assert command == "add"
+        assert params == ["topic", "name", ["a", "b", "c"]]
+
+    def test_deep_nesting(self):
+        assert parse_sexpr("(a (b (c (d))))") == ["a", ["b", ["c", ["d"]]]]
+
+    def test_dict_form(self):
+        assert parse_sexpr("(a: 1 b: 2)") == {"a": "1", "b": "2"}
+
+    def test_dict_with_list_value(self):
+        assert parse_sexpr("(k: (x y))") == {"k": ["x", "y"]}
+
+    def test_unbalanced_open(self):
+        with pytest.raises(ParseError):
+            parse_sexpr("(a (b)")
+
+    def test_unbalanced_close(self):
+        with pytest.raises(ParseError):
+            parse_sexpr("(a))")
+
+    def test_length_prefixed_token(self):
+        # binary-safe token: "7:a b (c)" is one atom of 7 chars
+        assert parse_sexpr("(x 7:a b (c))")[1] == "a b (c)"
+
+    def test_length_prefixed_not_dict_key(self):
+        # a raw token ending in ':' must not become a dict key
+        result = parse_sexpr("(2:a: b)")
+        assert result == ["a:", "b"]
+
+    def test_whitespace(self):
+        assert parse("  ( aloha   Pele )  ") == ("aloha", ["Pele"])
+
+
+class TestGenerate:
+    def test_simple(self):
+        assert generate("aloha", ["Pele"]) == "(aloha Pele)"
+
+    def test_nested(self):
+        assert generate("add", ["t", ["a", "b"]]) == "(add t (a b))"
+
+    def test_dict(self):
+        assert generate_sexpr({"a": 1, "b": "x"}) == "(a: 1 b: x)"
+
+    def test_atom_quoting(self):
+        text = "hello world (quoted)"
+        encoded = generate_sexpr(text)
+        assert parse_sexpr(f"(x {encoded})")[1] == text
+
+    def test_empty_atom(self):
+        assert parse_sexpr(f"(x {generate_sexpr('')})")[1] == ""
+
+    def test_roundtrip(self):
+        cases = [
+            ("aloha", ["Pele"]),
+            ("add", ["topic/path", "name", ["t1=a", "t2=b"]]),
+            ("share", ["resp", "300", "*"]),
+            ("update", ["k", "some value with spaces"]),
+        ]
+        for command, params in cases:
+            assert parse(generate(command, params)) == (command, params)
+
+    def test_bool_none(self):
+        assert generate_sexpr(True) == "true"
+        assert generate_sexpr(False) == "false"
+        assert generate_sexpr(None) == "()"
+
+    def test_numbers(self):
+        assert generate_sexpr(42) == "42"
+        assert generate_sexpr(1.5) == "1.5"
+
+
+class TestNumericHelpers:
+    def test_parse_int(self):
+        assert parse_int("42") == 42
+        assert parse_int("x", 7) == 7
+        assert parse_int(None, 3) == 3
+
+    def test_parse_float(self):
+        assert parse_float("1.5") == 1.5
+        assert parse_float("x", 2.0) == 2.0
+
+    def test_parse_number(self):
+        assert parse_number("42") == 42
+        assert parse_number("1.5") == 1.5
+        assert parse_number("nope", 0) == 0
+
+
+class TestDictHelpers:
+    def test_list_to_dict(self):
+        assert list_to_dict(["a", "1", "b", "2"]) == {"a": "1", "b": "2"}
+
+    def test_list_to_dict_odd(self):
+        with pytest.raises(ParseError):
+            list_to_dict(["a", "1", "b"])
+
+    def test_dict_to_list(self):
+        assert dict_to_list({"a": "1"}) == ["a", "1"]

@@ -1,0 +1,218 @@
+# Whole-system control-plane tests: multiple logical processes share one
+# event engine + in-memory broker, so registrar election, discovery, actor
+# RPC, and EC state sync run deterministically in a single pytest process —
+# the test capability the reference lacks entirely (SURVEY.md §4).
+
+from aiko_services_tpu.actor import Actor, ActorDiscovery, get_remote_proxy
+from aiko_services_tpu.connection import ConnectionState
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.service import ServiceFilter
+from aiko_services_tpu.share import ECConsumer, ECProducer, ServicesCache
+
+
+def settle(engine, seconds=5.0, tick=0.05):
+    """Advance virtual time, stepping the engine each tick."""
+    steps = int(seconds / tick)
+    for _ in range(steps):
+        while engine.step():
+            pass
+        engine.clock.advance(tick)
+    while engine.step():
+        pass
+
+
+class AlohaHonua(Actor):
+    """Minimal actor (reference: examples/aloha_honua/aloha_honua_0.py)."""
+
+    def __init__(self, runtime, name="aloha_honua"):
+        super().__init__(runtime, name)
+        self.greetings = []
+
+    def aloha(self, name):
+        self.greetings.append(name)
+
+
+class TestRegistrarElection:
+    def test_single_registrar_becomes_primary(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        registrar = Registrar(r)
+        assert not registrar.is_primary
+        settle(engine, 3.0)
+        assert registrar.is_primary
+        assert r.connection.state == ConnectionState.REGISTRAR
+
+    def test_second_registrar_becomes_secondary(self, engine, make_runtime):
+        r1 = make_runtime("reg1").initialize()
+        reg1 = Registrar(r1)
+        settle(engine, 3.0)
+        r2 = make_runtime("reg2").initialize()
+        reg2 = Registrar(r2)
+        settle(engine, 3.0)
+        assert reg1.is_primary
+        assert reg2.state_machine.state == "secondary"
+
+    def test_failover_on_primary_crash(self, engine, make_runtime):
+        r1 = make_runtime("reg1").initialize()
+        reg1 = Registrar(r1)
+        settle(engine, 3.0)
+        r2 = make_runtime("reg2").initialize()
+        reg2 = Registrar(r2)
+        settle(engine, 3.0)
+        assert reg1.is_primary and not reg2.is_primary
+        # crash the primary: LWTs fire
+        r1.message.crash()
+        settle(engine, 3.0)
+        assert reg2.is_primary
+        assert r2.connection.state == ConnectionState.REGISTRAR
+
+    def test_service_registration(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        registrar = Registrar(r)
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        settle(engine, 3.0)
+        topic_paths = [f.topic_path for f in registrar.services]
+        assert actor.topic_path in topic_paths
+        # registrar registers itself too
+        assert registrar.topic_path in topic_paths
+
+    def test_dead_process_purged(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        registrar = Registrar(r)
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        settle(engine, 3.0)
+        assert registrar.services.get(actor.topic_path) is not None
+        w.message.crash()
+        settle(engine, 1.0)
+        assert registrar.services.get(actor.topic_path) is None
+        # departed service lands in history
+        assert any(f.topic_path == actor.topic_path
+                   for f in registrar.history)
+
+
+class TestActorRPC:
+    def test_local_rpc_via_topic(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        Registrar(r)
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        settle(engine, 3.0)
+        w.publish(actor.topic_in, "(aloha Pele)")
+        settle(engine, 0.2)
+        assert actor.greetings == ["Pele"]
+
+    def test_remote_proxy(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        Registrar(r)
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        c = make_runtime("client").initialize()
+        settle(engine, 3.0)
+        proxy = get_remote_proxy(c, actor.topic_in, AlohaHonua)
+        proxy.aloha("Hiʻiaka")
+        settle(engine, 0.2)
+        assert actor.greetings == ["Hiʻiaka"]
+
+    def test_control_priority(self, engine, make_runtime):
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        order = []
+        actor.slow = lambda: order.append("slow")
+        actor.control_fast = lambda: order.append("fast")
+        actor.post("slow")
+        actor.post("control_fast")
+        settle(engine, 0.2)
+        assert order == ["fast", "slow"]
+
+    def test_unknown_method_ignored(self, engine, make_runtime):
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        w.publish(actor.topic_in, "(no_such_method)")
+        settle(engine, 0.2)    # must not raise
+
+    def test_discovery(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        Registrar(r)
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        c = make_runtime("client").initialize()
+        found = []
+        discovery = ActorDiscovery(c)
+        discovery.add_handler(
+            lambda cmd, fields: found.append((cmd, fields.name)),
+            ServiceFilter(name="aloha_honua"))
+        settle(engine, 3.0)
+        assert ("add", "aloha_honua") in found
+
+
+class TestECShare:
+    def test_share_snapshot_and_delta(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        Registrar(r)
+        p = make_runtime("producer").initialize()
+        actor = AlohaHonua(p)
+        c = make_runtime("consumer").initialize()
+        cache = {}
+        consumer = ECConsumer(c, cache, actor.topic_control)
+        settle(engine, 3.0)
+        assert consumer.synchronized
+        assert cache["lifecycle"] == "ready"
+        # delta propagation
+        actor.ec_producer.update("custom", 42)
+        settle(engine, 0.2)
+        assert cache["custom"] == 42
+        actor.ec_producer.remove("custom")
+        settle(engine, 0.2)
+        assert "custom" not in cache
+
+    def test_nested_share_paths(self, engine, make_runtime):
+        p = make_runtime("producer").initialize()
+        actor = AlohaHonua(p)
+        actor.ec_producer.update("metrics.frames", 10)
+        assert actor.ec_producer.get("metrics.frames") == 10
+        assert actor.share["metrics"] == {"frames": 10}
+        actor.ec_producer.remove("metrics.frames")
+        assert "metrics" not in actor.share
+
+    def test_remote_update_via_control_topic(self, engine, make_runtime):
+        # the dashboard mutation path: publish (update ...) to /control
+        p = make_runtime("producer").initialize()
+        actor = AlohaHonua(p)
+        c = make_runtime("client").initialize()
+        settle(engine, 0.2)
+        c.publish(actor.topic_control, "(update log_level DEBUG)")
+        settle(engine, 0.2)
+        assert actor.share["log_level"] == "DEBUG"
+        assert actor.logger.level == 10    # DEBUG applied to the logger
+
+    def test_lease_expiry_stops_updates(self, engine, make_runtime):
+        p = make_runtime("producer").initialize()
+        actor = AlohaHonua(p)
+        c = make_runtime("consumer").initialize()
+        cache = {}
+        consumer = ECConsumer(c, cache, actor.topic_control,
+                              lease_time=10.0)
+        settle(engine, 1.0)
+        assert consumer.synchronized
+        consumer.terminate()     # consumer stops extending
+        settle(engine, 15.0)     # producer lease expires
+        actor.ec_producer.update("after", 1)
+        settle(engine, 0.5)
+        assert "after" not in cache
+
+    def test_services_cache_replica(self, engine, make_runtime):
+        r = make_runtime("registrar").initialize()
+        Registrar(r)
+        c = make_runtime("observer").initialize()
+        cache = ServicesCache(c)
+        settle(engine, 3.0)
+        w = make_runtime("worker").initialize()
+        actor = AlohaHonua(w)
+        settle(engine, 1.0)
+        assert cache.synchronized
+        assert cache.services.get(actor.topic_path) is not None
+        w.message.crash()
+        settle(engine, 1.0)
+        assert cache.services.get(actor.topic_path) is None
+        assert any(f.topic_path == actor.topic_path for f in cache.history)

@@ -1,0 +1,108 @@
+from aiko_services_tpu.transport.memory import MemoryBroker, MemoryMessage
+from aiko_services_tpu.transport.message import topic_matches
+
+
+class TestTopicMatch:
+    def test_exact(self):
+        assert topic_matches("a/b/c", "a/b/c")
+        assert not topic_matches("a/b/c", "a/b/d")
+
+    def test_plus_wildcard(self):
+        assert topic_matches("a/+/c", "a/b/c")
+        assert not topic_matches("a/+/c", "a/b/c/d")
+        assert topic_matches("+/+/+", "a/b/c")
+
+    def test_hash_wildcard(self):
+        assert topic_matches("a/#", "a/b/c/d")
+        assert topic_matches("#", "anything/at/all")
+        assert not topic_matches("a/#", "b/c")
+
+    def test_length_mismatch(self):
+        assert not topic_matches("a/b", "a/b/c")
+        assert not topic_matches("a/b/c", "a/b")
+
+
+class TestMemoryBroker:
+    def make_client(self, broker, topics):
+        seen = []
+        client = MemoryMessage(
+            on_message=lambda t, p: seen.append((t, p)),
+            subscriptions=topics, broker=broker)
+        client.connect()
+        return client, seen
+
+    def test_pub_sub(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["x/y"])
+        sender, _ = self.make_client(broker, [])
+        sender.publish("x/y", "hello")
+        assert seen == [("x/y", "hello")]
+
+    def test_wildcard_subscription(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["ns/+/state"])
+        sender, _ = self.make_client(broker, [])
+        sender.publish("ns/p1/state", "absent")
+        sender.publish("ns/p1/other", "x")
+        assert seen == [("ns/p1/state", "absent")]
+
+    def test_retained_delivered_on_subscribe(self):
+        broker = MemoryBroker()
+        sender, _ = self.make_client(broker, [])
+        sender.publish("boot", "(primary found x)", retain=True)
+        _, seen = self.make_client(broker, ["boot"])
+        assert seen == [("boot", "(primary found x)")]
+
+    def test_retained_cleared_by_empty_payload(self):
+        broker = MemoryBroker()
+        sender, _ = self.make_client(broker, [])
+        sender.publish("boot", "data", retain=True)
+        sender.publish("boot", "", retain=True)
+        _, seen = self.make_client(broker, ["boot"])
+        assert seen == []              # nothing retained any more
+        assert broker.retained("boot") is None
+
+    def test_lwt_on_crash(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["state"])
+        dying = MemoryMessage(broker=broker, lwt_topic="state",
+                              lwt_payload="(absent)")
+        dying.connect()
+        dying.crash()
+        assert seen == [("state", "(absent)")]
+
+    def test_no_lwt_on_graceful_disconnect(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["state"])
+        leaving = MemoryMessage(broker=broker, lwt_topic="state",
+                                lwt_payload="(absent)")
+        leaving.connect()
+        leaving.disconnect()
+        assert seen == []
+
+    def test_multiple_wills(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["#"])
+        client = MemoryMessage(broker=broker, lwt_topic="a",
+                               lwt_payload="1")
+        client.add_last_will_and_testament("b", "2", retain=True)
+        client.connect()
+        client.crash()
+        assert ("a", "1") in seen and ("b", "2") in seen
+        assert broker.retained("b") == "2"
+
+    def test_no_delivery_after_disconnect(self):
+        broker = MemoryBroker()
+        client, seen = self.make_client(broker, ["t"])
+        client.disconnect()
+        sender, _ = self.make_client(broker, [])
+        sender.publish("t", "x")
+        assert seen == []
+
+    def test_subscribe_after_connect_gets_retained(self):
+        broker = MemoryBroker()
+        sender, _ = self.make_client(broker, [])
+        sender.publish("cfg", "v1", retain=True)
+        client, seen = self.make_client(broker, [])
+        client.subscribe("cfg")
+        assert seen == [("cfg", "v1")]
