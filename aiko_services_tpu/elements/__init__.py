@@ -1,0 +1,23 @@
+# Built-in pipeline elements.
+#
+# Capability parity with the reference built-ins
+# (reference: aiko_services/pipeline_elements.py:37-175): PE_GenerateNumbers
+# (source), PE_Metrics (per-element timing sink), PE_0..PE_4 arithmetic test
+# elements, PE_DataEncode/PE_DataDecode tensor marshalling.
+#
+# TPU-native change: DataEncode/Decode marshal tensors only at the
+# host↔control-plane boundary; co-located elements pass jax.Arrays through
+# the swag untouched (SURVEY.md §5.8: the encode/decode seam becomes tensor
+# egress/ingress at the device edge only).
+
+from .common import (                                       # noqa: F401
+    PE_GenerateNumbers, PE_Metrics, PE_Identity,
+    PE_0, PE_1, PE_2, PE_3, PE_4,
+    PE_DataEncode, PE_DataDecode,
+)
+
+__all__ = [
+    "PE_GenerateNumbers", "PE_Metrics", "PE_Identity",
+    "PE_0", "PE_1", "PE_2", "PE_3", "PE_4",
+    "PE_DataEncode", "PE_DataDecode",
+]

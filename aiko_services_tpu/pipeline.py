@@ -1,0 +1,678 @@
+# Pipeline framework: a dataflow DAG of PipelineElements processing streams
+# of frames.
+#
+# Capability parity with the reference pipeline framework
+# (reference: aiko_services/pipeline.py:116-938):
+#   * JSON pipeline definition — version / name / runtime / graph DSL /
+#     parameters / per-element definitions with local or remote deploy
+#     (reference schema: pipeline.py:753-866, dataclasses :137-173);
+#   * PipelineGraph — Graph + dataflow validation: every declared element
+#     input must be produced by a predecessor output or renamed through an
+#     explicit fan-in edge mapping (reference: pipeline.py:177-260);
+#   * PipelineElement — create_frame / get_parameter / process_frame /
+#     start_stream / stop_stream; every element is an Actor, so it is
+#     independently addressable and dashboard-visible
+#     (reference: pipeline.py:270-338);
+#   * Streams — leased lifecycles with per-stream parameters; frames extend
+#     the lease; expiry destroys the stream (reference: pipeline.py:717-749);
+#   * per-frame metrics: per-element and cumulative wall time stamped into
+#     the frame context (reference: pipeline.py:639-703);
+#   * remote elements: placeholder swapped for a discovered proxy when the
+#     remote service appears (reference: pipeline.py:340-362, :591-620).
+#
+# TPU-native design changes (SURVEY.md §7):
+#   * frames carry a "swag" dict whose values may be jax.Arrays — co-located
+#     elements hand tensors to each other on-device with no serialization
+#     (the reference zlib+np.save's tensors through an MQTT broker);
+#   * element process_frame may return a third value `defer` — a callable
+#     resolved later — enabling overlapped device execution (jax dispatch is
+#     async; the host DAG walk does not block on device completion);
+#   * an element failure destroys the failing stream only, not the process
+#     (the reference exits the whole process, pipeline.py:704-710);
+#   * deterministic: runs entirely on the EventEngine, so multi-pipeline
+#     systems are testable with a VirtualClock in one pytest process.
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .actor import Actor, get_remote_proxy
+from .lease import Lease
+from .service import ServiceFilter, ServiceProtocol
+from .share import ServicesCache
+from .utils import Graph, GraphError, get_logger, load_class, load_module
+
+__all__ = [
+    "PROTOCOL_PIPELINE", "PipelineDefinition", "PipelineElementDefinition",
+    "PipelineGraph", "PipelineElement", "Pipeline", "Stream", "Frame",
+    "FrameOutput", "parse_pipeline_definition", "load_pipeline_definition",
+    "PipelineError",
+]
+
+PROTOCOL_PIPELINE = ServiceProtocol("pipeline")
+DEFINITION_VERSION = 0
+STREAM_LEASE_TIME = 60.0          # reference: pipeline.py:128
+DEFAULT_STREAM_ID = "*"
+
+
+class PipelineError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Definition schema
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PipelineElementDefinition:
+    """One element in a pipeline definition.
+
+    deploy is either local —  {"local": {"module": ..., "class_name": ...}}
+    — or remote — {"remote": {"service_filter": {...}}} (reference:
+    pipeline.py:156-173)."""
+    name: str
+    input: list = field(default_factory=list)    # [{"name":..,"type":..}]
+    output: list = field(default_factory=list)
+    parameters: dict = field(default_factory=dict)
+    deploy: dict = field(default_factory=dict)
+
+    @property
+    def input_names(self) -> list[str]:
+        return [item["name"] for item in self.input]
+
+    @property
+    def output_names(self) -> list[str]:
+        return [item["name"] for item in self.output]
+
+    @property
+    def is_remote(self) -> bool:
+        return "remote" in self.deploy
+
+
+@dataclass
+class PipelineDefinition:
+    version: int
+    name: str
+    runtime: str
+    graph: list                    # list of graph-DSL strings
+    parameters: dict = field(default_factory=dict)
+    elements: list = field(default_factory=list)
+
+    def element(self, name: str) -> PipelineElementDefinition:
+        for element in self.elements:
+            if element.name == name:
+                return element
+        raise PipelineError(f"no element definition: {name}")
+
+
+_RUNTIMES = ("python", "jax", "tpu")
+
+
+def parse_pipeline_definition(data: dict,
+                              source: str = "<dict>") -> PipelineDefinition:
+    """Validate + build a PipelineDefinition from a parsed JSON dict.
+
+    Explicit structural validation replacing the reference's embedded Avro
+    schema (reference: pipeline.py:512-589, :753-866)."""
+    def fail(msg):
+        raise PipelineError(f"pipeline definition {source}: {msg}")
+
+    if not isinstance(data, dict):
+        fail("top level must be an object")
+    for key in ("version", "name", "runtime", "graph", "elements"):
+        if key not in data:
+            fail(f"missing required field {key!r}")
+    if data["version"] != DEFINITION_VERSION:
+        fail(f"version must be {DEFINITION_VERSION}, got {data['version']!r}")
+    if data["runtime"] not in _RUNTIMES:
+        fail(f"runtime must be one of {_RUNTIMES}, got {data['runtime']!r}")
+    graph = data["graph"]
+    if isinstance(graph, str):
+        graph = [graph]
+    if not isinstance(graph, list) or not graph or \
+            not all(isinstance(g, str) for g in graph):
+        fail("graph must be a non-empty list of DSL strings")
+    parameters = data.get("parameters", {})
+    if not isinstance(parameters, dict):
+        fail("parameters must be an object")
+
+    elements = []
+    seen = set()
+    for index, raw in enumerate(data["elements"]):
+        where = f"elements[{index}]"
+        if not isinstance(raw, dict) or "name" not in raw:
+            fail(f"{where}: must be an object with a name")
+        name = raw["name"]
+        if name in seen:
+            fail(f"{where}: duplicate element name {name!r}")
+        seen.add(name)
+        for io_key in ("input", "output"):
+            for io_item in raw.get(io_key, []):
+                if not isinstance(io_item, dict) or "name" not in io_item:
+                    fail(f"{where}.{io_key}: entries need a name")
+        deploy = raw.get("deploy", {})
+        if deploy:
+            if set(deploy) - {"local", "remote"} or len(deploy) != 1:
+                fail(f"{where}.deploy: exactly one of local|remote")
+            if "local" in deploy and "class_name" not in deploy["local"]:
+                fail(f"{where}.deploy.local: needs class_name")
+            if "remote" in deploy and "service_filter" not in deploy["remote"]:
+                fail(f"{where}.deploy.remote: needs service_filter")
+        elements.append(PipelineElementDefinition(
+            name=name,
+            input=list(raw.get("input", [])),
+            output=list(raw.get("output", [])),
+            parameters=dict(raw.get("parameters", {})),
+            deploy=dict(deploy)))
+
+    return PipelineDefinition(
+        version=data["version"], name=data["name"], runtime=data["runtime"],
+        graph=graph, parameters=dict(parameters), elements=elements)
+
+
+def load_pipeline_definition(pathname: str) -> PipelineDefinition:
+    with open(pathname) as f:
+        data = json.load(f)
+    return parse_pipeline_definition(data, source=pathname)
+
+
+# ---------------------------------------------------------------------------
+# Graph with dataflow validation
+# ---------------------------------------------------------------------------
+
+class PipelineGraph(Graph):
+    """Pipeline DAG: nodes carry elements; edges may carry name mappings
+    "(PE_1 (PE_2 (a: x)))" meaning PE_1's output `a` feeds PE_2's input `x`
+    (reference mapping capture: pipeline.py:418-427)."""
+
+    def __init__(self):
+        super().__init__()
+        # (tail, head) -> {producer_output_name: consumer_input_name}
+        self.mappings: dict[tuple[str, str], dict] = {}
+
+    @classmethod
+    def from_definition(cls,
+                        definition: PipelineDefinition) -> "PipelineGraph":
+        graph = cls()
+
+        def capture(tail, head, properties):
+            graph.mappings[(tail, head)] = dict(properties)
+
+        parsed = Graph.traverse(definition.graph, capture)
+        graph._nodes = parsed._nodes
+        graph._head_names = parsed._head_names
+        # re-key captured properties (traverse stores them on nodes too)
+        for node in graph.nodes():
+            for head, properties in node.properties.items():
+                graph.mappings.setdefault((node.name, head),
+                                          dict(properties))
+        for name in graph.node_names():
+            definition.element(name)        # every node must be defined
+        return graph
+
+    def validate(self, definition: PipelineDefinition) -> None:
+        """Every element input must be satisfiable: produced upstream under
+        the same name, renamed onto it by an edge mapping, or provided by
+        the stream swag for head nodes (reference: pipeline.py:230-260)."""
+        preds = self.predecessor_map()
+        for node in self.topological_order():
+            element_def = definition.element(node.name)
+            if not preds[node.name]:
+                continue        # head node: inputs come from the frame swag
+            available: set[str] = set()
+            for pred in preds[node.name]:
+                pred_outputs = definition.element(pred).output_names
+                mapping = self.mappings.get((pred, node.name), {})
+                for output_name in pred_outputs:
+                    available.add(mapping.get(output_name, output_name))
+            missing = [name for name in element_def.input_names
+                       if name not in available]
+            if missing:
+                raise PipelineError(
+                    f"element {node.name}: inputs {missing} not produced by "
+                    f"predecessors {preds[node.name]} (add an edge mapping?)")
+
+
+# ---------------------------------------------------------------------------
+# Streams and frames
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Stream:
+    """A leased sequence of frames flowing through the pipeline."""
+    stream_id: str
+    parameters: dict = field(default_factory=dict)
+    frame_id: int = 0
+    state: str = "run"              # run | stop
+    lease: Lease | None = None
+    variables: dict = field(default_factory=dict)   # element scratch space
+
+    def next_frame_id(self) -> int:
+        frame_id = self.frame_id
+        self.frame_id += 1
+        return frame_id
+
+
+@dataclass
+class Frame:
+    """One unit of work: stream context + named values ("swag")."""
+    stream: Stream
+    frame_id: int
+    swag: dict = field(default_factory=dict)
+    metrics: dict = field(default_factory=dict)
+
+    @property
+    def stream_id(self) -> str:
+        return self.stream.stream_id
+
+
+class FrameOutput:
+    """Element result: ok + named outputs.  `outputs=None` with ok=True means
+    "frame consumed" (sink / windowing elements that emit nothing)."""
+    __slots__ = ("ok", "outputs", "diagnostic")
+
+    def __init__(self, ok: bool, outputs: dict | None = None,
+                 diagnostic: str = ""):
+        self.ok = ok
+        self.outputs = outputs
+        self.diagnostic = diagnostic
+
+    def __iter__(self):     # allow  ok, outputs = element.process_frame(...)
+        yield self.ok
+        yield self.outputs
+
+
+# ---------------------------------------------------------------------------
+# PipelineElement
+# ---------------------------------------------------------------------------
+
+class PipelineElement(Actor):
+    """One stage of a pipeline.  Subclasses implement process_frame and may
+    implement start_stream / stop_stream (reference: pipeline.py:270-338).
+
+    Elements whose compute is a jax program should build/jit it once in
+    __init__ or start_stream and call it in process_frame — process_frame
+    itself is host-side control code."""
+
+    def __init__(self, runtime, name, definition: PipelineElementDefinition,
+                 pipeline: "Pipeline | None" = None, protocol=None,
+                 tags=None):
+        share = {"element": definition.name,
+                 "inputs": ",".join(definition.input_names),
+                 "outputs": ",".join(definition.output_names)}
+        super().__init__(runtime, name,
+                         protocol or ServiceProtocol("pipeline_element"),
+                         tags, share=share)
+        self.definition = definition
+        self.pipeline = pipeline
+        for key, value in definition.parameters.items():
+            self.ec_producer.update(f"parameter.{key}", value)
+
+    # -- parameters: stream > element > pipeline (reference: :316-329) ------
+    def get_parameter(self, name: str, default=None, stream: Stream = None):
+        if stream is not None and name in stream.parameters:
+            return stream.parameters[name], True
+        if name in self.definition.parameters:
+            return self.definition.parameters[name], True
+        if self.pipeline is not None:
+            pipeline_params = self.pipeline.definition.parameters
+            # specific beats general: "{element}.{name}" before bare "{name}"
+            scoped = f"{self.definition.name}.{name}"
+            if scoped in pipeline_params:
+                return pipeline_params[scoped], True
+            if name in pipeline_params:
+                return pipeline_params[name], True
+        return default, False
+
+    # -- stream lifecycle ---------------------------------------------------
+    def start_stream(self, stream: Stream) -> None:
+        pass
+
+    def stop_stream(self, stream: Stream) -> None:
+        pass
+
+    def process_frame(self, frame: Frame, **inputs) -> FrameOutput:
+        raise NotImplementedError
+
+    # -- source API: push a new frame into the owning pipeline --------------
+    def create_frame(self, stream: Stream, swag: dict) -> None:
+        """Thread-safe: posts a process_frame message onto the pipeline's
+        mailbox (reference: pipeline.py:415-416)."""
+        if self.pipeline is not None:
+            self.pipeline.post("process_frame", stream.stream_id, swag)
+
+
+class _RemoteElementPlaceholder:
+    """Stands in for a remote element until discovery finds it
+    (reference: PipelineElementRemoteAbsent, pipeline.py:340-352)."""
+
+    def __init__(self, definition: PipelineElementDefinition):
+        self.definition = definition
+        self.proxy = None
+        self.topic_path = None
+
+    @property
+    def found(self) -> bool:
+        return self.proxy is not None
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+
+class Pipeline(PipelineElement):
+    """The pipeline engine.  A Pipeline is-a PipelineElement, so pipelines
+    nest (reference: pipeline.py:377-398).
+
+    Frame walk: topological DAG order; each element's declared inputs are
+    gathered from the swag (applying fan-in renames), process_frame invoked,
+    outputs renamed per fan-out mapping and merged back into the swag, and
+    per-element wall time recorded (reference hot loop: pipeline.py:623-715).
+    """
+
+    def __init__(self, runtime, definition: PipelineDefinition,
+                 name: str | None = None, definition_pathname: str = "",
+                 element_classes: dict | None = None,
+                 services_cache: ServicesCache | None = None,
+                 stream_lease_time: float = STREAM_LEASE_TIME,
+                 auto_create_streams: bool = False):
+        self._element_classes = element_classes or {}
+        self.graph = PipelineGraph.from_definition(definition)
+        self.graph.validate(definition)
+        super().__init__(
+            runtime, name or definition.name,
+            PipelineElementDefinition(name=definition.name),
+            pipeline=None, protocol=PROTOCOL_PIPELINE,
+            tags=[f"definition={definition_pathname}"] if definition_pathname
+                 else None)
+        # the Actor base stored the element-level definition; a Pipeline's
+        # own definition is the pipeline-level one (it has .parameters too,
+        # so get_parameter's fallback chain terminates here)
+        self.element_definition = self.definition
+        self.definition = definition
+        self.pipeline = self        # parameter resolution terminates here
+        self.logger = get_logger(f"pipeline.{self.name}")
+        self.stream_lease_time = stream_lease_time
+        self.auto_create_streams = auto_create_streams
+        self.streams: dict[str, Stream] = {}
+        self._remote: dict[str, _RemoteElementPlaceholder] = {}
+        self._services_cache = services_cache
+        self._frame_handlers: list[Callable] = []
+        self._create_elements()
+        self._precompute_schedule()
+        self.ec_producer.update("element_count", len(self.graph))
+        self.ec_producer.update("stream_count", 0)
+
+    # -- element construction (reference: pipeline.py:429-493) --------------
+    def _create_elements(self) -> None:
+        for node in self.graph.nodes():
+            element_def = self.definition.element(node.name)
+            if element_def.is_remote:
+                placeholder = _RemoteElementPlaceholder(element_def)
+                node.element = placeholder
+                self._remote[node.name] = placeholder
+                self._watch_remote(node.name, element_def)
+                continue
+            node.element = self._instantiate(element_def)
+
+    def _instantiate(self, element_def) -> PipelineElement:
+        local = element_def.deploy.get("local", {})
+        class_name = local.get("class_name", element_def.name)
+        if class_name in self._element_classes:
+            element_class = self._element_classes[class_name]
+        elif "module" in local:
+            element_class = load_class(local["module"], class_name)
+        else:
+            from . import elements as _builtin
+            element_class = getattr(_builtin, class_name, None)
+            if element_class is None:
+                raise PipelineError(
+                    f"element {element_def.name}: class {class_name} not in "
+                    f"element_classes, no deploy.local.module given, and not "
+                    f"a built-in element")
+        return element_class(self.runtime, f"{self.name}.{element_def.name}",
+                             element_def, pipeline=self)
+
+    def _precompute_schedule(self) -> None:
+        """Freeze the per-frame walk: graph + definition are immutable after
+        construction, so topo order, predecessor/rename maps and element
+        definitions are computed once, not per frame (the reference rebuilds
+        them each frame inside its hot loop, pipeline.py:650-712)."""
+        self._topo_nodes = self.graph.topological_order()
+        preds = self.graph.predecessor_map()
+        self._element_defs = {node.name: self.definition.element(node.name)
+                              for node in self._topo_nodes}
+        # per-node: declared input name -> name as produced upstream
+        self._renames: dict[str, dict[str, str]] = {}
+        for node in self._topo_nodes:
+            rename = {}
+            for pred in preds[node.name]:
+                mapping = self.graph.mappings.get((pred, node.name), {})
+                for src, dst in mapping.items():
+                    rename[dst] = src
+            self._renames[node.name] = rename
+
+    def _watch_remote(self, node_name: str, element_def) -> None:
+        """Swap the placeholder for a live proxy when the remote pipeline
+        service appears (reference: pipeline.py:591-620)."""
+        if self._services_cache is None:
+            return
+        raw = element_def.deploy["remote"]["service_filter"]
+        service_filter = ServiceFilter(**raw) if isinstance(raw, dict) \
+            else raw
+
+        def handler(command, fields):
+            placeholder = self._remote[node_name]
+            if command == "add" and not placeholder.found:
+                placeholder.topic_path = fields.topic_path
+                placeholder.proxy = get_remote_proxy(
+                    self.runtime, f"{fields.topic_path}/in", Pipeline)
+                self.logger.info("pipeline %s: remote element %s found at %s",
+                                 self.name, node_name, fields.topic_path)
+            elif command == "remove" and \
+                    placeholder.topic_path == fields.topic_path:
+                placeholder.proxy = None
+                placeholder.topic_path = None
+
+        self._services_cache.add_handler(handler, service_filter)
+
+    def remote_elements_ready(self) -> bool:
+        return all(p.found for p in self._remote.values())
+
+    # -- stream lifecycle (reference: pipeline.py:717-749) ------------------
+    def create_stream(self, stream_id, parameters: dict | None = None,
+                      lease_time: float | None = None) -> Stream:
+        stream_id = str(stream_id)
+        if stream_id in self.streams:
+            raise PipelineError(f"stream exists: {stream_id}")
+        stream = Stream(stream_id=stream_id,
+                        parameters=dict(parameters or {}))
+        lease_time = lease_time if lease_time is not None \
+            else self.stream_lease_time
+        if lease_time > 0:
+            stream.lease = Lease(
+                self.runtime.event, lease_time, stream_id,
+                lease_expired_handler=lambda _id:
+                    self.destroy_stream(stream_id))
+        self.streams[stream_id] = stream
+        self.ec_producer.update("stream_count", len(self.streams))
+        try:
+            for node in self._topo_nodes:
+                element = node.element
+                if isinstance(element, PipelineElement):
+                    element.start_stream(stream)
+        except Exception as exc:
+            # don't leave a half-initialized stream registered
+            self.destroy_stream(stream_id)
+            raise PipelineError(
+                f"pipeline {self.name}: start_stream({stream_id}) failed in "
+                f"element {node.name}: {exc!r}") from exc
+        return stream
+
+    def destroy_stream(self, stream_id) -> None:
+        stream = self.streams.pop(str(stream_id), None)
+        if stream is None:
+            return
+        stream.state = "stop"
+        if stream.lease is not None:
+            stream.lease.terminate()
+        for node in self._topo_nodes:
+            element = node.element
+            if isinstance(element, PipelineElement):
+                try:
+                    element.stop_stream(stream)
+                except Exception:
+                    self.logger.exception(
+                        "pipeline %s: %s.stop_stream(%s) raised", self.name,
+                        node.name, stream_id)
+        self.ec_producer.update("stream_count", len(self.streams))
+
+    def add_frame_handler(self, handler: Callable) -> None:
+        """handler(frame) after every completed frame (tests, sinks,
+        benchmark harnesses)."""
+        self._frame_handlers.append(handler)
+
+    # -- frame engine (reference hot loop: pipeline.py:623-715) -------------
+    def process_frame(self, frame_or_stream_id, swag: dict | None = None,
+                      **_kwargs) -> FrameOutput:
+        """Dual interface: called with (Frame, **inputs) when nested as an
+        element, or with (stream_id, swag) via the actor mailbox."""
+        if isinstance(frame_or_stream_id, Frame):
+            # nested as an element: isolate the walk on a swag copy so a
+            # nested failure or scratch value never mutates the parent frame;
+            # the declared-output filter below returns only our interface
+            parent = frame_or_stream_id
+            stream = parent.stream
+            child_swag = dict(parent.swag)
+            child_swag.update(_kwargs)      # fan-in renamed inputs
+            frame = Frame(stream=stream, frame_id=parent.frame_id,
+                          swag=child_swag, metrics=parent.metrics)
+        else:
+            stream = self.streams.get(str(frame_or_stream_id))
+            if stream is None:
+                # "*" always auto-creates; named streams only when serving
+                # remote frames (auto_create_streams) — leased, so orphaned
+                # remote streams expire
+                if str(frame_or_stream_id) == DEFAULT_STREAM_ID:
+                    stream = self.create_stream(DEFAULT_STREAM_ID,
+                                                lease_time=0)
+                elif self.auto_create_streams:
+                    stream = self.create_stream(str(frame_or_stream_id))
+                else:
+                    self.logger.warning("pipeline %s: frame for unknown "
+                                        "stream %s dropped", self.name,
+                                        frame_or_stream_id)
+                    return FrameOutput(False, diagnostic="unknown stream")
+            frame = Frame(stream=stream, frame_id=stream.next_frame_id(),
+                          swag=dict(swag or {}))
+        if stream.lease is not None:
+            stream.lease.extend()
+
+        start = time.perf_counter()
+        frame.metrics["time_pipeline_start"] = start
+        swag = frame.swag
+
+        for node in self._topo_nodes:
+            element = node.element
+            element_def = self._element_defs[node.name]
+            inputs = self._gather_inputs(node.name, element_def, swag)
+            if inputs is None:
+                self._fail_frame(frame, node.name,
+                                 "missing inputs in swag")
+                return FrameOutput(False,
+                                   diagnostic=f"{node.name}: missing inputs")
+            element_start = time.perf_counter()
+
+            if isinstance(element, _RemoteElementPlaceholder):
+                ok, outputs = self._process_remote(element, frame, inputs)
+            else:
+                try:
+                    result = element.process_frame(frame, **inputs)
+                except Exception as exc:
+                    self.logger.exception(
+                        "pipeline %s: element %s raised", self.name,
+                        node.name)
+                    self._fail_frame(frame, node.name, repr(exc))
+                    return FrameOutput(False,
+                                       diagnostic=f"{node.name}: {exc!r}")
+                ok, outputs = result
+            frame.metrics[f"time_{node.name}"] = \
+                time.perf_counter() - element_start
+            if not ok:
+                self._fail_frame(frame, node.name, "element reported not-ok")
+                return FrameOutput(
+                    False, diagnostic=f"{node.name}: reported not-ok")
+            if outputs:
+                # an element's interface is its declared outputs: scratch
+                # values (e.g. a nested pipeline's intermediates) don't leak
+                if element_def.output:
+                    declared = element_def.output_names
+                    outputs = {k: v for k, v in outputs.items()
+                               if k in declared}
+                self._scatter_outputs(node.name, outputs, swag)
+
+        frame.metrics["time_pipeline"] = time.perf_counter() - start
+        for handler in self._frame_handlers:
+            handler(frame)
+        return FrameOutput(True, dict(swag))
+
+    def _gather_inputs(self, node_name, element_def, swag):
+        """Collect declared inputs from the swag, applying fan-in renames
+        (reference: pipeline.py:657-675)."""
+        rename = self._renames[node_name]
+        inputs = {}
+        for input_name in element_def.input_names:
+            source_name = input_name if input_name in swag else \
+                rename.get(input_name, input_name)
+            if input_name in swag:
+                inputs[input_name] = swag[input_name]
+            elif source_name in swag:
+                inputs[input_name] = swag[source_name]
+            else:
+                return None
+        return inputs
+
+    def _scatter_outputs(self, node_name, outputs, swag) -> None:
+        """Merge outputs into the swag, applying fan-out renames per edge
+        mapping (reference: pipeline.py:687-703)."""
+        renamed = dict(outputs)
+        for successor in self.graph.successors(node_name):
+            mapping = self.graph.mappings.get((node_name, successor), {})
+            for src, dst in mapping.items():
+                if src in outputs:
+                    renamed[dst] = outputs[src]
+        swag.update(renamed)
+
+    def _process_remote(self, placeholder, frame, inputs):
+        """Fire a frame at a discovered remote pipeline.  Fire-and-forget,
+        like the reference (pipeline.py:693-695: result return is an
+        acknowledged TODO there; our data plane handles co-located tensor
+        handoff on-device instead).
+
+        The serving pipeline should run with auto_create_streams=True so
+        frames for upstream-created streams are accepted.  Values cross the
+        wire as S-expression text: tensors must pass through PE_DataEncode
+        before the boundary and PE_DataDecode after it (the device data
+        plane bypasses this entirely for co-located elements)."""
+        if not placeholder.found:
+            return False, None
+        placeholder.proxy.process_frame(frame.stream_id, inputs)
+        return True, {}
+
+    def _fail_frame(self, frame, node_name, diagnostic) -> None:
+        self.logger.error("pipeline %s stream %s frame %s: element %s "
+                          "failed: %s", self.name, frame.stream_id,
+                          frame.frame_id, node_name, diagnostic)
+        self.destroy_stream(frame.stream_id)
+
+    def stop(self) -> None:
+        for stream_id in list(self.streams):
+            self.destroy_stream(stream_id)
+        for node in self.graph.nodes():
+            element = node.element
+            if isinstance(element, PipelineElement) and element is not self:
+                element.stop()
+        super().stop()

@@ -169,6 +169,11 @@ class ProcessRuntime:
     def add_service(self, service) -> int:
         service_id = next(self._service_counter)
         self._services[service_id] = service
+        # assign the address here: service_fields() (used for registrar
+        # registration below) needs topic_path before Service.__init__ has
+        # returned
+        service.service_id = service_id
+        service.topic_path = f"{self.topic_path}/{service_id}"
         if self.registrar is not None:
             self._register_service(service)
         return service_id

@@ -215,7 +215,8 @@ class Registrar(Service):
 
     # -- shutdown ----------------------------------------------------------
     def stop(self) -> None:
-        if self.is_primary:
+        was_primary = self.is_primary
+        if was_primary:
             boot_topic = self.runtime.topic_registrar_boot
             self.runtime.publish(boot_topic, "", retain=True)
             self.runtime.publish(boot_topic,
@@ -224,4 +225,15 @@ class Registrar(Service):
                                   "remove_last_will_and_testament", None)
             if remove_will:
                 remove_will(boot_topic)
+        # full teardown: a stopped registrar must neither keep serving its
+        # protocol nor re-assert primacy when a successor announces itself
+        self._cancel_search()
+        runtime = self.runtime
+        runtime.remove_message_handler(self._boot_handler,
+                                       runtime.topic_registrar_boot)
+        runtime.remove_message_handler(self._in_handler, self.topic_in)
+        runtime.remove_message_handler(
+            self._state_handler, f"{runtime.namespace}/+/+/+/state")
+        if was_primary:
+            self.state_machine.transition("primary_yield")
         super().stop()

@@ -1,0 +1,393 @@
+# Pipeline framework tests: definition validation, the diamond dataflow
+# graph with fan-in/out mappings, stream lifecycle + leases, metrics,
+# failure isolation.  (The reference ships the diamond graph as
+# examples/pipeline/pipeline_local.json and has no automated tests at all —
+# SURVEY.md §4.)
+
+import json
+
+import pytest
+
+from aiko_services_tpu.pipeline import (
+    Pipeline, PipelineError, PipelineGraph, load_pipeline_definition,
+    parse_pipeline_definition,
+)
+
+
+def element(name, inputs=(), outputs=(), parameters=None, deploy=None):
+    return {
+        "name": name,
+        "input": [{"name": n, "type": "int"} for n in inputs],
+        "output": [{"name": n, "type": "int"} for n in outputs],
+        "parameters": parameters or {},
+        "deploy": deploy or {},
+    }
+
+
+DIAMOND = {
+    "version": 0,
+    "name": "p_diamond",
+    "runtime": "python",
+    "graph": ["(PE_1 (PE_2 PE_4) (PE_3 PE_4) PE_Metrics)"],
+    "parameters": {},
+    "elements": [
+        element("PE_1", ["number"], ["a"]),
+        element("PE_2", ["a"], ["b"]),
+        element("PE_3", ["a"], ["c"]),
+        element("PE_4", ["b", "c"], ["d"]),
+        element("PE_Metrics"),
+    ],
+}
+
+
+# -- definition parsing ------------------------------------------------------
+
+def test_parse_definition_roundtrip(tmp_path):
+    path = tmp_path / "diamond.json"
+    path.write_text(json.dumps(DIAMOND))
+    definition = load_pipeline_definition(str(path))
+    assert definition.name == "p_diamond"
+    assert definition.element("PE_4").input_names == ["b", "c"]
+
+
+@pytest.mark.parametrize("mutate, message", [
+    (lambda d: d.pop("version"), "missing required field"),
+    (lambda d: d.update(version=7), "version must be"),
+    (lambda d: d.update(runtime="torch"), "runtime must be"),
+    (lambda d: d.update(graph=[]), "graph must be"),
+    (lambda d: d["elements"].append(element("PE_1")), "duplicate element"),
+])
+def test_parse_definition_rejects(mutate, message):
+    bad = json.loads(json.dumps(DIAMOND))
+    mutate(bad)
+    with pytest.raises(PipelineError, match=message):
+        parse_pipeline_definition(bad)
+
+
+def test_deploy_validation():
+    bad = json.loads(json.dumps(DIAMOND))
+    bad["elements"][0]["deploy"] = {"local": {}, "remote": {}}
+    with pytest.raises(PipelineError, match="exactly one"):
+        parse_pipeline_definition(bad)
+
+
+# -- graph validation --------------------------------------------------------
+
+def test_graph_validate_detects_unproduced_input():
+    bad = json.loads(json.dumps(DIAMOND))
+    # PE_2 now wants an input nothing upstream produces
+    bad["elements"][1]["input"] = [{"name": "zz", "type": "int"}]
+    definition = parse_pipeline_definition(bad)
+    graph = PipelineGraph.from_definition(definition)
+    with pytest.raises(PipelineError, match=r"PE_2.*zz"):
+        graph.validate(definition)
+
+
+def test_graph_edge_mapping_satisfies_input():
+    data = {
+        "version": 0, "name": "p_map", "runtime": "python",
+        "graph": ["(PE_A (PE_B (out_x: in_y)))"],
+        "elements": [
+            element("PE_A", [], ["out_x"]),
+            element("PE_B", ["in_y"], []),
+        ],
+    }
+    definition = parse_pipeline_definition(data)
+    graph = PipelineGraph.from_definition(definition)
+    graph.validate(definition)      # must not raise
+    assert graph.mappings[("PE_A", "PE_B")] == {"out_x": "in_y"}
+
+
+def test_graph_node_without_element_definition():
+    bad = json.loads(json.dumps(DIAMOND))
+    bad["elements"] = bad["elements"][:-1]      # drop PE_Metrics
+    definition = parse_pipeline_definition(bad)
+    with pytest.raises(PipelineError, match="PE_Metrics"):
+        PipelineGraph.from_definition(definition)
+
+
+# -- frame engine ------------------------------------------------------------
+
+@pytest.fixture
+def pipeline(make_runtime):
+    runtime = make_runtime("pipeline_host").initialize()
+    definition = parse_pipeline_definition(json.loads(json.dumps(DIAMOND)))
+    return Pipeline(runtime, definition, stream_lease_time=0)
+
+
+def test_diamond_dataflow(pipeline):
+    pipeline.create_stream("s1", lease_time=0)
+    result = pipeline.process_frame("s1", {"number": 3})
+    ok, swag = result
+    assert ok
+    # 3 -> PE_1 a=4 -> PE_2 b=8 / PE_3 c=14 -> PE_4 d=22
+    assert swag["a"] == 4 and swag["b"] == 8 and swag["c"] == 14
+    assert swag["d"] == 22
+
+
+def test_frame_metrics_recorded(pipeline):
+    pipeline.create_stream("s1", lease_time=0)
+    captured = []
+    pipeline.add_frame_handler(captured.append)
+    pipeline.process_frame("s1", {"number": 0})
+    frame = captured[0]
+    assert "time_pipeline" in frame.metrics
+    for name in ("PE_1", "PE_2", "PE_3", "PE_4"):
+        assert f"time_{name}" in frame.metrics
+    metrics_element = pipeline.runtime.service_by_name(
+        "p_diamond.PE_Metrics")
+    assert metrics_element.ec_producer.get("metrics.frame_id") == 0
+
+
+def test_frame_ids_increment(pipeline):
+    pipeline.create_stream("s1", lease_time=0)
+    captured = []
+    pipeline.add_frame_handler(captured.append)
+    for number in range(3):
+        pipeline.process_frame("s1", {"number": number})
+    assert [f.frame_id for f in captured] == [0, 1, 2]
+
+
+def test_unknown_stream_dropped(pipeline):
+    ok, _ = pipeline.process_frame("nope", {"number": 1})
+    assert not ok
+
+
+def test_default_stream_autocreated(pipeline):
+    ok, swag = pipeline.process_frame("*", {"number": 0})
+    assert ok and swag["d"] == 13
+
+
+def test_element_failure_destroys_stream_only(make_runtime):
+    from aiko_services_tpu.pipeline import (
+        Frame, FrameOutput, PipelineElement)
+
+    class PE_Boom(PipelineElement):
+        def process_frame(self, frame, **inputs):
+            raise RuntimeError("boom")
+
+    runtime = make_runtime("boom_host").initialize()
+    data = {
+        "version": 0, "name": "p_boom", "runtime": "python",
+        "graph": ["(PE_Boom)"],
+        "elements": [element("PE_Boom")],
+    }
+    definition = parse_pipeline_definition(data)
+    pipeline = Pipeline(runtime, definition,
+                        element_classes={"PE_Boom": PE_Boom},
+                        stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+    pipeline.create_stream("s2", lease_time=0)
+    ok, _ = pipeline.process_frame("s1", {})
+    assert not ok
+    assert "s1" not in pipeline.streams      # failing stream destroyed
+    assert "s2" in pipeline.streams          # other streams unaffected
+
+
+def test_stream_lease_expiry_destroys_stream(make_runtime, engine):
+    runtime = make_runtime("lease_host").initialize()
+    definition = parse_pipeline_definition(json.loads(json.dumps(DIAMOND)))
+    pipeline = Pipeline(runtime, definition)
+    pipeline.create_stream("s1", lease_time=5.0)
+    assert "s1" in pipeline.streams
+    engine.clock.advance(6.0)
+    engine.step()
+    assert "s1" not in pipeline.streams
+
+
+def test_frames_extend_stream_lease(make_runtime, engine):
+    runtime = make_runtime("extend_host").initialize()
+    definition = parse_pipeline_definition(json.loads(json.dumps(DIAMOND)))
+    pipeline = Pipeline(runtime, definition)
+    pipeline.create_stream("s1", lease_time=5.0)
+    for _ in range(3):
+        engine.clock.advance(3.0)
+        engine.step()
+        pipeline.process_frame("s1", {"number": 1})
+    assert "s1" in pipeline.streams          # 9s elapsed, lease kept alive
+    engine.clock.advance(6.0)
+    engine.step()
+    assert "s1" not in pipeline.streams
+
+
+def test_generate_numbers_source(make_runtime, engine):
+    runtime = make_runtime("source_host").initialize()
+    data = {
+        "version": 0, "name": "p_source", "runtime": "python",
+        "graph": ["(PE_GenerateNumbers PE_0)"],
+        "parameters": {"PE_0.constant": 100},
+        "elements": [
+            element("PE_GenerateNumbers", [], ["number"],
+                    parameters={"rate": 10.0, "limit": 5}),
+            element("PE_0", ["number"], ["a"]),
+        ],
+    }
+    definition = parse_pipeline_definition(data)
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    captured = []
+    pipeline.add_frame_handler(captured.append)
+    pipeline.create_stream("s1", lease_time=0)
+    for _ in range(20):
+        engine.clock.advance(0.1)
+        engine.step()
+    assert len(captured) == 5                 # limit honoured
+    assert [f.swag["a"] for f in captured] == [100, 101, 102, 103, 104]
+
+
+def test_pipeline_level_parameter_resolution(make_runtime):
+    runtime = make_runtime("param_host").initialize()
+    data = {
+        "version": 0, "name": "p_params", "runtime": "python",
+        "graph": ["(PE_0)"],
+        "parameters": {"PE_0.constant": 7},
+        "elements": [element("PE_0", ["number"], ["a"])],
+    }
+    pipeline = Pipeline(runtime, parse_pipeline_definition(data),
+                        stream_lease_time=0)
+    stream = pipeline.create_stream("s1", lease_time=0)
+    ok, swag = pipeline.process_frame("s1", {"number": 1})
+    assert ok and swag["a"] == 8
+    # stream parameters override pipeline-level
+    stream.parameters["constant"] = 50
+    ok, swag = pipeline.process_frame("s1", {"number": 1})
+    assert ok and swag["a"] == 51
+
+
+def test_data_encode_decode_roundtrip(make_runtime):
+    np = pytest.importorskip("numpy")
+    runtime = make_runtime("codec_host").initialize()
+    data = {
+        "version": 0, "name": "p_codec", "runtime": "python",
+        "graph": ["(PE_DataEncode PE_DataDecode)"],
+        "elements": [
+            element("PE_DataEncode", ["data"], ["data"]),
+            element("PE_DataDecode", ["data"], ["data"]),
+        ],
+    }
+    pipeline = Pipeline(runtime, parse_pipeline_definition(data),
+                        stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+    tensor = np.arange(12, dtype=np.float32).reshape(3, 4)
+    ok, swag = pipeline.process_frame("s1", {"data": tensor})
+    assert ok
+    np.testing.assert_array_equal(swag["data"], tensor)
+
+
+def test_nested_pipeline(make_runtime):
+    """A Pipeline is-a PipelineElement: inner pipeline used as a stage."""
+    runtime = make_runtime("nest_host").initialize()
+    inner_def = parse_pipeline_definition({
+        "version": 0, "name": "inner", "runtime": "python",
+        "graph": ["(PE_2)"],
+        "elements": [element("PE_2", ["a"], ["b"])],
+    })
+    inner = Pipeline(runtime, inner_def, stream_lease_time=0)
+    outer_def = parse_pipeline_definition({
+        "version": 0, "name": "outer", "runtime": "python",
+        "graph": ["(PE_1 inner)"],
+        "elements": [
+            element("PE_1", ["number"], ["a"]),
+            element("inner", ["a"], ["b"]),
+        ],
+    })
+    outer = Pipeline(runtime, outer_def,
+                     element_classes={"inner": lambda *a, **k: inner},
+                     stream_lease_time=0)
+    outer.create_stream("s1", lease_time=0)
+    inner.create_stream("s1", lease_time=0)
+    ok, swag = outer.process_frame("s1", {"number": 3})
+    assert ok and swag["b"] == 8              # (3+1)*2
+
+
+# -- regression tests for review findings ------------------------------------
+
+def test_scoped_parameter_beats_global(make_runtime):
+    runtime = make_runtime("scope_host").initialize()
+    data = {
+        "version": 0, "name": "p_scope", "runtime": "python",
+        "graph": ["(PE_0)"],
+        "parameters": {"constant": 5, "PE_0.constant": 9},
+        "elements": [element("PE_0", ["number"], ["a"])],
+    }
+    pipeline = Pipeline(runtime, parse_pipeline_definition(data),
+                        stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+    ok, swag = pipeline.process_frame("s1", {"number": 0})
+    assert ok and swag["a"] == 9          # scoped override wins
+
+
+def test_start_stream_failure_cleans_up(make_runtime):
+    from aiko_services_tpu.pipeline import PipelineElement
+
+    class PE_BadStart(PipelineElement):
+        def start_stream(self, stream):
+            raise RuntimeError("no device")
+
+        def process_frame(self, frame, **inputs):
+            return True, {}
+
+    runtime = make_runtime("badstart_host").initialize()
+    data = {
+        "version": 0, "name": "p_badstart", "runtime": "python",
+        "graph": ["(PE_BadStart)"],
+        "elements": [element("PE_BadStart")],
+    }
+    pipeline = Pipeline(runtime, parse_pipeline_definition(data),
+                        element_classes={"PE_BadStart": PE_BadStart},
+                        stream_lease_time=0)
+    with pytest.raises(PipelineError, match="PE_BadStart"):
+        pipeline.create_stream("s1", lease_time=0)
+    assert "s1" not in pipeline.streams
+    # retry is possible after cleanup (no "stream exists")
+    with pytest.raises(PipelineError):
+        pipeline.create_stream("s1", lease_time=0)
+
+
+def test_nested_pipeline_isolates_parent_swag(make_runtime):
+    """Inner scratch values must not clobber the outer swag; only the
+    declared outputs of the nested element cross back."""
+    runtime = make_runtime("isolate_host").initialize()
+    # inner produces scratch "a" (a collision with outer's "a") and "b"
+    inner_def = parse_pipeline_definition({
+        "version": 0, "name": "inner2", "runtime": "python",
+        "graph": ["(PE_1 PE_2)"],
+        "elements": [
+            element("PE_1", ["number"], ["a"]),
+            element("PE_2", ["a"], ["b"]),
+        ],
+    })
+    inner = Pipeline(runtime, inner_def, stream_lease_time=0)
+    outer_def = parse_pipeline_definition({
+        "version": 0, "name": "outer2", "runtime": "python",
+        "graph": ["(PE_1 inner2 PE_3)"],    # fan-out: inner2 and PE_3
+        "elements": [
+            element("PE_1", ["number"], ["a"]),
+            element("inner2", ["a"], ["b"]),        # declares only b out
+            element("PE_3", ["a"], ["c"]),
+        ],
+    })
+    outer = Pipeline(runtime, outer_def,
+                     element_classes={"inner2": lambda *a, **k: inner},
+                     stream_lease_time=0)
+    outer.create_stream("s1", lease_time=0)
+    inner.create_stream("s1", lease_time=0)
+    ok, swag = outer.process_frame("s1", {"number": 3})
+    assert ok
+    # outer PE_1: a=4; inner PE_1 scratch a=4 (same calc) must not leak —
+    # but prove isolation with PE_3 consuming OUTER's a: c = 4+10
+    assert swag["a"] == 4 and swag["c"] == 14
+    assert swag["b"] == 8                 # inner's declared output crossed
+
+
+def test_auto_create_streams_for_remote_frames(make_runtime, engine):
+    runtime = make_runtime("serve_host").initialize()
+    definition = parse_pipeline_definition(json.loads(json.dumps(DIAMOND)))
+    serving = Pipeline(runtime, definition, auto_create_streams=True,
+                       stream_lease_time=5.0)
+    ok, swag = serving.process_frame("upstream-7", {"number": 1})
+    assert ok and swag["d"] == 16         # a=2 -> b=4, c=12 -> d=16
+    assert "upstream-7" in serving.streams
+    # orphaned remote stream expires with its lease
+    engine.clock.advance(6.0)
+    engine.step()
+    assert "upstream-7" not in serving.streams

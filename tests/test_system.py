@@ -216,3 +216,52 @@ class TestECShare:
         settle(engine, 1.0)
         assert cache.services.get(actor.topic_path) is None
         assert any(f.topic_path == actor.topic_path for f in cache.history)
+
+
+def test_stopped_primary_does_not_reassert(make_runtime, engine):
+    """A stopped primary registrar must not re-assert primacy when the
+    successor announces itself (review finding: stop() left handlers
+    registered and state 'primary')."""
+    from aiko_services_tpu.registrar import Registrar
+    rt1 = make_runtime("reg1").initialize()
+    reg1 = Registrar(rt1)
+    engine.clock.advance(2.1)
+    for _ in range(5):
+        engine.step()
+    assert reg1.is_primary
+    reg1.stop()
+    assert not reg1.is_primary
+    rt2 = make_runtime("reg2").initialize()
+    reg2 = Registrar(rt2)
+    engine.clock.advance(2.1)
+    for _ in range(5):
+        engine.step()
+    assert reg2.is_primary
+    for _ in range(5):
+        engine.step()
+    # reg2 remains the announced primary; reg1 stayed quiet
+    assert reg2.is_primary and not reg1.is_primary
+    assert rt2.registrar["topic_path"] == reg2.topic_path
+
+
+def test_service_created_after_registrar_known(make_runtime, engine):
+    """Regression: adding a service AFTER the registrar is discovered must
+    register it (add_service builds the discovery record mid-construction,
+    before Service.__init__ returned)."""
+    from aiko_services_tpu.actor import Actor
+    from aiko_services_tpu.registrar import Registrar
+    reg_rt = make_runtime("regA").initialize()
+    registrar = Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    for _ in range(5):
+        engine.step()
+    assert registrar.is_primary
+    app_rt = make_runtime("appA").initialize()
+    for _ in range(5):
+        engine.step()
+    assert app_rt.registrar is not None
+    actor = Actor(app_rt, "late_actor")       # created after discovery
+    for _ in range(5):
+        engine.step()
+    assert any(f.name == "late_actor" for f in registrar.services)
+    assert actor.topic_path.endswith(f"/{actor.service_id}")
