@@ -1,0 +1,22 @@
+# Parallelism substrate: meshes, sharding rules, collectives, ring
+# attention.  The TPU-native replacement for distribution the reference
+# does over MQTT (SURVEY.md §2 "Parallelism & distribution components").
+#
+# jax is imported lazily inside functions — control-plane-only processes
+# never pay for it.
+
+from .mesh import (                                         # noqa: F401
+    AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_SEQUENCE, AXIS_STAGE,
+    MeshSpec, best_mesh_shape, create_mesh, single_device_mesh,
+)
+from .sharding import (                                     # noqa: F401
+    DEFAULT_RULES, ShardingRules, constrain, named_sharding, replicated,
+    shard_pytree,
+)
+from .collectives import (                                  # noqa: F401
+    all_gather, axis_index, axis_size, device_transfer, pmax, pmean,
+    ppermute_ring, psum, reduce_scatter, ring_neighbours,
+)
+from .ring_attention import (                               # noqa: F401
+    attention_reference, ring_attention, ring_attention_sharded,
+)

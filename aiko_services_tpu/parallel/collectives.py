@@ -1,0 +1,73 @@
+# Collective communication: the tensor-path replacement for the reference's
+# MQTT data plane.
+#
+# The reference moves tensors as zlib+np.save blobs through a broker
+# (reference: aiko_services/elements/audio_io.py:392-439); here co-located
+# elements exchange jax.Arrays and cross-chip movement is XLA collectives
+# over ICI/DCN.  These wrappers exist so runtime code (schedulers, pipeline
+# data plane) has one seam for device communication — inside shard_map they
+# are the jax.lax collectives; outside they are sharding-aware transfers.
+
+from __future__ import annotations
+
+__all__ = ["psum", "pmean", "pmax", "all_gather", "ppermute_ring",
+           "reduce_scatter", "axis_index", "axis_size", "device_transfer",
+           "ring_neighbours"]
+
+
+def psum(x, axis_name):
+    import jax
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    import jax
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    import jax
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name, axis: int = 0, tiled: bool = True):
+    import jax
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis: int = 0):
+    import jax
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def axis_index(axis_name):
+    import jax
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    import jax
+    return jax.lax.psum(1, axis_name)
+
+
+def ring_neighbours(n: int, reverse: bool = False):
+    """Permutation table sending shard j → j+1 (mod n); the ICI ring."""
+    if reverse:
+        return [(j, (j - 1) % n) for j in range(n)]
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def ppermute_ring(x, axis_name, n: int, reverse: bool = False):
+    """Rotate x one hop around the ring of `axis_name` (ring attention,
+    pipeline-parallel stage handoff)."""
+    import jax
+    return jax.lax.ppermute(x, axis_name,
+                            perm=ring_neighbours(n, reverse))
+
+
+def device_transfer(x, sharding):
+    """Host-side: move/reshard an array (async under the hood — jax
+    dispatches eagerly and the transfer overlaps host code)."""
+    import jax
+    return jax.device_put(x, sharding)

@@ -1,0 +1,92 @@
+# Sharding rules: logical tensor axes → mesh axes → NamedSharding.
+#
+# Models annotate parameters/activations with LOGICAL axis names
+# ("embed", "heads", "batch", ...); a ShardingRules table maps those to
+# physical mesh axes; XLA inserts the collectives.  This indirection is what
+# lets one model definition run DP-only on 1 chip, TP over 8, or DP×TP over
+# a pod without touching model code (scaling-book recipe; no reference
+# counterpart — the reference has no tensor path at all, SURVEY.md §2).
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .mesh import AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_SEQUENCE
+
+__all__ = ["ShardingRules", "DEFAULT_RULES", "named_sharding",
+           "shard_pytree", "constrain", "replicated"]
+
+
+@dataclass
+class ShardingRules:
+    """logical axis name → mesh axis name (or None = replicate)."""
+    rules: dict = field(default_factory=dict)
+
+    def spec(self, *logical_axes) -> "jax.sharding.PartitionSpec":
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(
+            *(self.rules.get(axis) for axis in logical_axes))
+
+    def with_overrides(self, **overrides) -> "ShardingRules":
+        merged = dict(self.rules)
+        merged.update(overrides)
+        return ShardingRules(merged)
+
+
+# The standard megatron-style layout:
+#   batch over data axis; attention heads + ffn hidden over model axis;
+#   embed/ffn-in replicated within a TP group; sequence over seq axis for
+#   context parallelism; experts over the expert axis.
+DEFAULT_RULES = ShardingRules({
+    "batch": AXIS_DATA,
+    "sequence": AXIS_SEQUENCE,
+    "heads": AXIS_MODEL,
+    "kv_heads": AXIS_MODEL,
+    "embed": None,
+    "head_dim": None,
+    "ffn": AXIS_MODEL,
+    "vocab": AXIS_MODEL,
+    "expert": AXIS_EXPERT,
+    "channels": None,
+})
+
+
+def named_sharding(mesh, *logical_axes, rules: ShardingRules = None):
+    from jax.sharding import NamedSharding
+    rules = rules or DEFAULT_RULES
+    spec = rules.spec(*logical_axes)
+    # drop mesh axes the mesh doesn't have (e.g. TP rules on a DP-only mesh)
+    from jax.sharding import PartitionSpec
+    cleaned = PartitionSpec(
+        *(axis if axis in mesh.axis_names else None for axis in spec))
+    return NamedSharding(mesh, cleaned)
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_pytree(tree, axes_tree, mesh, rules: ShardingRules = None):
+    """Place a parameter pytree onto the mesh.
+
+    axes_tree mirrors `tree`, each leaf a tuple of logical axis names (or
+    None = replicate).  Returns the tree with jax.device_put applied."""
+    import jax
+
+    def place(leaf, axes):
+        if axes is None:
+            return jax.device_put(leaf, replicated(mesh))
+        return jax.device_put(
+            leaf, named_sharding(mesh, *axes, rules=rules))
+
+    return jax.tree.map(place, tree, axes_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def constrain(x, mesh, *logical_axes, rules: ShardingRules = None):
+    """with_sharding_constraint under logical names (no-op off-mesh)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, *logical_axes, rules=rules))

@@ -4,11 +4,18 @@
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = \
         (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin force-sets jax_platforms at import time, clobbering
+# the env var — an explicit config.update after import is the only override
+# that sticks.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
