@@ -1,0 +1,20 @@
+# Model zoo: TPU-native implementations of the model families the
+# reference reaches through external CUDA/HTTP dependencies (SURVEY.md §2:
+# WhisperX ASR, ResNet-class vision, LLM agent).
+#
+# jax imports are deliberately NOT triggered by the package root —
+# `import aiko_services_tpu` stays control-plane-cheap; import
+# aiko_services_tpu.models explicitly for the compute plane.
+
+from .whisper import (                                      # noqa: F401
+    WHISPER_PRESETS, WhisperConfig, whisper_init, whisper_axes,
+    encode, decode_step, greedy_decode, forward,
+)
+from .resnet import (                                       # noqa: F401
+    RESNET_PRESETS, ResNetConfig, resnet_init, resnet_axes, resnet_forward,
+)
+from .llama import (                                        # noqa: F401
+    LLAMA_PRESETS, LlamaConfig, llama_init, llama_axes, llama_forward,
+    llama_decode_step, llama_greedy_decode, init_llama_caches,
+)
+from . import layers                                        # noqa: F401

@@ -1,0 +1,257 @@
+# Shared neural-net building blocks: functional jax, param pytrees, and
+# logical sharding axes.
+#
+# No reference counterpart — the reference wraps external CUDA models
+# (WhisperX: examples/speech/speech_elements.py:174-180; its framework code
+# contains no model math).  Style: every block is a pair of pure functions
+# (init(key, ...) -> params, apply(params, x, ...)) plus an axes() tree of
+# logical axis names consumed by parallel.shard_pytree, so any model built
+# from these blocks is sharding-annotated by construction.
+#
+# dtype policy: params live in float32 (or bfloat16 for serving), compute
+# runs in the dtype of the activations, matmul accumulation is always
+# float32 (preferred_element_type) — the MXU-native recipe.
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "linear_init", "linear", "linear_axes",
+    "layer_norm_init", "layer_norm", "layer_norm_axes",
+    "rms_norm_init", "rms_norm", "rms_norm_axes",
+    "embedding_init", "embedding", "embedding_axes",
+    "conv1d_init", "conv1d", "conv1d_axes",
+    "mha_init", "mha", "mha_axes", "init_kv_cache", "update_kv_cache",
+    "sinusoid_position_encoding", "gelu", "rope_frequencies", "apply_rope",
+]
+
+
+# -- linear ------------------------------------------------------------------
+
+def linear_init(key, in_dim: int, out_dim: int, bias: bool = True,
+                dtype=jnp.float32, scale: float | None = None):
+    scale = scale if scale is not None else 1.0 / math.sqrt(in_dim)
+    params = {"w": (jax.random.normal(key, (in_dim, out_dim)) *
+                    scale).astype(dtype)}
+    if bias:
+        params["b"] = jnp.zeros((out_dim,), dtype)
+    return params
+
+
+def linear(params, x):
+    y = jnp.einsum("...i,io->...o", x, params["w"],
+                   preferred_element_type=jnp.float32)
+    if "b" in params:
+        y = y + params["b"]
+    return y.astype(x.dtype)
+
+
+def linear_axes(in_axis: str, out_axis: str, bias: bool = True):
+    axes = {"w": (in_axis, out_axis)}
+    if bias:
+        axes["b"] = (out_axis,)
+    return axes
+
+
+# -- norms -------------------------------------------------------------------
+
+def layer_norm_init(dim: int, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,),
+                                                                dtype)}
+
+
+def layer_norm(params, x, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * params["scale"] + params["bias"]).astype(x.dtype)
+
+
+def layer_norm_axes():
+    return {"scale": ("embed",), "bias": ("embed",)}
+
+
+def rms_norm_init(dim: int, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype)}
+
+
+def rms_norm(params, x, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                            + eps)
+    return (y * params["scale"]).astype(x.dtype)
+
+
+def rms_norm_axes():
+    return {"scale": ("embed",)}
+
+
+# -- embedding ---------------------------------------------------------------
+
+def embedding_init(key, vocab: int, dim: int, dtype=jnp.float32):
+    return {"table": (jax.random.normal(key, (vocab, dim)) *
+                      0.02).astype(dtype)}
+
+
+def embedding(params, token_ids):
+    return jnp.take(params["table"], token_ids, axis=0)
+
+
+def embedding_axes():
+    return {"table": ("vocab", "embed")}
+
+
+# -- conv1d ------------------------------------------------------------------
+
+def conv1d_init(key, in_ch: int, out_ch: int, kernel: int,
+                dtype=jnp.float32):
+    scale = 1.0 / math.sqrt(in_ch * kernel)
+    return {"w": (jax.random.normal(key, (kernel, in_ch, out_ch)) *
+                  scale).astype(dtype),
+            "b": jnp.zeros((out_ch,), dtype)}
+
+
+def conv1d(params, x, stride: int = 1, padding: str = "SAME"):
+    """x: [B, T, C_in] → [B, T', C_out] (maps onto the MXU as a matmul
+    over the unrolled kernel window)."""
+    y = jax.lax.conv_general_dilated(
+        x, params["w"], window_strides=(stride,), padding=padding,
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        preferred_element_type=jnp.float32)
+    return (y + params["b"]).astype(x.dtype)
+
+
+def conv1d_axes():
+    return {"w": (None, None, "embed"), "b": ("embed",)}
+
+
+# -- attention ---------------------------------------------------------------
+
+def mha_init(key, dim: int, num_heads: int, num_kv_heads: int | None = None,
+             bias: bool = True, dtype=jnp.float32):
+    """Multi-head attention params.  num_kv_heads < num_heads = GQA."""
+    num_kv_heads = num_kv_heads or num_heads
+    head_dim = dim // num_heads
+    keys = jax.random.split(key, 4)
+    return {
+        "q": linear_init(keys[0], dim, num_heads * head_dim, bias, dtype),
+        "k": linear_init(keys[1], dim, num_kv_heads * head_dim, False,
+                         dtype),
+        "v": linear_init(keys[2], dim, num_kv_heads * head_dim, bias,
+                         dtype),
+        "o": linear_init(keys[3], num_heads * head_dim, dim, bias, dtype),
+    }
+
+
+def mha_axes(bias: bool = True):
+    return {
+        "q": linear_axes("embed", "heads", bias),
+        "k": linear_axes("embed", "kv_heads", False),
+        "v": linear_axes("embed", "kv_heads", bias),
+        "o": linear_axes("heads", "embed", bias),
+    }
+
+
+def _split_heads(x, num_heads):
+    b, t, _ = x.shape
+    return x.reshape(b, t, num_heads, -1).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+def init_kv_cache(batch: int, max_len: int, num_kv_heads: int,
+                  head_dim: int, dtype=jnp.float32):
+    """Static-shape KV cache: [B, H_kv, T_max, D] + write index."""
+    shape = (batch, num_kv_heads, max_len, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "index": jnp.zeros((), jnp.int32)}
+
+
+def update_kv_cache(cache, k_new, v_new):
+    """Write new K/V at the cache cursor (static shapes; donation-friendly
+    under jit so decode steps update in place on TPU)."""
+    index = cache["index"]
+    k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k_new, index,
+                                            axis=2)
+    v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v_new, index,
+                                            axis=2)
+    return {"k": k, "v": v, "index": index + k_new.shape[2]}
+
+
+def mha(params, x, kv_input=None, mask=None, cache=None,
+        num_heads: int = 8, num_kv_heads: int | None = None):
+    """Attention: self (kv_input None) or cross; optional KV cache.
+
+    mask: broadcastable to [B, H, Tq, Tk], True = attend.
+    Returns (output, new_cache)."""
+    num_kv_heads = num_kv_heads or num_heads
+    kv_input = x if kv_input is None else kv_input
+
+    q = _split_heads(linear(params["q"], x), num_heads)
+    k = _split_heads(linear(params["k"], kv_input), num_kv_heads)
+    v = _split_heads(linear(params["v"], kv_input), num_kv_heads)
+
+    if cache is not None:
+        cache = update_kv_cache(cache, k, v)
+        k, v = cache["k"], cache["v"]
+        # valid-position mask for the unwritten cache tail
+        valid = (jnp.arange(k.shape[2]) < cache["index"])[None, None, None]
+        mask = valid if mask is None else (mask & valid)
+
+    if num_kv_heads != num_heads:                  # GQA: repeat KV groups
+        repeat = num_heads // num_kv_heads
+        k = jnp.repeat(k, repeat, axis=1)
+        v = jnp.repeat(v, repeat, axis=1)
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return linear(params["o"], _merge_heads(out)), cache
+
+
+# -- positional encodings ----------------------------------------------------
+
+def sinusoid_position_encoding(length: int, dim: int,
+                               max_timescale: float = 10000.0):
+    """Whisper-style sinusoids: [length, dim]."""
+    half = dim // 2
+    log_increment = math.log(max_timescale) / max(half - 1, 1)
+    inv_timescales = jnp.exp(-log_increment * jnp.arange(half))
+    scaled = jnp.arange(length)[:, None] * inv_timescales[None, :]
+    return jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
+    """RoPE cos/sin tables: each [max_len, head_dim//2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2) / head_dim))
+    angles = jnp.arange(max_len)[:, None] * inv[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin, position_offset=0):
+    """x: [B, H, T, D]; rotates pairs (even, odd) by position angle."""
+    t = x.shape[2]
+    positions = position_offset + jnp.arange(t)
+    cos_t = jnp.take(cos, positions, axis=0)[None, None]   # [1,1,T,D/2]
+    sin_t = jnp.take(sin, positions, axis=0)[None, None]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos_t - x2 * sin_t,
+                         x1 * sin_t + x2 * cos_t], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)

@@ -1,0 +1,207 @@
+# Llama-style decoder-only transformer, TPU-native.
+#
+# Parity target: BASELINE.md config 5 ("xgo_robot vision+ASR+Llama-3-8B
+# agent sharded over v5e-16") — the reference only reaches an LLM through
+# an HTTP hop (reference: examples/speech/speech_elements.py:155-172); here
+# the model is native so the agent element shards over the mesh (TP on
+# heads/ffn via logical axes, GQA KV heads, RoPE, RMSNorm, SwiGLU).
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+__all__ = ["LlamaConfig", "llama_init", "llama_axes", "llama_forward",
+           "llama_decode_step", "llama_greedy_decode", "init_llama_caches",
+           "LLAMA_PRESETS"]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 128256
+    dim: int = 4096
+    ffn_dim: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    dtype: object = jnp.float32
+
+    @property
+    def head_dim(self):
+        return self.dim // self.num_heads
+
+
+LLAMA_PRESETS = {
+    # llama-3-8b geometry (the BASELINE agent config)
+    "8b": LlamaConfig(),
+    # scaled-down variants for tests / CI / single-chip smoke
+    "tiny": LlamaConfig(vocab=256, dim=64, ffn_dim=128, num_layers=2,
+                        num_heads=4, num_kv_heads=2, max_seq_len=128),
+    "1b": LlamaConfig(vocab=128256, dim=2048, ffn_dim=8192, num_layers=16,
+                      num_heads=32, num_kv_heads=8),
+}
+
+
+def _layer_init(key, config: LlamaConfig):
+    keys = jax.random.split(key, 4)
+    dim, dtype = config.dim, config.dtype
+    return {
+        "ln_attn": L.rms_norm_init(dim, dtype),
+        "attn": L.mha_init(keys[0], dim, config.num_heads,
+                           config.num_kv_heads, bias=False, dtype=dtype),
+        "ln_mlp": L.rms_norm_init(dim, dtype),
+        "gate": L.linear_init(keys[1], dim, config.ffn_dim, bias=False,
+                              dtype=dtype),
+        "up": L.linear_init(keys[2], dim, config.ffn_dim, bias=False,
+                            dtype=dtype),
+        "down": L.linear_init(keys[3], config.ffn_dim, dim, bias=False,
+                              dtype=dtype),
+    }
+
+
+def _layer_axes():
+    return {
+        "ln_attn": L.rms_norm_axes(),
+        "attn": L.mha_axes(bias=False),
+        "ln_mlp": L.rms_norm_axes(),
+        "gate": L.linear_axes("embed", "ffn", bias=False),
+        "up": L.linear_axes("embed", "ffn", bias=False),
+        "down": L.linear_axes("ffn", "embed", bias=False),
+    }
+
+
+def llama_init(key, config: LlamaConfig):
+    keys = jax.random.split(key, config.num_layers + 2)
+    return {
+        "embed": L.embedding_init(keys[0], config.vocab, config.dim,
+                                  config.dtype),
+        "layers": [_layer_init(keys[i + 1], config)
+                   for i in range(config.num_layers)],
+        "ln_out": L.rms_norm_init(config.dim, config.dtype),
+        "lm_head": L.linear_init(keys[-1], config.dim, config.vocab,
+                                 bias=False, dtype=config.dtype),
+    }
+
+
+def llama_axes(config: LlamaConfig):
+    return {
+        "embed": L.embedding_axes(),
+        "layers": [_layer_axes()] * config.num_layers,
+        "ln_out": L.rms_norm_axes(),
+        "lm_head": L.linear_axes("embed", "vocab", bias=False),
+    }
+
+
+def init_llama_caches(config: LlamaConfig, batch: int,
+                      max_len: int | None = None):
+    return [L.init_kv_cache(batch, max_len or config.max_seq_len,
+                            config.num_kv_heads, config.head_dim,
+                            config.dtype)
+            for _ in range(config.num_layers)]
+
+
+def _attention(layer, config: LlamaConfig, x, cos, sin, cache,
+               position_offset, mask):
+    """RoPE attention with GQA + KV cache (rotation applied pre-cache so
+    cached keys are already positioned)."""
+    import math as _math
+
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    b, t, _ = x.shape
+    q = L._split_heads(L.linear(layer["attn"]["q"], x), num_heads)
+    k = L._split_heads(L.linear(layer["attn"]["k"], x), num_kv)
+    v = L._split_heads(L.linear(layer["attn"]["v"], x), num_kv)
+    q = L.apply_rope(q, cos, sin, position_offset)
+    k = L.apply_rope(k, cos, sin, position_offset)
+
+    cache = L.update_kv_cache(cache, k, v)
+    k, v = cache["k"], cache["v"]
+    valid = (jnp.arange(k.shape[2]) < cache["index"])[None, None, None]
+    mask = valid if mask is None else (mask & valid)
+
+    repeat = num_heads // num_kv
+    if repeat > 1:
+        k = jnp.repeat(k, repeat, axis=1)
+        v = jnp.repeat(v, repeat, axis=1)
+
+    scale = 1.0 / _math.sqrt(config.head_dim)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return L.linear(layer["attn"]["o"], L._merge_heads(out)), cache
+
+
+def _swiglu(layer, x):
+    return L.linear(layer["down"],
+                    jax.nn.silu(L.linear(layer["gate"], x)) *
+                    L.linear(layer["up"], x))
+
+
+def llama_decode_step(params, config: LlamaConfig, tokens, caches,
+                      position_offset=0):
+    """tokens: [B, T] → (logits [B, T, vocab], new_caches).  T=1 for
+    incremental decode; T>1 prefills with an in-step causal mask."""
+    cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
+                                  config.rope_theta)
+    x = L.embedding(params["embed"], tokens).astype(config.dtype)
+    t = tokens.shape[1]
+
+    mask = None
+    if t > 1:
+        q_pos = position_offset + jnp.arange(t)[:, None]
+        k_pos = jnp.arange(caches[0]["k"].shape[2])[None, :]
+        mask = (k_pos <= q_pos)[None, None]
+
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        attn_out, cache = _attention(
+            layer, config, L.rms_norm(layer["ln_attn"], x), cos, sin,
+            cache, position_offset, mask)
+        x = x + attn_out
+        x = x + _swiglu(layer, L.rms_norm(layer["ln_mlp"], x))
+        new_caches.append(cache)
+    x = L.rms_norm(params["ln_out"], x)
+    logits = L.linear(params["lm_head"], x.astype(jnp.float32))
+    return logits, new_caches
+
+
+def llama_forward(params, config: LlamaConfig, tokens):
+    """Teacher-forced full-sequence forward: tokens [B, S] → logits."""
+    caches = init_llama_caches(config, tokens.shape[0], tokens.shape[1])
+    logits, _ = llama_decode_step(params, config, tokens, caches)
+    return logits
+
+
+def llama_greedy_decode(params, config: LlamaConfig, prompt,
+                        max_tokens: int = 32, eos_token: int | None = None):
+    """prompt: [B, S] → generated tokens [B, max_tokens].  One lax.scan,
+    static shapes, caches threaded through the carry."""
+    batch, prompt_len = prompt.shape
+    caches = init_llama_caches(config, batch, prompt_len + max_tokens)
+    logits, caches = llama_decode_step(params, config, prompt, caches)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    eos = eos_token if eos_token is not None else -1
+
+    def step(carry, position):
+        token, caches, done = carry
+        logits, caches = llama_decode_step(
+            params, config, token[:, None], caches,
+            position_offset=position)
+        next_token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        next_token = jnp.where(done, eos, next_token)
+        done = done | (next_token == eos)
+        return (next_token, caches, done), token
+
+    positions = prompt_len + jnp.arange(max_tokens)
+    (_, _, _), tokens = jax.lax.scan(
+        step, (first, caches, first == eos), positions)
+    return jnp.moveaxis(tokens, 0, 1)

@@ -1,0 +1,144 @@
+# ResNet: residual conv classifier, TPU-native.
+#
+# Parity target: BASELINE.md config 2 ("examples/pipeline: ResNet-18
+# image-classify PipelineElement") — the reference has no model code of its
+# own (SURVEY.md §2).  Inference-mode batchnorm (folded running stats);
+# NHWC layout (TPU-native); channels on the logical "channels" axis so a
+# mesh can shard large batches over data and keep convs MXU-tiled.
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ResNetConfig", "resnet_init", "resnet_axes", "resnet_forward",
+           "RESNET_PRESETS"]
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (2, 2, 2, 2)       # ResNet-18
+    num_classes: int = 1000
+    width: int = 64
+    dtype: object = jnp.float32
+
+
+RESNET_PRESETS = {
+    "resnet18": ResNetConfig((2, 2, 2, 2)),
+    "resnet34": ResNetConfig((3, 4, 6, 3)),
+}
+
+
+def _conv_init(key, kernel, in_ch, out_ch, dtype):
+    fan_in = kernel * kernel * in_ch
+    scale = math.sqrt(2.0 / fan_in)
+    return (jax.random.normal(key, (kernel, kernel, in_ch, out_ch)) *
+            scale).astype(dtype)
+
+
+def _bn_init(ch, dtype):
+    # inference-mode affine (scale/bias with folded running stats)
+    return {"scale": jnp.ones((ch,), dtype), "bias": jnp.zeros((ch,),
+                                                               dtype)}
+
+
+def _conv(w, x, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _bn(params, x):
+    return x * params["scale"] + params["bias"]
+
+
+def _basic_block_init(key, in_ch, out_ch, dtype):
+    keys = jax.random.split(key, 3)
+    params = {
+        "conv1": _conv_init(keys[0], 3, in_ch, out_ch, dtype),
+        "bn1": _bn_init(out_ch, dtype),
+        "conv2": _conv_init(keys[1], 3, out_ch, out_ch, dtype),
+        "bn2": _bn_init(out_ch, dtype),
+    }
+    if in_ch != out_ch:
+        params["proj"] = _conv_init(keys[2], 1, in_ch, out_ch, dtype)
+        params["bn_proj"] = _bn_init(out_ch, dtype)
+    return params
+
+
+def _basic_block(params, x, stride):
+    residual = x
+    y = jax.nn.relu(_bn(params["bn1"], _conv(params["conv1"], x, stride)))
+    y = _bn(params["bn2"], _conv(params["conv2"], y))
+    if "proj" in params:
+        residual = _bn(params["bn_proj"],
+                       _conv(params["proj"], x, stride))
+    return jax.nn.relu(y + residual)
+
+
+def resnet_init(key, config: ResNetConfig):
+    dtype = config.dtype
+    keys = jax.random.split(key, 2 + sum(config.stage_sizes))
+    k_iter = iter(keys)
+    params = {
+        "stem": _conv_init(next(k_iter), 7, 3, config.width, dtype),
+        "bn_stem": _bn_init(config.width, dtype),
+        "stages": [],
+    }
+    in_ch = config.width
+    for stage, blocks in enumerate(config.stage_sizes):
+        out_ch = config.width * (2 ** stage)
+        stage_params = []
+        for _ in range(blocks):
+            stage_params.append(
+                _basic_block_init(next(k_iter), in_ch, out_ch, dtype))
+            in_ch = out_ch
+        params["stages"].append(stage_params)
+    params["head"] = {
+        "w": (jax.random.normal(next(k_iter),
+                                (in_ch, config.num_classes)) *
+              (1.0 / math.sqrt(in_ch))).astype(dtype),
+        "b": jnp.zeros((config.num_classes,), dtype),
+    }
+    return params
+
+
+def _block_axes(params):
+    axes = {"conv1": (None, None, None, "channels"),
+            "bn1": {"scale": ("channels",), "bias": ("channels",)},
+            "conv2": (None, None, None, "channels"),
+            "bn2": {"scale": ("channels",), "bias": ("channels",)}}
+    if "proj" in params:
+        axes["proj"] = (None, None, None, "channels")
+        axes["bn_proj"] = {"scale": ("channels",), "bias": ("channels",)}
+    return axes
+
+
+def resnet_axes(params):
+    return {
+        "stem": (None, None, None, "channels"),
+        "bn_stem": {"scale": ("channels",), "bias": ("channels",)},
+        "stages": [[_block_axes(b) for b in stage]
+                   for stage in params["stages"]],
+        "head": {"w": ("channels", "vocab"), "b": ("vocab",)},
+    }
+
+
+def resnet_forward(params, config: ResNetConfig, images):
+    """images: [B, H, W, 3] → logits [B, num_classes]."""
+    x = images.astype(config.dtype)
+    x = jax.nn.relu(_bn(params["bn_stem"], _conv(params["stem"], x, 2)))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for stage, stage_params in enumerate(params["stages"]):
+        for i, block in enumerate(stage_params):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            x = _basic_block(block, x, stride)
+    x = jnp.mean(x, axis=(1, 2))                       # global avg pool
+    logits = x.astype(jnp.float32) @ params["head"]["w"].astype(
+        jnp.float32) + params["head"]["b"]
+    return logits

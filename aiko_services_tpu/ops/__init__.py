@@ -1,0 +1,9 @@
+# Compute ops: device-side kernels and host-side scheduling for the
+# inference data plane (SURVEY.md §7).  jax imports stay inside modules so
+# the control plane never pays for them.
+
+from .batching import (                                     # noqa: F401
+    BatchItem, BatchingScheduler, ShapeBuckets,
+)
+
+__all__ = ["BatchItem", "BatchingScheduler", "ShapeBuckets"]

@@ -1,0 +1,145 @@
+# Fused attention: pallas flash-attention kernel for TPU, XLA fallback
+# elsewhere.
+#
+# The hot op of every model in models/ (SURVEY.md §7 "hard parts": fused
+# streaming attention).  Flash algorithm: tile Q into VMEM blocks, stream
+# K/V blocks through, keep the online-softmax running max/normalizer in
+# f32 scratch — the S×S score matrix never touches HBM, so the op is
+# compute-bound on the MXU instead of bandwidth-bound.
+#
+# Block sizes honour the (8,128)/(16,128) tiling floors
+# (/opt/skills/guides/pallas_guide.md "Tiling Constraints").
+
+from __future__ import annotations
+
+import functools
+import math
+
+__all__ = ["flash_attention", "attention"]
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
+                  acc_scratch, *, causal: bool, scale: float,
+                  block_q: int, block_k: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(2)            # grid: (batch*heads, 1, q_blocks)
+    k_idx = pl.program_id(3)
+    k_blocks = pl.num_programs(3)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def compute():
+        q = q_ref[0]                    # [block_q, d]
+        k = k_ref[0]                    # [block_k, d]
+        v = v_ref[0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1)
+            scores = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
+
+        m_prev = m_scratch[:]                       # [bq, 1]
+        block_max = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - m_safe)
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        correction = jnp.where(jnp.isneginf(m_prev), 0.0,
+                               jnp.exp(m_prev - m_safe))
+        m_scratch[:] = m_new
+        l_scratch[:] = l_scratch[:] * correction + \
+            jnp.sum(p, axis=-1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * correction + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip fully-masked K blocks (block strictly above the diagonal)
+        @pl.when(k_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(k_idx == k_blocks - 1)
+    def _finish():
+        l = l_scratch[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: float | None = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused attention.  q,k,v: [B, H, S, D] → [B, H, S, D].
+
+    interpret=None auto-selects: compiled pallas on TPU, interpreter mode
+    elsewhere (CPU tests run the same kernel code path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"sequence {s} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, s, d)
+    vf = v.reshape(bh, s, d)
+
+    grid = (bh, 1, s // block_q, s // block_k)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, _, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, _, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, _, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, _, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+def attention(q, k, v, causal: bool = False, scale: float | None = None):
+    """Dispatch: pallas flash kernel on TPU when shapes tile cleanly,
+    plain XLA attention otherwise (XLA fuses well for small shapes)."""
+    import jax
+
+    s, d = q.shape[2], q.shape[3]
+    if jax.default_backend() == "tpu" and s >= 256 and s % 128 == 0 \
+            and d % 128 == 0:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    from ..parallel.ring_attention import attention_reference
+    return attention_reference(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,81 @@
+# Audio DSP ops: log-mel spectrogram frontend, on-device.
+#
+# Replaces the host-side librosa/torch feature extraction the reference's
+# ASR element delegates to faster-whisper (reference: examples/speech/
+# speech_elements.py:217-250).  Computing the mel frontend in jax keeps the
+# microphone→features→encoder path on-device: one jit, no host round-trip
+# between framing and the encoder (SURVEY.md §7 "host↔device I/O overlap").
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mel_filterbank", "log_mel_spectrogram", "stft",
+           "WHISPER_SAMPLE_RATE", "WHISPER_N_FFT", "WHISPER_HOP"]
+
+WHISPER_SAMPLE_RATE = 16000
+WHISPER_N_FFT = 400
+WHISPER_HOP = 160
+
+
+def _hz_to_mel(hz):
+    return 2595.0 * math.log10(1.0 + hz / 700.0)
+
+
+@functools.lru_cache(maxsize=8)
+def mel_filterbank(num_mels: int = 80, n_fft: int = WHISPER_N_FFT,
+                   sample_rate: int = WHISPER_SAMPLE_RATE,
+                   fmin: float = 0.0, fmax: float | None = None):
+    """Slaney-style triangular mel filterbank: [n_fft//2+1, num_mels]."""
+    fmax = fmax if fmax is not None else sample_rate / 2.0
+    num_bins = n_fft // 2 + 1
+    fft_freqs = jnp.linspace(0.0, sample_rate / 2.0, num_bins)
+    mel_points = jnp.linspace(_hz_to_mel(fmin), _hz_to_mel(fmax),
+                              num_mels + 2)
+    hz_points = 700.0 * (10.0 ** (mel_points / 2595.0) - 1.0)
+
+    lower = hz_points[:-2][None, :]
+    centre = hz_points[1:-1][None, :]
+    upper = hz_points[2:][None, :]
+    freqs = fft_freqs[:, None]
+    up_slope = (freqs - lower) / jnp.maximum(centre - lower, 1e-10)
+    down_slope = (upper - freqs) / jnp.maximum(upper - centre, 1e-10)
+    weights = jnp.maximum(0.0, jnp.minimum(up_slope, down_slope))
+    # Slaney area normalization
+    enorm = 2.0 / (hz_points[2:] - hz_points[:-2])
+    return weights * enorm[None, :]
+
+
+def stft(audio, n_fft: int = WHISPER_N_FFT, hop: int = WHISPER_HOP):
+    """audio: [B, T_samples] → magnitude² [B, T_frames, n_fft//2+1].
+    Hann window, centred (reflect padding), matching whisper's frontend."""
+    pad = n_fft // 2
+    audio = jnp.pad(audio, ((0, 0), (pad, pad)), mode="reflect")
+    num_frames = 1 + (audio.shape[1] - n_fft) // hop
+    # frame extraction as a strided gather → [B, frames, n_fft]
+    idx = (jnp.arange(num_frames)[:, None] * hop +
+           jnp.arange(n_fft)[None, :])
+    frames = audio[:, idx]
+    window = jnp.hanning(n_fft + 1)[:-1].astype(audio.dtype)
+    spectrum = jnp.fft.rfft(frames * window, axis=-1)
+    return jnp.abs(spectrum) ** 2
+
+
+def log_mel_spectrogram(audio, num_mels: int = 80,
+                        n_fft: int = WHISPER_N_FFT,
+                        hop: int = WHISPER_HOP,
+                        sample_rate: int = WHISPER_SAMPLE_RATE):
+    """audio: [B, T_samples] float in [-1, 1] → log-mel [B, T_frames, mels]
+    (whisper normalization: log10, clamp to max-8, scale to ~[-1, 1])."""
+    power = stft(audio.astype(jnp.float32), n_fft, hop)
+    power = power[:, :-1]         # whisper drops the final frame
+    mels = power @ mel_filterbank(num_mels, n_fft, sample_rate)
+    log_spec = jnp.log10(jnp.maximum(mels, 1e-10))
+    log_spec = jnp.maximum(log_spec,
+                           jnp.max(log_spec, axis=(1, 2),
+                                   keepdims=True) - 8.0)
+    return (log_spec + 4.0) / 4.0

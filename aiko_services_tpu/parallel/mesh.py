@@ -77,12 +77,17 @@ def create_mesh(axes: dict | MeshSpec | None = None, devices=None):
         axes = axes.resolve(len(devices))
     elif isinstance(axes, dict):
         axes = MeshSpec(dict(axes)).resolve(len(devices))
+    # Auto axis types: shardings propagate from annotations
+    # (with_sharding_constraint) rather than the explicit-sharding type
+    # system — the classic pjit programming model
+    auto = (jax.sharding.AxisType.Auto,) * len(axes)
     return jax.make_mesh(tuple(axes.values()), tuple(axes.keys()),
-                         devices=devices)
+                         devices=devices, axis_types=auto)
 
 
 def single_device_mesh(axis: str = AXIS_DATA):
     """1×1 mesh: lets single-chip code paths share the sharded code path."""
     import jax
 
-    return jax.make_mesh((1,), (axis,), devices=jax.devices()[:1])
+    return jax.make_mesh((1,), (axis,), devices=jax.devices()[:1],
+                         axis_types=(jax.sharding.AxisType.Auto,))

@@ -1,0 +1,94 @@
+# Sharded training step: the SPMD "one step" the whole framework hangs off.
+#
+# No reference counterpart (the reference is inference-only glue; SURVEY.md
+# §2).  Recipe (scaling book): place params on the mesh via their logical
+# axes, shard the batch over the data axis, jit the whole
+# loss→grad→optimizer update — XLA inserts the gradient psums over the data
+# axis and the TP collectives over the model axis from the shardings alone.
+
+from __future__ import annotations
+
+import functools
+
+from .sharding import named_sharding, replicated, shard_pytree
+
+__all__ = ["make_train_step", "cross_entropy_loss", "TrainState",
+           "init_train_state"]
+
+
+class TrainState:
+    """Minimal train state: params + optimizer state + step counter."""
+
+    def __init__(self, params, opt_state, step=0):
+        self.params = params
+        self.opt_state = opt_state
+        self.step = step
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+def _register():
+    import jax
+    try:
+        jax.tree_util.register_pytree_node(
+            TrainState, lambda s: s.tree_flatten(),
+            TrainState.tree_unflatten)
+    except ValueError:
+        pass        # already registered
+
+
+_register()
+
+
+def cross_entropy_loss(logits, targets, mask=None):
+    """logits [B,S,V] float32, targets [B,S] int32."""
+    import jax
+    import jax.numpy as jnp
+
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets[..., None],
+                               axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def init_train_state(params, optimizer, mesh=None, param_axes=None,
+                     rules=None):
+    """Build a TrainState; with a mesh + axes tree the params (and the
+    optimizer state, which mirrors the param tree) are placed sharded."""
+    if mesh is not None and param_axes is not None:
+        params = shard_pytree(params, param_axes, mesh, rules)
+    opt_state = optimizer.init(params)
+    return TrainState(params, opt_state)
+
+
+def make_train_step(loss_fn, optimizer, mesh=None, batch_axes=("batch",),
+                    rules=None, donate: bool = True):
+    """Compile a full train step.
+
+    loss_fn(params, batch) -> scalar loss.  Returns
+    step(state, batch) -> (state, loss), jitted; with a mesh, the batch is
+    constrained onto the data axis and state donation keeps params
+    in-place on device."""
+    import jax
+
+    def train_step(state, batch):
+        if mesh is not None:
+            batch = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, named_sharding(mesh, *batch_axes[:x.ndim],
+                                      rules=rules)),
+                batch)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,72 @@
+# Benchmark: Whisper-small streaming ASR throughput on one chip.
+#
+# The BASELINE.md headline metric is "speech pipeline real-time-factor":
+# how many concurrent real-time audio streams one chip sustains.  The
+# reference wraps faster-whisper on CUDA, single stream, tensors
+# serialized through an MQTT broker (reference: examples/speech/
+# speech_elements.py:174-250); it publishes no numbers, so the implied
+# baseline is 1.0 (one real-time stream — what its pipeline sustains by
+# construction, SURVEY.md §6).
+#
+# Measures: batched greedy decode (encoder + KV-cache token scan) over a
+# batch of CHUNK_SECONDS-second utterances in bfloat16 on the flagship
+# Whisper-small geometry.  streams = audio-seconds decoded per wall-second.
+#
+# Prints ONE JSON line:
+#   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from aiko_services_tpu.models import WhisperConfig, whisper_init
+from aiko_services_tpu.models.whisper import greedy_decode
+
+CHUNK_SECONDS = 5.0           # streaming chunk size (audio_io.py-style)
+FRAMES_PER_SECOND = 100       # whisper log-mel frame rate
+BATCH = 32                    # concurrent streams per device step
+MAX_TOKENS = 24               # tokens decoded per 5 s chunk (typical speech)
+REPEATS = 5
+
+
+def main() -> None:
+    frames = int(CHUNK_SECONDS * FRAMES_PER_SECOND)
+    config = WhisperConfig(dim=768, num_heads=12, enc_layers=12,
+                           dec_layers=12, n_audio_ctx=frames // 2,
+                           n_text_ctx=MAX_TOKENS + 8, dtype=jnp.bfloat16)
+    params = whisper_init(jax.random.PRNGKey(0), config)
+    mel = jax.random.normal(jax.random.PRNGKey(1),
+                            (BATCH, frames, config.n_mels), jnp.bfloat16)
+
+    decode = jax.jit(lambda params, mel: greedy_decode(
+        params, config, mel, max_tokens=MAX_TOKENS))
+
+    tokens, lengths = decode(params, mel)     # compile + warmup
+    np.asarray(tokens)
+
+    # hard sync each iteration via host transfer: block_until_ready does
+    # not reliably synchronize through the remote-TPU tunnel
+    start = time.perf_counter()
+    for _ in range(REPEATS):
+        tokens, lengths = decode(params, mel)
+        np.asarray(tokens)
+    elapsed = (time.perf_counter() - start) / REPEATS
+
+    audio_seconds = BATCH * CHUNK_SECONDS
+    streams = audio_seconds / elapsed         # concurrent real-time streams
+    print(json.dumps({
+        "metric": "whisper_small_concurrent_realtime_streams_per_chip",
+        "value": round(streams, 2),
+        "unit": "streams",
+        "vs_baseline": round(streams / 1.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

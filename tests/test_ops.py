@@ -1,0 +1,179 @@
+# ops/ tests: audio frontend correctness, batching scheduler latency and
+# bucketing contracts.
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aiko_services_tpu.ops.audio import (
+    log_mel_spectrogram, mel_filterbank, stft)
+from aiko_services_tpu.ops.batching import (
+    BatchingScheduler, ShapeBuckets)
+
+
+# -- audio -------------------------------------------------------------------
+
+def test_mel_filterbank_shape_and_coverage():
+    fb = mel_filterbank(80)
+    assert fb.shape == (201, 80)
+    # every mel filter has some support
+    assert bool(jnp.all(jnp.sum(fb, axis=0) > 0))
+
+
+def test_stft_detects_tone():
+    """A pure 1 kHz tone concentrates energy in the right FFT bin."""
+    sr, n_fft, hop = 16000, 400, 160
+    t = jnp.arange(sr, dtype=jnp.float32) / sr          # 1 s
+    audio = jnp.sin(2 * jnp.pi * 1000.0 * t)[None]
+    power = stft(audio, n_fft, hop)
+    bin_hz = sr / n_fft                                  # 40 Hz per bin
+    peak_bins = jnp.argmax(power, axis=-1)
+    expected = round(1000.0 / bin_hz)
+    assert bool(jnp.all(jnp.abs(peak_bins - expected) <= 1))
+
+
+def test_log_mel_whisper_shapes():
+    audio = jnp.zeros((2, 16000))                        # 1 s
+    mel = log_mel_spectrogram(audio)
+    assert mel.shape == (2, 100, 80)                     # 100 frames/s
+    assert bool(jnp.all(jnp.isfinite(mel)))
+
+
+def test_log_mel_jits():
+    fn = jax.jit(log_mel_spectrogram)
+    out = fn(jnp.ones((1, 8000)))
+    assert out.shape == (1, 50, 80)
+
+
+# -- batching ----------------------------------------------------------------
+
+def test_shape_buckets():
+    buckets = ShapeBuckets([100, 500, 1500])
+    assert buckets.bucket_for(1) == 100
+    assert buckets.bucket_for(100) == 100
+    assert buckets.bucket_for(101) == 500
+    with pytest.raises(ValueError):
+        buckets.bucket_for(2000)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_batch_dispatches_when_full():
+    clock = FakeClock()
+    calls = []
+
+    def process(bucket, items):
+        calls.append((bucket, len(items)))
+        return [i.payload * 2 for i in items]
+
+    results = {}
+    sched = BatchingScheduler(process, ShapeBuckets([100]), max_batch=4,
+                              max_wait=1.0, clock=clock)
+    for i in range(4):
+        sched.submit(f"s{i}", i, 50, lambda sid, r: results.__setitem__(
+            sid, r))
+    assert sched.drain() == 4                  # full batch: no wait needed
+    assert calls == [(100, 4)]
+    assert results == {"s0": 0, "s1": 2, "s2": 4, "s3": 6}
+
+
+def test_partial_batch_waits_then_dispatches():
+    clock = FakeClock()
+    calls = []
+    sched = BatchingScheduler(
+        lambda b, items: [None] * len(items), ShapeBuckets([100]),
+        max_batch=8, max_wait=0.05, clock=clock)
+    sched.submit("s0", 0, 10, lambda *_: calls.append("done"))
+    assert sched.drain() == 0                  # not full, not old enough
+    clock.now = 0.06
+    assert sched.drain() == 1                  # max_wait exceeded
+    assert calls == ["done"]
+
+
+def test_buckets_batch_independently():
+    clock = FakeClock()
+    seen = []
+    sched = BatchingScheduler(
+        lambda b, items: seen.append((b, len(items))) or
+        [None] * len(items),
+        ShapeBuckets([100, 500]), max_batch=2, max_wait=1.0, clock=clock)
+    sched.submit("a", 0, 50, lambda *_: None)
+    sched.submit("b", 0, 400, lambda *_: None)
+    sched.submit("c", 0, 60, lambda *_: None)
+    sched.drain()                              # bucket 100 is full (a, c)
+    assert seen == [(100, 2)]
+    sched.drain(force=True)                    # flush bucket 500
+    assert seen == [(100, 2), (500, 1)]
+
+
+def test_next_deadline_tracks_oldest():
+    clock = FakeClock()
+    sched = BatchingScheduler(lambda b, i: [None] * len(i),
+                              ShapeBuckets([100]), max_batch=8,
+                              max_wait=0.05, clock=clock)
+    assert sched.next_deadline() is None
+    sched.submit("s", 0, 10, lambda *_: None)
+    assert sched.next_deadline() == pytest.approx(0.05)
+
+
+def test_stats_track_batches():
+    clock = FakeClock()
+    sched = BatchingScheduler(lambda b, i: [None] * len(i),
+                              ShapeBuckets([100]), max_batch=2,
+                              max_wait=1.0, clock=clock)
+    for i in range(4):
+        sched.submit(f"s{i}", 0, 10, lambda *_: None)
+    sched.drain()
+    assert sched.stats["batches"] == 2
+    assert sched.mean_batch_size() == 2.0
+    assert sched.stats["full_batches"] == 2
+
+
+def test_scheduler_on_event_engine():
+    """Integration: the scheduler drains off an EventEngine timer."""
+    from aiko_services_tpu.event import EventEngine, VirtualClock
+    engine = EventEngine(VirtualClock())
+    clock = engine.clock
+    done = []
+    sched = BatchingScheduler(
+        lambda b, items: [i.payload + 1 for i in items],
+        ShapeBuckets([100]), max_batch=16, max_wait=0.02,
+        clock=clock.now)
+    sched.attach(engine, period=0.005)
+    sched.submit("s0", 41, 10, lambda sid, r: done.append(r))
+    for _ in range(10):
+        clock.advance(0.005)
+        engine.step()
+    assert done == [42]
+
+
+# -- flash attention ---------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    from aiko_services_tpu.ops.attention import flash_attention
+    from aiko_services_tpu.parallel import attention_reference
+    b, h, s, d = 2, 3, 128, 32
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(key, (b, h, s, d), jnp.float32)
+               for key in keys)
+    expected = attention_reference(q, k, v, causal=causal)
+    result = flash_attention(q, k, v, causal=causal, block_q=64,
+                             block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(result), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_rejects_ragged_blocks():
+    from aiko_services_tpu.ops.attention import flash_attention
+    q = jnp.ones((1, 1, 100, 16))
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
