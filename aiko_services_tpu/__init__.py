@@ -23,6 +23,14 @@ from .actor import (                                        # noqa: F401
     get_remote_proxy,
 )
 from .registrar import Registrar                            # noqa: F401
+from .process_manager import ProcessManager                 # noqa: F401
+from .lifecycle import (                                    # noqa: F401
+    LifeCycleClient, LifeCycleManager,
+)
+from .recorder import Recorder                              # noqa: F401
+from .storage import (                                      # noqa: F401
+    ResponseCollector, Storage, do_command, do_request,
+)
 from .transport import (                                    # noqa: F401
     MemoryBroker, MemoryMessage, Message, MQTT_AVAILABLE, default_broker,
     topic_matches,

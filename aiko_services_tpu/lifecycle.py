@@ -1,0 +1,178 @@
+# LifeCycleManager / LifeCycleClient: spawn a fleet of worker processes and
+# track their health.
+#
+# Capability parity with the reference lifecycle subsystem
+# (reference: aiko_services/lifecycle.py:98-288, :355-388):
+#   * the manager spawns N clients (via a spawner callable — OS processes
+#     through ProcessManager, or in-process runtimes in tests/TPU pools);
+#   * each client calls back `(add_client topic_path id)` on the manager's
+#     control topic within a handshake lease (30 s default);
+#   * the manager EC-consumes each client's share to watch its lifecycle
+#     state, and purges clients the registrar reports gone;
+#   * deletion leases force-kill stragglers.
+#
+# TPU-native addition: the same manager places *device workloads* — a
+# client's "process" may be a TPU slice runtime rather than an OS process
+# (SURVEY.md §2: elastic scheduling → device/slice placement).
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .actor import Actor
+from .lease import Lease
+from .service import ServiceProtocol
+from .share import ECConsumer
+from .utils import get_logger
+
+__all__ = ["LifeCycleManager", "LifeCycleClient",
+           "PROTOCOL_LIFECYCLE_MANAGER", "PROTOCOL_LIFECYCLE_CLIENT"]
+
+PROTOCOL_LIFECYCLE_MANAGER = ServiceProtocol("lifecycle_manager")
+PROTOCOL_LIFECYCLE_CLIENT = ServiceProtocol("lifecycle_client")
+_HANDSHAKE_LEASE = 30.0     # seconds (reference: lifecycle.py:74)
+_DELETION_LEASE = 30.0      # seconds (reference: lifecycle.py:75)
+
+
+@dataclass
+class _ClientRecord:
+    client_id: str
+    topic_path: str = ""
+    state: str = "spawned"          # spawned | ready | deleting | gone
+    lease: Lease | None = None
+    consumer: ECConsumer | None = None
+    share: dict = field(default_factory=dict)
+
+
+class LifeCycleManager(Actor):
+    """Spawns clients via `spawner(client_id, manager_topic_path)` and
+    tracks them.  spawner returns an opaque handle passed to
+    `terminator(client_id, handle)` on deletion (both injectable: OS
+    processes, in-process runtimes, TPU slice allocations)."""
+
+    def __init__(self, runtime, name: str, spawner, terminator=None,
+                 client_change_handler=None,
+                 handshake_lease_time: float = _HANDSHAKE_LEASE):
+        super().__init__(runtime, name, PROTOCOL_LIFECYCLE_MANAGER)
+        self.logger = get_logger(f"lifecycle_manager.{name}")
+        self.spawner = spawner
+        self.terminator = terminator
+        self.client_change_handler = client_change_handler
+        self.handshake_lease_time = handshake_lease_time
+        self.clients: dict[str, _ClientRecord] = {}
+        self._handles: dict[str, object] = {}
+        self._counter = 0
+        runtime.add_message_handler(self._control_handler,
+                                    self.topic_control)
+        self.ec_producer.update("client_count", 0)
+
+    # -- spawning ----------------------------------------------------------
+    def create_clients(self, count: int) -> list[str]:
+        ids = []
+        for _ in range(count):
+            client_id = str(self._counter)
+            self._counter += 1
+            record = _ClientRecord(client_id)
+            record.lease = Lease(
+                self.runtime.event, self.handshake_lease_time, client_id,
+                lease_expired_handler=self._handshake_expired)
+            self.clients[client_id] = record
+            self._handles[client_id] = self.spawner(client_id,
+                                                    self.topic_path)
+            ids.append(client_id)
+        self._publish_count()
+        return ids
+
+    def _handshake_expired(self, client_id) -> None:
+        record = self.clients.get(str(client_id))
+        if record and record.state == "spawned":
+            self.logger.warning("client %s missed handshake; deleting",
+                                client_id)
+            self.delete_client(str(client_id))
+
+    # -- protocol ----------------------------------------------------------
+    def _control_handler(self, _topic, payload) -> None:
+        from .utils import parse
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command == "add_client" and len(params) >= 2:
+            self._add_client(params[0], str(params[1]))
+
+    def _add_client(self, topic_path: str, client_id: str) -> None:
+        record = self.clients.get(client_id)
+        if record is None or record.state != "spawned":
+            return
+        record.topic_path = topic_path
+        record.state = "ready"
+        if record.lease:
+            record.lease.terminate()
+            record.lease = None
+        # mirror the client's share (lifecycle state etc.)
+        record.consumer = ECConsumer(
+            self.runtime, record.share, f"{topic_path}/control")
+        self.logger.info("client %s ready at %s", client_id, topic_path)
+        if self.client_change_handler:
+            self.client_change_handler("add", client_id, record)
+        self._publish_count()
+
+    # -- deletion ----------------------------------------------------------
+    def delete_client(self, client_id: str) -> None:
+        record = self.clients.pop(str(client_id), None)
+        if record is None:
+            return
+        record.state = "deleting"
+        if record.lease:
+            record.lease.terminate()
+        if record.consumer:
+            record.consumer.terminate()
+        if record.topic_path:
+            # polite ask first; the deletion lease force-kills stragglers
+            self.runtime.publish(f"{record.topic_path}/in",
+                                 "(control_stop)")
+        handle = self._handles.pop(str(client_id), None)
+        if self.terminator:
+            Lease(self.runtime.event, _DELETION_LEASE, client_id,
+                  lease_expired_handler=lambda cid, h=handle:
+                      self.terminator(str(cid), h))
+        if self.client_change_handler:
+            self.client_change_handler("remove", str(client_id), record)
+        self._publish_count()
+
+    def delete_all(self) -> None:
+        for client_id in list(self.clients):
+            self.delete_client(client_id)
+
+    def ready_count(self) -> int:
+        return sum(1 for r in self.clients.values() if r.state == "ready")
+
+    def _publish_count(self) -> None:
+        self.ec_producer.update("client_count", len(self.clients))
+
+    def stop(self) -> None:
+        for record in self.clients.values():
+            if record.lease:
+                record.lease.terminate()
+            if record.consumer:
+                record.consumer.terminate()
+        self.runtime.remove_message_handler(self._control_handler,
+                                            self.topic_control)
+        super().stop()
+
+
+class LifeCycleClient(Actor):
+    """Worker-side half: announces itself to the manager's control topic
+    on creation (reference: lifecycle.py:355-388)."""
+
+    def __init__(self, runtime, name: str, manager_topic_path: str,
+                 client_id: str, protocol=None):
+        super().__init__(runtime, name,
+                         protocol or PROTOCOL_LIFECYCLE_CLIENT)
+        self.client_id = client_id
+        self.manager_topic_path = manager_topic_path
+        self.ec_producer.update("client_id", client_id)
+        from .utils import generate
+        runtime.publish(f"{manager_topic_path}/control",
+                        generate("add_client",
+                                 [self.topic_path, client_id]))

@@ -25,7 +25,8 @@ __all__ = [
     "rms_norm_init", "rms_norm", "rms_norm_axes",
     "embedding_init", "embedding", "embedding_axes",
     "conv1d_init", "conv1d", "conv1d_axes",
-    "mha_init", "mha", "mha_axes", "init_kv_cache", "update_kv_cache",
+    "mha_init", "mha", "mha_axes", "precompute_kv", "init_kv_cache",
+    "update_kv_cache",
     "sinusoid_position_encoding", "gelu", "rope_frequencies", "apply_rope",
 ]
 
@@ -186,18 +187,34 @@ def update_kv_cache(cache, k_new, v_new):
     return {"k": k, "v": v, "index": index + k_new.shape[2]}
 
 
-def mha(params, x, kv_input=None, mask=None, cache=None,
-        num_heads: int = 8, num_kv_heads: int | None = None):
-    """Attention: self (kv_input None) or cross; optional KV cache.
-
-    mask: broadcastable to [B, H, Tq, Tk], True = attend.
-    Returns (output, new_cache)."""
-    num_kv_heads = num_kv_heads or num_heads
-    kv_input = x if kv_input is None else kv_input
-
-    q = _split_heads(linear(params["q"], x), num_heads)
+def precompute_kv(params, kv_input, num_kv_heads: int):
+    """Project K/V once for reuse across many queries (e.g. encoder output
+    attended by every decode step).  Returns (k, v): [B, H_kv, T, D]."""
     k = _split_heads(linear(params["k"], kv_input), num_kv_heads)
     v = _split_heads(linear(params["v"], kv_input), num_kv_heads)
+    return k, v
+
+
+def mha(params, x, kv_input=None, mask=None, cache=None,
+        num_heads: int = 8, num_kv_heads: int | None = None,
+        qk_transform=None, precomputed_kv=None, fused: bool = True):
+    """Attention: self (kv_input None), cross (kv_input or precomputed_kv),
+    optional KV cache.
+
+    mask: broadcastable to [B, H, Tq, Tk], True = attend.
+    qk_transform(q, k) -> (q, k): applied after head split, before the
+    cache write (RoPE hook — cached keys are stored already-positioned).
+    precomputed_kv: (k, v) already projected+split (cross-attention cache).
+    Returns (output, new_cache)."""
+    num_kv_heads = num_kv_heads or num_heads
+    q = _split_heads(linear(params["q"], x), num_heads)
+    if precomputed_kv is not None:
+        k, v = precomputed_kv
+    else:
+        k, v = precompute_kv(params, x if kv_input is None else kv_input,
+                             num_kv_heads)
+    if qk_transform is not None:
+        q, k = qk_transform(q, k)
 
     if cache is not None:
         cache = update_kv_cache(cache, k, v)
@@ -210,6 +227,14 @@ def mha(params, x, kv_input=None, mask=None, cache=None,
         repeat = num_heads // num_kv_heads
         k = jnp.repeat(k, repeat, axis=1)
         v = jnp.repeat(v, repeat, axis=1)
+
+    if fused and mask is None and cache is None and \
+            q.shape[2] == k.shape[2]:
+        # mask-free self/cross attention: fused flash path (pallas on TPU
+        # when shapes tile, XLA otherwise)
+        from ..ops.attention import attention
+        out = attention(q, k, v)
+        return linear(params["o"], _merge_heads(out)), cache
 
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,

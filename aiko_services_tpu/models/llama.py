@@ -108,36 +108,16 @@ def init_llama_caches(config: LlamaConfig, batch: int,
 
 def _attention(layer, config: LlamaConfig, x, cos, sin, cache,
                position_offset, mask):
-    """RoPE attention with GQA + KV cache (rotation applied pre-cache so
-    cached keys are already positioned)."""
-    import math as _math
+    """RoPE attention with GQA + KV cache: layers.mha with the rotation
+    injected via qk_transform, so cached keys are stored
+    already-positioned."""
+    def rope(q, k):
+        return (L.apply_rope(q, cos, sin, position_offset),
+                L.apply_rope(k, cos, sin, position_offset))
 
-    num_heads, num_kv = config.num_heads, config.num_kv_heads
-    b, t, _ = x.shape
-    q = L._split_heads(L.linear(layer["attn"]["q"], x), num_heads)
-    k = L._split_heads(L.linear(layer["attn"]["k"], x), num_kv)
-    v = L._split_heads(L.linear(layer["attn"]["v"], x), num_kv)
-    q = L.apply_rope(q, cos, sin, position_offset)
-    k = L.apply_rope(k, cos, sin, position_offset)
-
-    cache = L.update_kv_cache(cache, k, v)
-    k, v = cache["k"], cache["v"]
-    valid = (jnp.arange(k.shape[2]) < cache["index"])[None, None, None]
-    mask = valid if mask is None else (mask & valid)
-
-    repeat = num_heads // num_kv
-    if repeat > 1:
-        k = jnp.repeat(k, repeat, axis=1)
-        v = jnp.repeat(v, repeat, axis=1)
-
-    scale = 1.0 / _math.sqrt(config.head_dim)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(mask, scores, -1e30)
-    weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v,
-                     preferred_element_type=jnp.float32).astype(x.dtype)
-    return L.linear(layer["attn"]["o"], L._merge_heads(out)), cache
+    return L.mha(layer["attn"], x, mask=mask, cache=cache,
+                 num_heads=config.num_heads,
+                 num_kv_heads=config.num_kv_heads, qk_transform=rope)
 
 
 def _swiglu(layer, x):

@@ -113,9 +113,8 @@ def whisper_init(key, config: WhisperConfig):
         "pos_embed": (jax.random.normal(
             next(k_iter), (config.n_text_ctx, config.dim)) * 0.01
             ).astype(dtype),
-        "dec_blocks": [_block_init(jax.random.fold_in(key, 1000 + i),
-                                   config, cross=True)
-                       for i in range(config.dec_layers)],
+        "dec_blocks": [_block_init(next(k_iter), config, cross=True)
+                       for _ in range(config.dec_layers)],
         "ln_dec": L.layer_norm_init(config.dim, dtype),
     }
 
@@ -157,16 +156,24 @@ def encode(params, config: WhisperConfig, mel):
     return L.layer_norm(params["ln_enc"], x)
 
 
-def _decoder_block(block, x, audio, num_heads, self_cache, mask):
+def _decoder_block(block, x, cross_kv, num_heads, self_cache, mask):
     attn_out, self_cache = L.mha(
         block["attn"], L.layer_norm(block["ln_attn"], x),
         cache=self_cache, mask=mask, num_heads=num_heads)
     x = x + attn_out
     cross_out, _ = L.mha(block["cross"],
                          L.layer_norm(block["ln_cross"], x),
-                         kv_input=audio, num_heads=num_heads)
+                         precomputed_kv=cross_kv, num_heads=num_heads)
     x = x + cross_out
     return x + _mlp(block, L.layer_norm(block["ln_mlp"], x)), self_cache
+
+
+def precompute_cross_kv(params, config: WhisperConfig, audio):
+    """Project every decoder block's cross-attention K/V over the audio
+    features ONCE per utterance — the decode loop then only projects Q
+    (recomputing these per token was pure wasted MXU work)."""
+    return [L.precompute_kv(block["cross"], audio, config.num_heads)
+            for block in params["dec_blocks"]]
 
 
 def init_caches(config: WhisperConfig, batch: int,
@@ -177,10 +184,14 @@ def init_caches(config: WhisperConfig, batch: int,
             for _ in range(config.dec_layers)]
 
 
-def decode_step(params, config: WhisperConfig, tokens, audio, caches,
+def decode_step(params, config: WhisperConfig, tokens, cross_kv, caches,
                 position_offset=0):
-    """tokens: [B, T_step] (T_step=1 for incremental decode); returns
+    """tokens: [B, T_step] (T_step=1 for incremental decode); cross_kv is
+    precompute_cross_kv(...)'s output (a raw audio-features array is also
+    accepted and projected on the fly).  Returns
     (logits [B, T_step, vocab], new_caches)."""
+    if not isinstance(cross_kv, (list, tuple)):
+        cross_kv = precompute_cross_kv(params, config, cross_kv)
     x = L.embedding(params["tok_embed"], tokens)
     t = tokens.shape[1]
     positions = position_offset + jnp.arange(t)
@@ -194,9 +205,10 @@ def decode_step(params, config: WhisperConfig, tokens, audio, caches,
         mask = (k_pos <= q_pos)[None, None]
 
     new_caches = []
-    for block, cache in zip(params["dec_blocks"], caches):
-        x, cache = _decoder_block(block, x, audio, config.num_heads, cache,
-                                  mask)
+    for block, block_kv, cache in zip(params["dec_blocks"], cross_kv,
+                                      caches):
+        x, cache = _decoder_block(block, x, block_kv, config.num_heads,
+                                  cache, mask)
         new_caches.append(cache)
     x = L.layer_norm(params["ln_dec"], x)
     logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
@@ -212,20 +224,26 @@ def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
     The token loop is a lax.scan over static-shape KV caches; finished
     sequences (EOT emitted) keep writing EOT — no dynamic shapes, so one
     compilation serves every utterance in the bucket."""
+    total = len(sot_sequence) + max_tokens
+    if total > config.n_text_ctx:
+        raise ValueError(
+            f"sot({len(sot_sequence)}) + max_tokens({max_tokens}) exceeds "
+            f"n_text_ctx({config.n_text_ctx}): positions past the table "
+            f"would silently clamp")
     batch = mel.shape[0]
     audio = encode(params, config, mel)
-    caches = init_caches(config, batch,
-                         max_len=len(sot_sequence) + max_tokens)
+    cross_kv = precompute_cross_kv(params, config, audio)
+    caches = init_caches(config, batch, max_len=total)
 
     # prefill the start-of-transcript prompt
     prompt = jnp.tile(jnp.array(sot_sequence, jnp.int32)[None], (batch, 1))
-    logits, caches = decode_step(params, config, prompt, audio, caches)
+    logits, caches = decode_step(params, config, prompt, cross_kv, caches)
     first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
 
     def step(carry, position):
         token, caches, done = carry
         logits, caches = decode_step(
-            params, config, token[:, None], audio, caches,
+            params, config, token[:, None], cross_kv, caches,
             position_offset=position)
         next_token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         next_token = jnp.where(done, EOT, next_token)

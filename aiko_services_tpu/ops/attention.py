@@ -139,7 +139,7 @@ def attention(q, k, v, causal: bool = False, scale: float | None = None):
 
     s, d = q.shape[2], q.shape[3]
     if jax.default_backend() == "tpu" and s >= 256 and s % 128 == 0 \
-            and d % 128 == 0:
+            and d % 64 == 0:
         return flash_attention(q, k, v, causal=causal, scale=scale)
     from ..parallel.ring_attention import attention_reference
     return attention_reference(q, k, v, causal=causal, scale=scale)

@@ -22,21 +22,38 @@ WHISPER_N_FFT = 400
 WHISPER_HOP = 160
 
 
-def _hz_to_mel(hz):
-    return 2595.0 * math.log10(1.0 + hz / 700.0)
+# Slaney mel scale (librosa default, what Whisper's frontend uses):
+# linear below 1 kHz, logarithmic above.  NOT the HTK 2595*log10 form —
+# they diverge above ~1 kHz and pretrained weights are scale-sensitive.
+_MIN_LOG_HZ = 1000.0
+_LIN_SLOPE = 3.0 / 200.0                      # mels per Hz below 1 kHz
+_MIN_LOG_MEL = _MIN_LOG_HZ * _LIN_SLOPE       # 15.0
+_LOG_STEP = math.log(6.4) / 27.0
+
+
+def _hz_to_mel(hz: float) -> float:
+    if hz < _MIN_LOG_HZ:
+        return hz * _LIN_SLOPE
+    return _MIN_LOG_MEL + math.log(hz / _MIN_LOG_HZ) / _LOG_STEP
+
+
+def _mel_to_hz(mels):
+    linear = mels / _LIN_SLOPE
+    log = _MIN_LOG_HZ * jnp.exp(_LOG_STEP * (mels - _MIN_LOG_MEL))
+    return jnp.where(mels < _MIN_LOG_MEL, linear, log)
 
 
 @functools.lru_cache(maxsize=8)
 def mel_filterbank(num_mels: int = 80, n_fft: int = WHISPER_N_FFT,
                    sample_rate: int = WHISPER_SAMPLE_RATE,
                    fmin: float = 0.0, fmax: float | None = None):
-    """Slaney-style triangular mel filterbank: [n_fft//2+1, num_mels]."""
+    """Slaney-scale triangular mel filterbank: [n_fft//2+1, num_mels]."""
     fmax = fmax if fmax is not None else sample_rate / 2.0
     num_bins = n_fft // 2 + 1
     fft_freqs = jnp.linspace(0.0, sample_rate / 2.0, num_bins)
     mel_points = jnp.linspace(_hz_to_mel(fmin), _hz_to_mel(fmax),
                               num_mels + 2)
-    hz_points = 700.0 * (10.0 ** (mel_points / 2595.0) - 1.0)
+    hz_points = _mel_to_hz(mel_points)
 
     lower = hz_points[:-2][None, :]
     centre = hz_points[1:-1][None, :]

@@ -104,10 +104,16 @@ class BatchingScheduler:
         return None
 
     def next_deadline(self) -> float | None:
-        """When the oldest pending item's max_wait expires (drive timers)."""
+        """When the next dispatch is due: now for an already-full bucket,
+        else when the oldest pending item's max_wait expires."""
         with self._lock:
-            heads = [b.items[0].enqueue_time
-                     for b in self._queues.values() if b.items]
+            heads = []
+            for bucket in self._queues.values():
+                if not bucket.items:
+                    continue
+                if len(bucket.items) >= self.max_batch:
+                    return self.clock()        # dispatchable right now
+                heads.append(bucket.items[0].enqueue_time)
         if not heads:
             return None
         return min(heads) + self.max_wait
@@ -133,7 +139,16 @@ class BatchingScheduler:
                 queue = self._queues[bucket_key].items
                 batch = [queue.popleft()
                          for _ in range(min(self.max_batch, len(queue)))]
-            results = self.process_batch(bucket_key, batch)
+            # items are already popped: every callback MUST fire, or the
+            # stream's frame silently vanishes — errors fan out as results
+            try:
+                results = self.process_batch(bucket_key, batch)
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"process_batch returned {len(results)} results "
+                        f"for {len(batch)} items")
+            except Exception as exc:
+                results = [exc] * len(batch)
             self.stats["batches"] += 1
             self.stats["items"] += len(batch)
             self.stats["batch_size_sum"] += len(batch)

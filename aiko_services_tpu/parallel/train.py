@@ -8,9 +8,7 @@
 
 from __future__ import annotations
 
-import functools
-
-from .sharding import named_sharding, replicated, shard_pytree
+from .sharding import named_sharding, shard_pytree
 
 __all__ = ["make_train_step", "cross_entropy_loss", "TrainState",
            "init_train_state"]

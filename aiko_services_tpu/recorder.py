@@ -1,0 +1,57 @@
+# Recorder: aggregate distributed log topics into browsable ring buffers.
+#
+# Capability parity with the reference recorder
+# (reference: aiko_services/recorder.py:43-107): subscribes the namespace
+# log topic filter ({namespace}/+/+/+/log), keeps an LRU of per-topic ring
+# buffers, and republishes counts into its EC share so dashboards can
+# discover which services are logging and fetch their tails.
+
+from __future__ import annotations
+
+from collections import deque
+
+from .actor import Actor
+from .service import ServiceProtocol
+from .utils import LRUCache, get_logger
+
+__all__ = ["Recorder", "PROTOCOL_RECORDER"]
+
+PROTOCOL_RECORDER = ServiceProtocol("recorder")
+_TOPIC_LIMIT = 64           # LRU of log topics
+_RING_LIMIT = 128           # records per topic
+
+
+class Recorder(Actor):
+    def __init__(self, runtime, name: str = "recorder",
+                 topic_limit: int = _TOPIC_LIMIT,
+                 ring_limit: int = _RING_LIMIT):
+        super().__init__(runtime, name, PROTOCOL_RECORDER)
+        self.logger = get_logger("recorder")
+        self.ring_limit = ring_limit
+        self.buffers: LRUCache = LRUCache(topic_limit)
+        self._log_filter = f"{runtime.namespace}/+/+/+/log"
+        runtime.add_message_handler(self._log_handler, self._log_filter)
+        self.ec_producer.update("topic_count", 0)
+        self.ec_producer.update("record_count", 0)
+
+    def _log_handler(self, topic: str, payload) -> None:
+        ring = self.buffers.get(topic)
+        if ring is None:
+            ring = deque(maxlen=self.ring_limit)
+            self.buffers.put(topic, ring)
+            self.ec_producer.update("topic_count", len(self.buffers))
+        ring.append(payload)
+        total = sum(len(self.buffers.get(t)) for t in self.buffers.keys())
+        self.ec_producer.update("record_count", total)
+
+    def tail(self, topic: str, count: int = 16) -> list:
+        ring = self.buffers.get(topic)
+        return list(ring)[-count:] if ring else []
+
+    def topics(self) -> list[str]:
+        return list(self.buffers.keys())
+
+    def stop(self) -> None:
+        self.runtime.remove_message_handler(self._log_handler,
+                                            self._log_filter)
+        super().stop()

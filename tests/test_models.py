@@ -164,3 +164,25 @@ def test_resnet_axes_cover_params():
     mesh = create_mesh({"data": 8})
     placed = shard_pytree(params, axes, mesh)
     assert placed["head"]["w"].shape == params["head"]["w"].shape
+
+
+def test_whisper_precomputed_cross_kv_matches_on_the_fly(whisper_params):
+    from aiko_services_tpu.models.whisper import precompute_cross_kv
+    config = TINY_WHISPER
+    mel = jax.random.normal(jax.random.PRNGKey(9), (1, 32, 8))
+    tokens = jnp.array([[4, 8]], dtype=jnp.int32)
+    audio = encode(whisper_params, config, mel)
+    cross_kv = precompute_cross_kv(whisper_params, config, audio)
+    logits_a, _ = decode_step(whisper_params, config, tokens, audio,
+                              init_caches(config, 1, 2))
+    logits_b, _ = decode_step(whisper_params, config, tokens, cross_kv,
+                              init_caches(config, 1, 2))
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_whisper_greedy_rejects_overlong_decode(whisper_params):
+    mel = jnp.zeros((1, 32, 8))
+    with pytest.raises(ValueError, match="n_text_ctx"):
+        greedy_decode(whisper_params, TINY_WHISPER, mel,
+                      max_tokens=TINY_WHISPER.n_text_ctx + 1)

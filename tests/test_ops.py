@@ -177,3 +177,42 @@ def test_flash_attention_rejects_ragged_blocks():
     q = jnp.ones((1, 1, 100, 16))
     with pytest.raises(ValueError, match="not divisible"):
         flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
+
+
+def test_batch_error_fans_out_to_callbacks():
+    clock = FakeClock()
+    results = {}
+
+    def boom(bucket, items):
+        raise RuntimeError("device lost")
+
+    sched = BatchingScheduler(boom, ShapeBuckets([100]), max_batch=2,
+                              max_wait=1.0, clock=clock)
+    for i in range(2):
+        sched.submit(f"s{i}", i, 10,
+                     lambda sid, r: results.__setitem__(sid, r))
+    sched.drain()
+    assert set(results) == {"s0", "s1"}
+    assert all(isinstance(r, RuntimeError) for r in results.values())
+
+
+def test_next_deadline_immediate_for_full_bucket():
+    clock = FakeClock()
+    sched = BatchingScheduler(lambda b, i: [None] * len(i),
+                              ShapeBuckets([100]), max_batch=2,
+                              max_wait=10.0, clock=clock)
+    sched.submit("a", 0, 10, lambda *_: None)
+    assert sched.next_deadline() == pytest.approx(10.0)
+    sched.submit("b", 0, 10, lambda *_: None)   # bucket now full
+    assert sched.next_deadline() == pytest.approx(0.0)
+
+
+def test_slaney_mel_scale_breakpoints():
+    """Slaney scale: linear below 1 kHz (hz/66.67), log above."""
+    from aiko_services_tpu.ops.audio import _hz_to_mel, _mel_to_hz
+    assert _hz_to_mel(500.0) == pytest.approx(7.5)
+    assert _hz_to_mel(1000.0) == pytest.approx(15.0)
+    # round trip across the breakpoint
+    for hz in (200.0, 999.0, 1000.0, 4000.0, 7999.0):
+        back = float(_mel_to_hz(jnp.array(_hz_to_mel(hz))))
+        assert back == pytest.approx(hz, rel=1e-5)

@@ -1,0 +1,178 @@
+# Core service tests: process manager, lifecycle fleet, recorder, storage
+# and the discover-call-respond patterns — all driven deterministically on
+# the shared in-memory broker + virtual clock.
+
+import sys
+
+import pytest
+
+from aiko_services_tpu.lifecycle import LifeCycleClient, LifeCycleManager
+from aiko_services_tpu.process_manager import ProcessManager
+from aiko_services_tpu.recorder import Recorder
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.service import ServiceFilter
+from aiko_services_tpu.storage import (
+    ResponseCollector, Storage, do_request)
+
+
+def settle(engine, steps=8):
+    for _ in range(steps):
+        engine.step()
+
+
+# -- process manager ---------------------------------------------------------
+
+def test_process_manager_spawn_and_exit(engine):
+    exits = []
+    manager = ProcessManager(
+        engine, lambda id, pid, code: exits.append((id, code)))
+    manager.spawn("ok", [sys.executable, "-c", "print('hi')"])
+    assert "ok" in manager
+    import time
+    deadline = time.monotonic() + 10
+    while exits == [] and time.monotonic() < deadline:
+        engine.clock.advance(0.2)
+        engine.step()
+        time.sleep(0.01)
+    assert exits == [("ok", 0)]
+    assert "ok" not in manager
+    manager.terminate()
+
+
+def test_process_manager_delete_kills(engine):
+    manager = ProcessManager(engine)
+    manager.spawn("sleeper", [sys.executable, "-c",
+                              "import time; time.sleep(60)"])
+    manager.delete("sleeper")
+    assert "sleeper" not in manager
+    manager.terminate()
+
+
+def test_process_manager_duplicate_id(engine):
+    manager = ProcessManager(engine)
+    manager.spawn("x", [sys.executable, "-c", "pass"])
+    with pytest.raises(ValueError):
+        manager.spawn("x", [sys.executable, "-c", "pass"])
+    manager.terminate()
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def test_lifecycle_fleet_handshake(make_runtime, engine):
+    """Manager spawns in-process clients; handshake completes; shares are
+    mirrored; deletion stops the client."""
+    manager_rt = make_runtime("lcm_host").initialize()
+    spawned = {}
+
+    def spawner(client_id, manager_topic):
+        rt = make_runtime(f"worker_{client_id}").initialize()
+        client = LifeCycleClient(rt, f"client_{client_id}", manager_topic,
+                                 client_id)
+        spawned[client_id] = (rt, client)
+        return rt
+
+    manager = LifeCycleManager(manager_rt, "lcm", spawner)
+    ids = manager.create_clients(3)
+    settle(engine, 12)
+    assert manager.ready_count() == 3
+    assert manager.ec_producer.get("client_count") == 3
+    # shares mirrored via EC
+    record = manager.clients[ids[0]]
+    # EC wire format folds types: numeric strings arrive as ints
+    assert str(record.share.get("client_id")) == ids[0]
+
+    manager.delete_client(ids[0])
+    settle(engine, 8)
+    assert manager.ready_count() == 2
+    assert len(manager.clients) == 2
+
+
+def test_lifecycle_handshake_timeout_deletes(make_runtime, engine):
+    manager_rt = make_runtime("lcm2_host").initialize()
+    manager = LifeCycleManager(manager_rt, "lcm2",
+                               spawner=lambda cid, topic: None,
+                               handshake_lease_time=5.0)
+    manager.create_clients(2)           # clients never call back
+    assert len(manager.clients) == 2
+    engine.clock.advance(6.0)
+    settle(engine, 4)
+    assert len(manager.clients) == 0    # reaped by handshake lease
+
+
+# -- recorder ----------------------------------------------------------------
+
+def test_recorder_aggregates_log_topics(make_runtime, engine):
+    rt = make_runtime("rec_host").initialize()
+    recorder = Recorder(rt)
+    settle(engine, 2)
+    log_topic = f"{rt.namespace}/host/123-0/1/log"
+    for i in range(5):
+        rt.publish(log_topic, f"line {i}")
+    settle(engine, 6)
+    assert recorder.tail(log_topic, 3) == ["line 2", "line 3", "line 4"]
+    assert recorder.ec_producer.get("topic_count") == 1
+    assert recorder.ec_producer.get("record_count") == 5
+
+
+def test_recorder_ring_limit(make_runtime, engine):
+    rt = make_runtime("rec2_host").initialize()
+    recorder = Recorder(rt, ring_limit=4)
+    settle(engine, 2)
+    topic = f"{rt.namespace}/h/1-0/1/log"
+    for i in range(10):
+        rt.publish(topic, str(i))
+    settle(engine, 12)
+    assert recorder.tail(topic, 99) == ["6", "7", "8", "9"]
+
+
+# -- storage -----------------------------------------------------------------
+
+def test_storage_put_get_roundtrip(make_runtime, engine):
+    rt = make_runtime("store_host").initialize()
+    storage = Storage(rt)
+    storage.put("alpha", {"x": 1})
+    storage.put("beta", [1, 2, 3])
+
+    got = []
+    collector = ResponseCollector(rt, got.append)
+    storage.get("alpha", collector.topic)
+    settle(engine, 6)
+    assert got == [[{"x": 1}]]
+
+    keys = []
+    collector2 = ResponseCollector(rt, keys.append)
+    storage.keys(collector2.topic)
+    settle(engine, 6)
+    assert keys == [["alpha", "beta"]]
+
+    storage.delete("alpha")
+    missing = []
+    collector3 = ResponseCollector(rt, missing.append)
+    storage.get("alpha", collector3.topic)
+    settle(engine, 6)
+    assert missing == [[]]
+
+
+def test_do_request_discovers_and_collects(make_runtime, engine):
+    """Full pattern: registrar + storage service + a separate client
+    process that discovers storage by protocol and issues a request."""
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine, 6)
+
+    store_rt = make_runtime("svc_host").initialize()
+    storage = Storage(store_rt)
+    storage.put("k", "v")
+    settle(engine, 8)
+
+    client_rt = make_runtime("cli_host").initialize()
+    settle(engine, 8)
+    results = []
+    do_request(
+        client_rt, Storage,
+        ServiceFilter(protocol=str(storage.protocol)),
+        lambda proxy, topic: proxy.get("k", topic),
+        results.append)
+    settle(engine, 20)
+    assert results == [["v"]]
