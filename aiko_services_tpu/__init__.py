@@ -28,6 +28,7 @@ from .lifecycle import (                                    # noqa: F401
     LifeCycleClient, LifeCycleManager,
 )
 from .recorder import Recorder                              # noqa: F401
+from .compute import ComputeRuntime                         # noqa: F401
 from .storage import (                                      # noqa: F401
     ResponseCollector, Storage, do_command, do_request,
 )

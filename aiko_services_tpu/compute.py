@@ -1,0 +1,161 @@
+# ComputeRuntime: the TPU execution backend service.
+#
+# This is the north-star component (BASELINE.json): the piece that hosts
+# compiled jax programs behind the control plane.  The reference has no
+# equivalent — its elements call CUDA models inline on the event loop
+# (reference: examples/speech/speech_elements.py:217-250), serializing
+# every tensor through MQTT.  Here:
+#   * a ComputeRuntime owns the device mesh and a table of compiled
+#     functions ("programs"), placed with logical-axis shardings;
+#   * pipeline elements submit work through a BatchingScheduler — frames
+#     from many streams coalesce into MXU-sized batches with a bounded
+#     wait (<150 ms p50 target);
+#   * it is a Service: its mesh geometry, program table, and batch stats
+#     are EC-shared, so dashboards and lifecycle managers see device
+#     health (SURVEY.md §7 "two-plane consistency").
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from .ops.batching import BatchingScheduler, ShapeBuckets
+from .service import ServiceProtocol
+from .actor import Actor
+from .utils import get_logger
+
+__all__ = ["ComputeRuntime", "CompiledProgram", "PROTOCOL_COMPUTE"]
+
+PROTOCOL_COMPUTE = ServiceProtocol("compute")
+
+
+@dataclass
+class CompiledProgram:
+    name: str
+    fn: Callable                  # jitted: fn(batch_payload) -> results
+    buckets: ShapeBuckets | None
+    scheduler: BatchingScheduler | None
+    compile_times: dict          # bucket -> seconds
+
+
+class ComputeRuntime(Actor):
+    """Owns the mesh; hosts compiled programs; schedules batches.
+
+    mesh=None → single-device.  Programs are registered with a collate
+    function (list of payloads → batch arrays) and a split function
+    (batch results → per-item results); the runtime wires them to a
+    BatchingScheduler driven off the EventEngine.
+    """
+
+    def __init__(self, runtime, name: str = "compute", mesh=None,
+                 drive_period: float = 0.005):
+        share = {"device_count": 0, "program_count": 0}
+        super().__init__(runtime, name, PROTOCOL_COMPUTE, share=share)
+        self.logger = get_logger(f"compute.{name}")
+        self._mesh = mesh
+        self.drive_period = drive_period
+        self.programs: dict[str, CompiledProgram] = {}
+        self._timers: list[int] = []
+        import jax
+        self._devices = list(mesh.devices.flat) if mesh is not None \
+            else jax.devices()[:1]
+        self.ec_producer.update("device_count", len(self._devices))
+        self.ec_producer.update(
+            "mesh", dict(mesh.shape) if mesh is not None else {})
+        self.ec_producer.update("platform", self._devices[0].platform)
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from .parallel import single_device_mesh
+            self._mesh = single_device_mesh()
+        return self._mesh
+
+    # -- direct (unbatched) programs ---------------------------------------
+    def register_program(self, name: str, fn, donate_argnums=()) -> None:
+        """Register a jittable fn for direct invocation via run()."""
+        import jax
+        compiled = jax.jit(fn, donate_argnums=donate_argnums)
+        self.programs[name] = CompiledProgram(name, compiled, None, None,
+                                              {})
+        self.ec_producer.update("program_count", len(self.programs))
+
+    def run(self, name: str, *args):
+        program = self.programs[name]
+        start = time.perf_counter()
+        result = program.fn(*args)
+        program.compile_times.setdefault("direct",
+                                         time.perf_counter() - start)
+        return result
+
+    # -- batched programs ---------------------------------------------------
+    def register_batched(self, name: str, fn, buckets,
+                         collate, split, max_batch: int = 32,
+                         max_wait: float = 0.05) -> BatchingScheduler:
+        """Register a batched program.
+
+        fn(bucket, batch_arrays) -> batch_results (jit-compiled per
+        bucket by the caller or internally static);
+        collate(bucket, payloads) -> batch_arrays;
+        split(batch_results, count) -> list of per-item results.
+        Returns the scheduler (elements submit through it)."""
+        program_holder = {}
+
+        def process_batch(bucket, items):
+            payloads = [item.payload for item in items]
+            batch = collate(bucket, payloads)
+            start = time.perf_counter()
+            results = fn(bucket, batch)
+            program = program_holder["program"]
+            if bucket not in program.compile_times:
+                program.compile_times[bucket] = \
+                    time.perf_counter() - start
+                self.ec_producer.update(
+                    f"compile.{name}.{bucket}",
+                    round(program.compile_times[bucket], 3))
+            self._publish_stats(name, scheduler)
+            return split(results, len(items))
+
+        if not isinstance(buckets, ShapeBuckets):
+            buckets = ShapeBuckets(buckets)
+        scheduler = BatchingScheduler(process_batch, buckets,
+                                      max_batch=max_batch,
+                                      max_wait=max_wait,
+                                      clock=self.runtime.event.clock.now)
+        program = CompiledProgram(name, fn, buckets, scheduler, {})
+        program_holder["program"] = program
+        self.programs[name] = program
+        self._timers.append(scheduler.attach(self.runtime.event,
+                                             self.drive_period))
+        self.ec_producer.update("program_count", len(self.programs))
+        return scheduler
+
+    def submit(self, name: str, stream_id: str, payload, length: int,
+               callback) -> None:
+        program = self.programs[name]
+        if program.scheduler is None:
+            raise ValueError(f"program {name} is not batched")
+        program.scheduler.submit(stream_id, payload, length, callback)
+
+    def _publish_stats(self, name: str, scheduler) -> None:
+        self.ec_producer.update(f"batch.{name}.batches",
+                                scheduler.stats["batches"])
+        self.ec_producer.update(f"batch.{name}.mean_size",
+                                round(scheduler.mean_batch_size(), 2))
+        self.ec_producer.update(f"batch.{name}.mean_wait_ms",
+                                round(scheduler.mean_wait() * 1000.0, 2))
+
+    # -- placement ----------------------------------------------------------
+    def place_params(self, params, param_axes, rules=None):
+        """Shard a parameter tree onto this runtime's mesh."""
+        from .parallel import shard_pytree
+        return shard_pytree(params, param_axes, self.mesh, rules)
+
+    def stop(self) -> None:
+        for timer in self._timers:
+            self.runtime.event.remove_timer_handler(timer)
+        for program in self.programs.values():
+            if program.scheduler is not None:
+                program.scheduler.drain(force=True)
+        super().stop()

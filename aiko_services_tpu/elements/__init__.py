@@ -15,9 +15,15 @@ from .common import (                                       # noqa: F401
     PE_0, PE_1, PE_2, PE_3, PE_4,
     PE_DataEncode, PE_DataDecode,
 )
+from .speech import (                                       # noqa: F401
+    PE_AudioFraming, PE_AudioReadFile, PE_AudioWriteFile, PE_LogMel,
+    PE_Synthesize, PE_WhisperASR,
+)
 
 __all__ = [
     "PE_GenerateNumbers", "PE_Metrics", "PE_Identity",
     "PE_0", "PE_1", "PE_2", "PE_3", "PE_4",
     "PE_DataEncode", "PE_DataDecode",
+    "PE_AudioFraming", "PE_AudioReadFile", "PE_AudioWriteFile",
+    "PE_LogMel", "PE_Synthesize", "PE_WhisperASR",
 ]

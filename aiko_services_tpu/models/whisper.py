@@ -46,6 +46,10 @@ class WhisperConfig:
 # parameter table mirrors the reference's model-size table
 # (speech_elements.py:175-180: tiny 39M … large 1550M)
 WHISPER_PRESETS = {
+    # not a real whisper size: CI/smoke geometry (real 80-mel frontend,
+    # toy transformer) so end-to-end speech tests run in seconds on CPU
+    "test":   WhisperConfig(dim=64,   num_heads=4,  enc_layers=2,
+                            dec_layers=2, n_vocab=256),
     "tiny":   WhisperConfig(dim=384,  num_heads=6,  enc_layers=4,
                             dec_layers=4),
     "base":   WhisperConfig(dim=512,  num_heads=8,  enc_layers=6,

@@ -48,8 +48,8 @@ from .utils import Graph, GraphError, get_logger, load_class, load_module
 __all__ = [
     "PROTOCOL_PIPELINE", "PipelineDefinition", "PipelineElementDefinition",
     "PipelineGraph", "PipelineElement", "Pipeline", "Stream", "Frame",
-    "FrameOutput", "parse_pipeline_definition", "load_pipeline_definition",
-    "PipelineError",
+    "FrameOutput", "DEFERRED", "parse_pipeline_definition",
+    "load_pipeline_definition", "PipelineError",
 ]
 
 PROTOCOL_PIPELINE = ServiceProtocol("pipeline")
@@ -263,10 +263,24 @@ class Frame:
     frame_id: int
     swag: dict = field(default_factory=dict)
     metrics: dict = field(default_factory=dict)
+    deferred_at: int | None = None      # topo index parked at (batching)
+    deferred_since: float = 0.0
 
     @property
     def stream_id(self) -> str:
         return self.stream.stream_id
+
+
+class _Deferred:
+    """Sentinel: element submitted async work (e.g. to a batching
+    scheduler) and will call pipeline.resume_frame(frame, name, outputs)
+    when it completes.  Return `FrameOutput(True, DEFERRED)`."""
+
+    def __repr__(self):
+        return "DEFERRED"
+
+
+DEFERRED = _Deferred()
 
 
 class FrameOutput:
@@ -313,8 +327,13 @@ class PipelineElement(Actor):
 
     # -- parameters: stream > element > pipeline (reference: :316-329) ------
     def get_parameter(self, name: str, default=None, stream: Stream = None):
-        if stream is not None and name in stream.parameters:
-            return stream.parameters[name], True
+        if stream is not None:
+            # specific beats general at every level
+            scoped = f"{self.definition.name}.{name}"
+            if scoped in stream.parameters:
+                return stream.parameters[scoped], True
+            if name in stream.parameters:
+                return stream.parameters[name], True
         if name in self.definition.parameters:
             return self.definition.parameters[name], True
         if self.pipeline is not None:
@@ -571,11 +590,39 @@ class Pipeline(PipelineElement):
         if stream.lease is not None:
             stream.lease.extend()
 
-        start = time.perf_counter()
-        frame.metrics["time_pipeline_start"] = start
-        swag = frame.swag
+        frame.metrics["time_pipeline_start"] = time.perf_counter()
+        return self._walk(frame, 0)
 
-        for node in self._topo_nodes:
+    def resume_frame(self, frame: Frame, node_name: str,
+                     outputs: dict | None) -> FrameOutput:
+        """Continue a frame parked by a DEFERRED element (continuous
+        batching: the element submitted work to a scheduler and calls this
+        — typically via `pipeline.post("resume_frame", ...)` — when the
+        batch completes)."""
+        index = frame.deferred_at
+        if index is None:
+            return FrameOutput(False, diagnostic="frame not deferred")
+        node = self._topo_nodes[index]
+        if node.name != node_name:
+            return FrameOutput(
+                False, diagnostic=f"deferred at {node.name}, "
+                                  f"resumed as {node_name}")
+        frame.deferred_at = None
+        frame.metrics[f"time_{node.name}"] = \
+            time.perf_counter() - frame.deferred_since
+        if isinstance(outputs, Exception):
+            self._fail_frame(frame, node.name, repr(outputs))
+            return FrameOutput(False,
+                               diagnostic=f"{node.name}: {outputs!r}")
+        if outputs:
+            self._merge_outputs(node, self._element_defs[node.name],
+                                outputs, frame.swag)
+        return self._walk(frame, index + 1)
+
+    def _walk(self, frame: Frame, start_index: int) -> FrameOutput:
+        swag = frame.swag
+        for index in range(start_index, len(self._topo_nodes)):
+            node = self._topo_nodes[index]
             element = node.element
             element_def = self._element_defs[node.name]
             inputs = self._gather_inputs(node.name, element_def, swag)
@@ -599,6 +646,11 @@ class Pipeline(PipelineElement):
                     return FrameOutput(False,
                                        diagnostic=f"{node.name}: {exc!r}")
                 ok, outputs = result
+            if ok and outputs is DEFERRED:
+                # park the frame; the element resumes it asynchronously
+                frame.deferred_at = index
+                frame.deferred_since = element_start
+                return FrameOutput(True, DEFERRED)
             frame.metrics[f"time_{node.name}"] = \
                 time.perf_counter() - element_start
             if not ok:
@@ -606,18 +658,21 @@ class Pipeline(PipelineElement):
                 return FrameOutput(
                     False, diagnostic=f"{node.name}: reported not-ok")
             if outputs:
-                # an element's interface is its declared outputs: scratch
-                # values (e.g. a nested pipeline's intermediates) don't leak
-                if element_def.output:
-                    declared = element_def.output_names
-                    outputs = {k: v for k, v in outputs.items()
-                               if k in declared}
-                self._scatter_outputs(node.name, outputs, swag)
+                self._merge_outputs(node, element_def, outputs, swag)
 
-        frame.metrics["time_pipeline"] = time.perf_counter() - start
+        frame.metrics["time_pipeline"] = \
+            time.perf_counter() - frame.metrics["time_pipeline_start"]
         for handler in self._frame_handlers:
             handler(frame)
         return FrameOutput(True, dict(swag))
+
+    def _merge_outputs(self, node, element_def, outputs, swag) -> None:
+        # an element's interface is its declared outputs: scratch values
+        # (e.g. a nested pipeline's intermediates) don't leak
+        if element_def.output:
+            declared = element_def.output_names
+            outputs = {k: v for k, v in outputs.items() if k in declared}
+        self._scatter_outputs(node.name, outputs, swag)
 
     def _gather_inputs(self, node_name, element_def, swag):
         """Collect declared inputs from the swag, applying fan-in renames

@@ -1,0 +1,138 @@
+# End-to-end speech slice (SURVEY.md §7 step 5 "ONE model running"):
+# wav file → framing → log-mel → batched Whisper ASR on the ComputeRuntime
+# → placeholder TTS → wav out, all inside one pipeline on the in-memory
+# control plane.  Uses the "test" whisper preset (real 80-mel frontend,
+# toy transformer) so it runs in seconds on CPU.
+
+import json
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.compute import ComputeRuntime
+from aiko_services_tpu.elements.speech import load_wav, save_wav
+from aiko_services_tpu.pipeline import (
+    Pipeline, parse_pipeline_definition)
+
+
+@pytest.fixture
+def wav_file(tmp_path):
+    rng = np.random.default_rng(0)
+    audio = (0.1 * rng.standard_normal(16000)).astype(np.float32)  # 1 s
+    path = tmp_path / "utterance.wav"
+    save_wav(str(path), audio)
+    return str(path)
+
+
+def test_wav_roundtrip(tmp_path):
+    audio = np.sin(np.linspace(0, 100, 8000)).astype(np.float32) * 0.5
+    path = tmp_path / "x.wav"
+    save_wav(str(path), audio)
+    loaded, rate = load_wav(str(path))
+    assert rate == 16000
+    np.testing.assert_allclose(loaded, audio, atol=1e-3)
+
+
+def speech_definition(tmp_path, mode):
+    return {
+        "version": 0, "name": "p_speech", "runtime": "jax",
+        "graph": ["(PE_AudioReadFile (PE_AudioFraming (PE_LogMel "
+                  "(PE_WhisperASR (PE_Synthesize PE_AudioWriteFile)))))"],
+        "parameters": {
+            "PE_WhisperASR.preset": "test",
+            "PE_WhisperASR.mode": mode,
+            "PE_WhisperASR.max_tokens": 8,
+            "PE_WhisperASR.buckets": [200],
+            "PE_WhisperASR.max_wait": 0.02,
+            "PE_AudioWriteFile.pathname":
+                str(tmp_path / "out_{stream_id}.wav"),
+        },
+        "elements": [
+            {"name": "PE_AudioReadFile", "input": [],
+             "output": [{"name": "audio"}, {"name": "sample_rate"}]},
+            {"name": "PE_AudioFraming", "input": [{"name": "audio"}],
+             "output": [{"name": "audio"}],
+             "parameters": {"window_count": 2}},
+            {"name": "PE_LogMel", "input": [{"name": "audio"}],
+             "output": [{"name": "mel"}]},
+            {"name": "PE_WhisperASR", "input": [{"name": "mel"}],
+             "output": [{"name": "tokens"}, {"name": "text"}]},
+            {"name": "PE_Synthesize", "input": [{"name": "text"}],
+             "output": [{"name": "audio"}]},
+            {"name": "PE_AudioWriteFile", "input": [{"name": "audio"}],
+             "output": []},
+        ],
+    }
+
+
+def run_speech_pipeline(make_runtime, engine, tmp_path, wav_file, mode):
+    runtime = make_runtime("speech_host").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition(
+        speech_definition(tmp_path, mode))
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream(
+        "s1", lease_time=0,
+        parameters={"PE_AudioReadFile.pathname": wav_file})
+    pipeline.post("process_frame", "s1", {})
+    # drive: mailbox delivery, batch max_wait expiry, resume
+    for _ in range(400):
+        if done:
+            break
+        engine.clock.advance(0.01)
+        engine.step()
+    assert done, f"speech frame never completed in mode={mode}"
+    frame = done[0]
+    assert "text" in frame.swag and isinstance(frame.swag["text"], str)
+    assert frame.swag["tokens"].dtype.kind == "i"
+    out_wav = tmp_path / "out_s1.wav"
+    assert out_wav.exists()
+    audio, rate = load_wav(str(out_wav))
+    assert rate == 16000 and audio.size > 0
+    # per-element metrics recorded, including the deferred ASR stage
+    assert "time_PE_WhisperASR" in frame.metrics
+    return frame
+
+
+def test_speech_pipeline_sync(make_runtime, engine, tmp_path, wav_file):
+    run_speech_pipeline(make_runtime, engine, tmp_path, wav_file, "sync")
+
+
+def test_speech_pipeline_batched_deferred(make_runtime, engine, tmp_path,
+                                          wav_file):
+    """Batched mode: the frame parks at the ASR element (DEFERRED), the
+    batch dispatches after max_wait, and resume_frame completes the walk."""
+    run_speech_pipeline(make_runtime, engine, tmp_path, wav_file,
+                        "batched")
+
+
+def test_batched_asr_coalesces_streams(make_runtime, engine, tmp_path,
+                                       wav_file):
+    """Many streams' frames form ONE device batch (the north-star
+    mechanic): 6 streams, max_wait expiry, single batch of 6."""
+    runtime = make_runtime("multi_host").initialize()
+    compute = ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition(
+        speech_definition(tmp_path, "batched"))
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    for i in range(6):
+        sid = f"s{i}"
+        pipeline.create_stream(
+            sid, lease_time=0,
+            parameters={"PE_AudioReadFile.pathname": wav_file})
+        pipeline.post("process_frame", sid, {})
+    for _ in range(600):
+        if len(done) == 6:
+            break
+        engine.clock.advance(0.005)
+        engine.step()
+    assert len(done) == 6
+    program = compute.programs["whisper_asr.PE_WhisperASR"]
+    stats = program.scheduler.stats
+    assert stats["items"] == 6
+    assert stats["batches"] <= 2          # coalesced, not one-by-one
+    assert program.scheduler.mean_batch_size() >= 3.0
