@@ -1,0 +1,161 @@
+# Command-line entry points.
+#
+# Capability parity with the reference console scripts
+# (reference: pyproject.toml:36-40 — aiko, aiko_dashboard, aiko_pipeline,
+# aiko_registrar; CLI autogen: aiko_services/cli.py:96-206, pipeline CLI:
+# pipeline.py:874-936).
+#
+#   aiko_tpu registrar                  — run a registrar process
+#   aiko_tpu pipeline create DEF.json   — run a pipeline from a definition
+#   aiko_tpu pipeline show DEF.json     — validate + print a definition
+#   aiko_tpu dashboard                  — curses service dashboard
+#   aiko_tpu storage                    — run a storage service
+#   aiko_tpu recorder                   — run a log recorder
+#
+# Transport selection: --transport memory|mqtt (AIKO_TPU_TRANSPORT env);
+# mqtt interops with a real broker, memory is single-process.
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import click
+
+__all__ = ["main"]
+
+
+def _make_runtime(name, transport):
+    from .process import ProcessRuntime
+
+    if transport == "mqtt":
+        from .transport.mqtt import MQTT_AVAILABLE, MqttMessage
+        if not MQTT_AVAILABLE:
+            raise click.ClickException(
+                "mqtt transport requested but paho-mqtt is not installed")
+
+        def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+            return MqttMessage(on_message=on_message, lwt_topic=lwt_topic,
+                               lwt_payload=lwt_payload,
+                               lwt_retain=lwt_retain)
+        runtime = ProcessRuntime(name=name, transport_factory=factory)
+    else:
+        runtime = ProcessRuntime(name=name)
+    return runtime.initialize()
+
+
+transport_option = click.option(
+    "--transport", default=lambda: os.environ.get("AIKO_TPU_TRANSPORT",
+                                                  "memory"),
+    type=click.Choice(["memory", "mqtt"]), help="control-plane transport")
+
+
+@click.group()
+def main() -> None:
+    """aiko_services_tpu: TPU-native distributed service framework."""
+
+
+@main.command()
+@transport_option
+def registrar(transport) -> None:
+    """Run a registrar (primary election + service discovery)."""
+    from .registrar import Registrar
+
+    runtime = _make_runtime("registrar", transport)
+    Registrar(runtime)
+    click.echo(f"registrar on {runtime.topic_path} ({transport})")
+    runtime.run(loop_when_no_handlers=True)
+
+
+@main.group()
+def pipeline() -> None:
+    """Pipeline operations."""
+
+
+@pipeline.command()
+@click.argument("definition_pathname")
+@click.option("--name", default=None, help="pipeline service name")
+@click.option("--stream", "stream_id", default="*",
+              help="stream id to create")
+@click.option("--stream-parameters", default="{}",
+              help="JSON dict of stream parameters")
+@click.option("--frame", "frame_json", default=None,
+              help="JSON swag for one immediate frame")
+@transport_option
+def create(definition_pathname, name, stream_id, stream_parameters,
+           frame_json, transport) -> None:
+    """Run a pipeline from DEFINITION_PATHNAME."""
+    from .compute import ComputeRuntime
+    from .pipeline import Pipeline, load_pipeline_definition
+
+    definition = load_pipeline_definition(definition_pathname)
+    runtime = _make_runtime(name or definition.name, transport)
+    ComputeRuntime(runtime, "compute")
+    pipe = Pipeline(runtime, definition, name=name,
+                    definition_pathname=definition_pathname)
+    pipe.create_stream(stream_id,
+                       parameters=json.loads(stream_parameters))
+    if frame_json is not None:
+        pipe.post("process_frame", stream_id, json.loads(frame_json))
+    click.echo(f"pipeline {pipe.name} on {pipe.topic_path} "
+               f"({len(pipe.graph)} elements, {transport})")
+    runtime.run(loop_when_no_handlers=True)
+
+
+@pipeline.command()
+@click.argument("definition_pathname")
+def show(definition_pathname) -> None:
+    """Validate and print a pipeline definition."""
+    from .pipeline import PipelineGraph, load_pipeline_definition
+
+    definition = load_pipeline_definition(definition_pathname)
+    graph = PipelineGraph.from_definition(definition)
+    graph.validate(definition)
+    click.echo(f"pipeline: {definition.name} (runtime={definition.runtime})")
+    for node in graph.topological_order():
+        element = definition.element(node.name)
+        deploy = "remote" if element.is_remote else "local"
+        click.echo(f"  {node.name}: {element.input_names} -> "
+                   f"{element.output_names} [{deploy}]"
+                   + (f" -> {node.successors}" if node.successors else ""))
+    click.echo("valid")
+
+
+@main.command()
+@transport_option
+def storage(transport) -> None:
+    """Run a storage service (sqlite key/value)."""
+    from .storage import Storage
+
+    runtime = _make_runtime("storage", transport)
+    database, _ = os.environ.get("AIKO_TPU_STORAGE", "storage.db"), None
+    Storage(runtime, database_path=database)
+    click.echo(f"storage ({database}) on {runtime.topic_path}")
+    runtime.run(loop_when_no_handlers=True)
+
+
+@main.command()
+@transport_option
+def recorder(transport) -> None:
+    """Run a log recorder."""
+    from .recorder import Recorder
+
+    runtime = _make_runtime("recorder", transport)
+    Recorder(runtime)
+    click.echo(f"recorder on {runtime.topic_path}")
+    runtime.run(loop_when_no_handlers=True)
+
+
+@main.command()
+@transport_option
+def dashboard(transport) -> None:
+    """Curses dashboard: live service table + EC share browser."""
+    from .dashboard import run_dashboard
+
+    runtime = _make_runtime("dashboard", transport)
+    run_dashboard(runtime)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,198 @@
+# Dashboard: terminal UI over the live service table and EC shares.
+#
+# Capability parity with the reference dashboard
+# (reference: aiko_services/dashboard.py:279-750 — asciimatics TUI):
+#   * services page: live table from the ServicesCache (registrar replica);
+#   * selecting a service ECConsumes its share and shows the variables
+#     live (reference: dashboard.py:337-352);
+#   * update a share variable (publishes "(update name value)" to the
+#     service's control topic, reference: dashboard.py:225-228);
+#   * log page: tail of the selected service's log topic.
+#
+# Built on stdlib curses (no asciimatics dependency); rendering is
+# separated from state (DashboardState) so the UI logic is testable
+# headless, and `run_dashboard` drives the EventEngine and the screen from
+# one loop.
+
+from __future__ import annotations
+
+from collections import deque
+
+from .share import ECConsumer, ServicesCache
+from .utils import generate
+
+__all__ = ["DashboardState", "run_dashboard"]
+
+_LOG_LIMIT = 256
+
+
+class DashboardState:
+    """UI-independent dashboard model: the services table, the selected
+    service's mirrored share, and its log tail."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.cache = ServicesCache(runtime)
+        self.selected_index = 0
+        self.page = "services"          # services | variables | log
+        self.share: dict = {}
+        self._consumer = None
+        self._log_topic = None
+        self.log_lines: deque = deque(maxlen=_LOG_LIMIT)
+
+    # -- services table -----------------------------------------------------
+    def services(self) -> list:
+        return sorted(self.cache.services,
+                      key=lambda fields: fields.topic_path)
+
+    def selected(self):
+        services = self.services()
+        if not services:
+            return None
+        self.selected_index %= len(services)
+        return services[self.selected_index]
+
+    def move(self, delta: int) -> None:
+        services = self.services()
+        if services:
+            self.selected_index = (self.selected_index + delta) % \
+                len(services)
+
+    # -- share mirror -------------------------------------------------------
+    def open_variables(self) -> None:
+        fields = self.selected()
+        if fields is None:
+            return
+        self.close_consumer()
+        self.share = {}
+        self._consumer = ECConsumer(self.runtime, self.share,
+                                    f"{fields.topic_path}/control")
+        self.page = "variables"
+
+    def update_variable(self, name: str, value) -> None:
+        fields = self.selected()
+        if fields is not None:
+            self.runtime.publish(f"{fields.topic_path}/control",
+                                 generate("update", [name, value]))
+
+    def close_consumer(self) -> None:
+        if self._consumer is not None:
+            self._consumer.terminate()
+            self._consumer = None
+
+    # -- log tail -----------------------------------------------------------
+    def open_log(self) -> None:
+        fields = self.selected()
+        if fields is None:
+            return
+        self.close_log()
+        self.log_lines.clear()
+        self._log_topic = f"{fields.topic_path}/log"
+        self.runtime.add_message_handler(self._on_log, self._log_topic)
+        self.page = "log"
+
+    def _on_log(self, _topic, payload) -> None:
+        self.log_lines.append(str(payload))
+
+    def close_log(self) -> None:
+        if self._log_topic is not None:
+            self.runtime.remove_message_handler(self._on_log,
+                                                self._log_topic)
+            self._log_topic = None
+
+    def back(self) -> None:
+        self.close_consumer()
+        self.close_log()
+        self.page = "services"
+
+    def flat_share(self) -> list:
+        rows = []
+        for key, value in sorted(self.share.items()):
+            if isinstance(value, dict):
+                for sub, sub_value in sorted(value.items()):
+                    rows.append((f"{key}.{sub}", sub_value))
+            else:
+                rows.append((key, value))
+        return rows
+
+    def terminate(self) -> None:
+        self.back()
+        self.cache.terminate()
+
+
+def _render(screen, state: DashboardState) -> None:
+    import curses
+
+    screen.erase()
+    height, width = screen.getmaxyx()
+    title = (f" aiko_tpu dashboard — {state.page} — "
+             f"{state.runtime.namespace} ")
+    screen.addnstr(0, 0, title.ljust(width - 1), width - 1,
+                   curses.A_REVERSE)
+
+    if state.page == "services":
+        header = f"{'SERVICE':32.32s} {'PROTOCOL':24.24s} TOPIC"
+        screen.addnstr(1, 0, header, width - 1, curses.A_BOLD)
+        for row, fields in enumerate(state.services()[:height - 3]):
+            attribute = curses.A_REVERSE if row == state.selected_index \
+                else curses.A_NORMAL
+            protocol = fields.protocol.rsplit("/", 1)[-1]
+            line = (f"{fields.name:32.32s} {protocol:24.24s} "
+                    f"{fields.topic_path}")
+            screen.addnstr(2 + row, 0, line, width - 1, attribute)
+        footer = "↑/↓ select · ⏎ variables · l log · q quit"
+    elif state.page == "variables":
+        fields = state.selected()
+        screen.addnstr(1, 0, f"share: {fields.name if fields else '?'}",
+                       width - 1, curses.A_BOLD)
+        for row, (key, value) in enumerate(
+                state.flat_share()[:height - 3]):
+            screen.addnstr(2 + row, 0, f"{key:40.40s} {value}", width - 1)
+        footer = "b back · q quit"
+    else:
+        screen.addnstr(1, 0, f"log: {state._log_topic}", width - 1,
+                       curses.A_BOLD)
+        lines = list(state.log_lines)[-(height - 3):]
+        for row, line in enumerate(lines):
+            screen.addnstr(2 + row, 0, line, width - 1)
+        footer = "b back · q quit"
+    screen.addnstr(height - 1, 0, footer.ljust(width - 1), width - 1,
+                   curses.A_REVERSE)
+    screen.refresh()
+
+
+def run_dashboard(runtime, tick: float = 0.05) -> None:
+    """Blocking curses loop; drives the runtime's EventEngine inline
+    (reference refresh: 20 FPS, dashboard.py:217-219)."""
+    import curses
+
+    state = DashboardState(runtime)
+
+    def loop(screen):
+        curses.curs_set(0)
+        screen.nodelay(True)
+        while True:
+            for _ in range(8):
+                runtime.event.step()
+            key = screen.getch()
+            if key in (ord("q"), 27):
+                break
+            elif key in (curses.KEY_UP, ord("k")):
+                state.move(-1)
+            elif key in (curses.KEY_DOWN, ord("j")):
+                state.move(1)
+            elif key in (curses.KEY_ENTER, 10, 13) and \
+                    state.page == "services":
+                state.open_variables()
+            elif key == ord("l") and state.page == "services":
+                state.open_log()
+            elif key == ord("b"):
+                state.back()
+            _render(screen, state)
+            import time
+            time.sleep(tick)
+
+    try:
+        curses.wrapper(loop)
+    finally:
+        state.terminate()

@@ -1,0 +1,203 @@
+# Image elements: file I/O, transforms, annotation, and batched
+# classification on the ComputeRuntime.
+#
+# Capability parity with the reference image elements
+# (reference: aiko_services/elements/image_io.py:17-86 — PIL
+# StreamElements) rebuilt on the modern pipeline API, plus the ResNet
+# classify element (BASELINE.md config 2: "ResNet-18 image-classify
+# PipelineElement") the reference names but never ships.
+
+from __future__ import annotations
+
+from ..pipeline import DEFERRED, Frame, FrameOutput, PipelineElement
+
+__all__ = [
+    "PE_ImageReadFile", "PE_ImageWriteFile", "PE_ImageResize",
+    "PE_ImageAnnotate", "PE_ImageOverlay", "PE_ImageClassify",
+]
+
+
+class PE_ImageReadFile(PipelineElement):
+    """pathname (parameter or swag) → image [H, W, 3] uint8."""
+
+    def process_frame(self, frame: Frame, pathname=None, **_) -> FrameOutput:
+        import numpy as np
+        from PIL import Image
+
+        if pathname is None:
+            pathname, found = self.get_parameter("pathname",
+                                                 stream=frame.stream)
+            if not found:
+                return FrameOutput(False, diagnostic="no pathname")
+        image = Image.open(str(pathname)).convert("RGB")
+        return FrameOutput(True, {"image": np.asarray(image)})
+
+
+class PE_ImageWriteFile(PipelineElement):
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        import numpy as np
+        from PIL import Image
+
+        pathname, found = self.get_parameter("pathname",
+                                             stream=frame.stream)
+        if not found:
+            return FrameOutput(False, diagnostic="no pathname")
+        pathname = str(pathname).format(stream_id=frame.stream_id,
+                                        frame_id=frame.frame_id)
+        Image.fromarray(np.asarray(image).astype("uint8")).save(pathname)
+        return FrameOutput(True, {})
+
+
+class PE_ImageResize(PipelineElement):
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        import numpy as np
+        from PIL import Image
+
+        width, _ = self.get_parameter("width", 224, frame.stream)
+        height, _ = self.get_parameter("height", 224, frame.stream)
+        resized = Image.fromarray(np.asarray(image).astype("uint8")) \
+            .resize((int(width), int(height)))
+        return FrameOutput(True, {"image": np.asarray(resized)})
+
+
+class PE_ImageAnnotate(PipelineElement):
+    """Draws text + optional boxes onto the image
+    (reference: image_io.py ImageAnnotate*)."""
+
+    def process_frame(self, frame: Frame, image=None, text="",
+                      boxes=None, **_) -> FrameOutput:
+        import numpy as np
+        from PIL import Image, ImageDraw
+
+        pil = Image.fromarray(np.asarray(image).astype("uint8"))
+        draw = ImageDraw.Draw(pil)
+        if text:
+            draw.text((8, 8), str(text), fill=(255, 32, 32))
+        for box in boxes or []:
+            draw.rectangle([tuple(box[:2]), tuple(box[2:4])],
+                           outline=(32, 255, 32), width=2)
+        return FrameOutput(True, {"image": np.asarray(pil)})
+
+
+class PE_ImageOverlay(PipelineElement):
+    """Alpha-blend `overlay` onto `image` (reference: ImageOverlay)."""
+
+    def process_frame(self, frame: Frame, image=None, overlay=None,
+                      **_) -> FrameOutput:
+        import numpy as np
+
+        alpha, _ = self.get_parameter("alpha", 0.5, frame.stream)
+        image = np.asarray(image, dtype="float32")
+        overlay = np.asarray(overlay, dtype="float32")
+        if overlay.shape != image.shape:
+            from PIL import Image
+            overlay = np.asarray(Image.fromarray(
+                overlay.astype("uint8")).resize(
+                    (image.shape[1], image.shape[0])), dtype="float32")
+        blended = (1 - float(alpha)) * image + float(alpha) * overlay
+        return FrameOutput(True,
+                           {"image": blended.clip(0, 255).astype("uint8")})
+
+
+class PE_ImageClassify(PipelineElement):
+    """Batched ResNet classification through the ComputeRuntime
+    (BASELINE.md config 2).  Emits {"class_id", "confidence"}.
+
+    Parameters: preset (resnet18/resnet34), image_size, mode
+    ("batched"|"sync"), max_batch, max_wait, compute (service name)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._program = f"classify.{self.definition.name}"
+        self._setup_done = False
+
+    def _setup(self) -> None:
+        if self._setup_done:
+            return
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.resnet import (
+            RESNET_PRESETS, resnet_axes, resnet_forward, resnet_init)
+
+        preset, _ = self.get_parameter("preset", "resnet18")
+        image_size, _ = self.get_parameter("image_size", 224)
+        max_batch, _ = self.get_parameter("max_batch", 32)
+        max_wait, _ = self.get_parameter("max_wait", 0.05)
+        self.mode, _ = self.get_parameter("mode", "batched")
+        self.image_size = int(image_size)
+
+        compute_name, _ = self.get_parameter("compute", "compute")
+        self.compute = self.runtime.service_by_name(compute_name)
+        if self.compute is None:
+            raise RuntimeError(
+                f"classify element {self.name}: no ComputeRuntime "
+                f"service named {compute_name!r}")
+        config = RESNET_PRESETS[str(preset)]
+        params = resnet_init(jax.random.PRNGKey(0), config)
+        self.params = self.compute.place_params(params,
+                                                resnet_axes(params))
+
+        forward = jax.jit(
+            lambda images: resnet_forward(self.params, config, images))
+
+        def run_bucket(_bucket, images):
+            logits = forward(images)
+            probs = jax.nn.softmax(logits, axis=-1)
+            return (jnp.argmax(probs, axis=-1),
+                    jnp.max(probs, axis=-1))
+
+        def collate(_bucket, payloads):
+            images = np.stack([np.asarray(p, dtype="float32") / 255.0
+                               for p in payloads])
+            return jnp.asarray(images)
+
+        def split(results, count):
+            class_ids, confidences = (np.asarray(r) for r in results)
+            return [(int(class_ids[i]), float(confidences[i]))
+                    for i in range(count)]
+
+        self.compute.register_batched(
+            self._program, run_bucket, [self.image_size], collate, split,
+            max_batch=int(max_batch), max_wait=float(max_wait))
+        self._setup_done = True
+
+    def start_stream(self, stream) -> None:
+        self._setup()
+
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        import numpy as np
+
+        self._setup()
+        image = np.asarray(image)
+        if image.shape[0] != self.image_size or \
+                image.shape[1] != self.image_size:
+            from PIL import Image
+            image = np.asarray(Image.fromarray(
+                image.astype("uint8")).resize(
+                    (self.image_size, self.image_size)))
+
+        if self.mode == "sync":
+            box = {}
+            self.compute.submit(self._program, frame.stream_id, image,
+                                self.image_size,
+                                lambda _sid, r: box.setdefault("r", r))
+            self.compute.programs[self._program].scheduler.drain(
+                force=True)
+            result = box["r"]
+            if isinstance(result, Exception):
+                return FrameOutput(False, diagnostic=repr(result))
+            class_id, confidence = result
+            return FrameOutput(True, {"class_id": class_id,
+                                      "confidence": confidence})
+
+        def callback(_sid, result):
+            outputs = result if isinstance(result, Exception) else \
+                {"class_id": result[0], "confidence": result[1]}
+            self.pipeline.post("resume_frame", frame,
+                               self.definition.name, outputs)
+
+        self.compute.submit(self._program, frame.stream_id, image,
+                            self.image_size, callback)
+        return FrameOutput(True, DEFERRED)

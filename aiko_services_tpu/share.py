@@ -363,3 +363,13 @@ class ServicesCache:
 
     def get_services(self) -> Services:
         return self.services
+
+    def terminate(self) -> None:
+        """Detach all transport subscriptions and handlers."""
+        self.runtime.remove_message_handler(self._response_handler,
+                                            self.response_topic)
+        if self._registrar_out:
+            self.runtime.remove_message_handler(self._event_handler,
+                                                self._registrar_out)
+            self._registrar_out = None
+        self._handlers.clear()

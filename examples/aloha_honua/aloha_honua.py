@@ -1,0 +1,55 @@
+# Hello-world actor (reference: examples/aloha_honua/aloha_honua_0.py).
+#
+# Run (two terminals, or one with --self-test):
+#   aiko_tpu registrar &
+#   python examples/aloha_honua/aloha_honua.py
+#   # then publish "(aloha Pele)" to the actor's topic_in
+#
+# With --self-test everything (registrar, actor, caller) runs in one
+# process on the in-memory broker — no external services needed.
+
+from __future__ import annotations
+
+import os
+import sys
+
+# allow running straight from a source checkout
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import sys
+
+from aiko_services_tpu import Actor, ProcessRuntime, Registrar
+
+
+class AlohaHonua(Actor):
+    def __init__(self, runtime, name: str = "aloha_honua"):
+        super().__init__(runtime, name, share={"greetings": 0})
+
+    def aloha(self, name: str) -> None:
+        count = self.ec_producer.get("greetings", 0) + 1
+        self.ec_producer.update("greetings", count)
+        self.logger.info("Aloha %s! (%d greetings)", name, count)
+        print(f"Aloha {name}!")
+
+
+def main() -> None:
+    runtime = ProcessRuntime(name="aloha_honua").initialize()
+    if "--self-test" in sys.argv:
+        Registrar(runtime)
+        actor = AlohaHonua(runtime)
+        runtime.event.run_until(lambda: runtime.registrar is not None,
+                                timeout=6.0)
+        runtime.publish(actor.topic_in, "(aloha Pele)")
+        runtime.event.run_until(
+            lambda: actor.ec_producer.get("greetings", 0) >= 1,
+            timeout=6.0)
+        print("self-test ok:", actor.ec_producer.get("greetings"),
+              "greeting(s)")
+        runtime.terminate()
+        return
+    AlohaHonua(runtime)
+    runtime.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,210 @@
+# Media element tests: audio DSP chain, binary tensor transport, image
+# pipeline with batched classification, video file roundtrip, IoU tracker.
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.compute import ComputeRuntime
+from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+
+
+def element(name, inputs=(), outputs=(), parameters=None):
+    return {
+        "name": name,
+        "input": [{"name": n} for n in inputs],
+        "output": [{"name": n} for n in outputs],
+        "parameters": parameters or {},
+    }
+
+
+# -- audio DSP chain ---------------------------------------------------------
+
+def test_mic_sim_fft_filter_resample(make_runtime, engine):
+    """Simulated mic → FFT → band filter → 8-band resampler: the 440 Hz
+    tone lands in the lowest band."""
+    runtime = make_runtime("dsp_host").initialize()
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_dsp", "runtime": "jax",
+        "graph": ["(PE_MicrophoneSim (PE_FFT (PE_AudioFilter "
+                  "PE_AudioResampler)))"],
+        "elements": [
+            element("PE_MicrophoneSim", [], ["audio"],
+                    {"chunk_seconds": 0.25, "limit": 2,
+                     "frequency": 440.0}),
+            element("PE_FFT", ["audio"], ["frequencies", "magnitudes"]),
+            element("PE_AudioFilter", ["frequencies", "magnitudes"],
+                    ["frequencies", "magnitudes"],
+                    {"low_hz": 100.0, "high_hz": 2000.0}),
+            element("PE_AudioResampler", ["frequencies", "magnitudes"],
+                    ["bands"], {"band_count": 8}),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream("s1", lease_time=0)
+    for _ in range(40):
+        if len(done) >= 2:
+            break
+        engine.clock.advance(0.25)
+        engine.step()
+    assert len(done) >= 2
+    bands = np.asarray(done[0].swag["bands"])
+    assert bands.shape == (8,)
+    assert np.argmax(bands) == 0          # 440 Hz is in the lowest band
+
+
+def test_remote_tensor_roundtrip(make_runtime, engine):
+    """PE_RemoteSend → binary topic → PE_RemoteReceive across two logical
+    processes on the shared broker (zlib+npy tensor path)."""
+    from aiko_services_tpu.elements.audio import (
+        decode_tensor, encode_tensor)
+    tensor = np.arange(1000, dtype="float32").reshape(10, 100)
+    np.testing.assert_array_equal(decode_tensor(encode_tensor(tensor)),
+                                  tensor)
+
+    send_rt = make_runtime("send_host").initialize()
+    recv_rt = make_runtime("recv_host").initialize()
+    topic = "tensors/audio/1"
+
+    sender = Pipeline(send_rt, parse_pipeline_definition({
+        "version": 0, "name": "p_send", "runtime": "python",
+        "graph": ["(PE_RemoteSend)"],
+        "elements": [element("PE_RemoteSend", ["audio"], [],
+                             {"topic": topic})],
+    }), stream_lease_time=0)
+    receiver = Pipeline(recv_rt, parse_pipeline_definition({
+        "version": 0, "name": "p_recv", "runtime": "python",
+        "graph": ["(PE_RemoteReceive)"],
+        "elements": [element("PE_RemoteReceive", [], ["audio"],
+                             {"topic": topic})],
+    }), stream_lease_time=0)
+    received = []
+    receiver.add_frame_handler(received.append)
+    receiver.create_stream("r1", lease_time=0)
+    sender.create_stream("s1", lease_time=0)
+
+    audio = np.sin(np.linspace(0, 10, 4000)).astype("float32")
+    sender.process_frame("s1", {"audio": audio})
+    for _ in range(10):
+        engine.step()
+    assert len(received) == 1
+    np.testing.assert_allclose(received[0].swag["audio"], audio)
+
+
+# -- image pipeline ----------------------------------------------------------
+
+def test_image_read_resize_classify_annotate_write(make_runtime, engine,
+                                                   tmp_path):
+    from PIL import Image
+    source = tmp_path / "in.png"
+    rng = np.random.default_rng(1)
+    Image.fromarray(rng.integers(0, 255, (64, 48, 3),
+                                 dtype=np.uint8)).save(source)
+
+    runtime = make_runtime("img_host").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_img", "runtime": "jax",
+        "graph": ["(PE_ImageReadFile (PE_ImageResize PE_ImageClassify "
+                  "(PE_ImageAnnotate PE_ImageWriteFile)))"],
+        "parameters": {
+            "PE_ImageResize.width": 32, "PE_ImageResize.height": 32,
+            "PE_ImageClassify.image_size": 32,
+            "PE_ImageClassify.mode": "sync",
+            "PE_ImageWriteFile.pathname":
+                str(tmp_path / "out_{frame_id}.png"),
+        },
+        "elements": [
+            element("PE_ImageReadFile", [], ["image"]),
+            element("PE_ImageResize", ["image"], ["image"]),
+            element("PE_ImageClassify", ["image"],
+                    ["class_id", "confidence"]),
+            element("PE_ImageAnnotate", ["image"], ["image"]),
+            element("PE_ImageWriteFile", ["image"], []),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    pipeline.create_stream(
+        "s1", lease_time=0,
+        parameters={"PE_ImageReadFile.pathname": str(source)})
+    ok, swag = pipeline.process_frame("s1", {})
+    assert ok
+    assert isinstance(swag["class_id"], int)
+    assert 0.0 <= swag["confidence"] <= 1.0
+    assert (tmp_path / "out_0.png").exists()
+
+
+# -- video -------------------------------------------------------------------
+
+def test_video_read_write_roundtrip(make_runtime, engine, tmp_path):
+    import cv2
+    source = str(tmp_path / "in.mp4")
+    writer = cv2.VideoWriter(source, cv2.VideoWriter_fourcc(*"mp4v"),
+                             10.0, (64, 48))
+    rng = np.random.default_rng(2)
+    for _ in range(5):
+        writer.write(rng.integers(0, 255, (48, 64, 3), dtype=np.uint8))
+    writer.release()
+
+    runtime = make_runtime("vid_host").initialize()
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_vid", "runtime": "python",
+        "graph": ["(PE_VideoReadFile PE_VideoWriteFile)"],
+        "parameters": {
+            "PE_VideoReadFile.rate": 100.0,
+            "PE_VideoWriteFile.pathname":
+                str(tmp_path / "out_{stream_id}.mp4"),
+        },
+        "elements": [
+            element("PE_VideoReadFile", [], ["image"]),
+            element("PE_VideoWriteFile", ["image"], []),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream(
+        "s1", lease_time=0,
+        parameters={"PE_VideoReadFile.pathname": source})
+    for _ in range(60):
+        engine.clock.advance(0.01)
+        engine.step()
+    assert len(done) == 5
+    out = cv2.VideoCapture(str(tmp_path / "out_s1.mp4"))
+    count = 0
+    while out.read()[0]:
+        count += 1
+    assert count == 5
+
+
+# -- tracker -----------------------------------------------------------------
+
+def test_tracker_stable_ids_and_expiry(make_runtime, engine):
+    runtime = make_runtime("trk_host").initialize()
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_trk", "runtime": "python",
+        "graph": ["(PE_Tracker)"],
+        "elements": [element("PE_Tracker", ["boxes"], ["tracks"],
+                             {"max_age": 1})],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+
+    # frame 0: two objects
+    ok, swag = pipeline.process_frame(
+        "s1", {"boxes": [[0, 0, 10, 10], [50, 50, 80, 80]]})
+    ids0 = {tuple(t["box"]): t["track_id"] for t in swag["tracks"]}
+    assert len(set(ids0.values())) == 2
+
+    # frame 1: both moved slightly -> same ids
+    ok, swag = pipeline.process_frame(
+        "s1", {"boxes": [[2, 2, 12, 12], [52, 52, 82, 82]]})
+    ids1 = [t["track_id"] for t in swag["tracks"]]
+    assert set(ids1) == set(ids0.values())
+
+    # frames 2-3: objects gone; then a new one appears -> fresh id
+    pipeline.process_frame("s1", {"boxes": []})
+    pipeline.process_frame("s1", {"boxes": []})
+    ok, swag = pipeline.process_frame("s1", {"boxes": [[0, 0, 10, 10]]})
+    assert swag["tracks"][0]["track_id"] not in set(ids0.values())

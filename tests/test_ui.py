@@ -1,0 +1,90 @@
+# Ops UX tests: the dashboard state model (headless) and the CLI.
+
+import json
+
+from click.testing import CliRunner
+
+from aiko_services_tpu.actor import Actor
+from aiko_services_tpu.cli import main as cli_main
+from aiko_services_tpu.dashboard import DashboardState
+from aiko_services_tpu.registrar import Registrar
+
+
+def settle(engine, steps=10):
+    for _ in range(steps):
+        engine.step()
+
+
+def test_dashboard_state_tracks_services(make_runtime, engine):
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    dash_rt = make_runtime("dash_host").initialize()
+    state = DashboardState(dash_rt)
+    settle(engine)
+
+    app_rt = make_runtime("app_host").initialize()
+    actor = Actor(app_rt, "worker", share={"temperature": 21})
+    settle(engine, 15)
+
+    names = [fields.name for fields in state.services()]
+    assert "worker" in names and "registrar" in names
+
+    # select worker, open its variables (EC mirror)
+    state.selected_index = [f.name for f in state.services()].index(
+        "worker")
+    state.open_variables()
+    settle(engine, 15)
+    flat = dict(state.flat_share())
+    assert flat.get("temperature") == 21
+    assert flat.get("lifecycle") == "ready"
+
+    # dashboard updates a variable on the remote actor
+    state.update_variable("temperature", 30)
+    settle(engine, 10)
+    assert actor.ec_producer.get("temperature") == 30
+    assert dict(state.flat_share()).get("temperature") == 30
+
+    # log page tails the service's log topic
+    state.back()
+    state.open_log()
+    app_rt.publish(actor.topic_log, "hello from worker")
+    settle(engine, 6)
+    assert "hello from worker" in list(state.log_lines)
+    state.terminate()
+
+
+def test_cli_pipeline_show(tmp_path):
+    definition = {
+        "version": 0, "name": "p_cli", "runtime": "python",
+        "graph": ["(PE_1 PE_2)"],
+        "elements": [
+            {"name": "PE_1", "input": [{"name": "number"}],
+             "output": [{"name": "a"}]},
+            {"name": "PE_2", "input": [{"name": "a"}],
+             "output": [{"name": "b"}]},
+        ],
+    }
+    path = tmp_path / "def.json"
+    path.write_text(json.dumps(definition))
+    result = CliRunner().invoke(cli_main, ["pipeline", "show", str(path)])
+    assert result.exit_code == 0, result.output
+    assert "valid" in result.output
+    assert "PE_1" in result.output
+
+
+def test_cli_pipeline_show_invalid(tmp_path):
+    definition = {
+        "version": 0, "name": "p_bad", "runtime": "python",
+        "graph": ["(PE_1 PE_2)"],
+        "elements": [
+            {"name": "PE_1", "input": [], "output": []},
+            {"name": "PE_2", "input": [{"name": "zz"}], "output": []},
+        ],
+    }
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(definition))
+    result = CliRunner().invoke(cli_main, ["pipeline", "show", str(path)])
+    assert result.exit_code != 0
