@@ -20,3 +20,12 @@ from .collectives import (                                  # noqa: F401
 from .ring_attention import (                               # noqa: F401
     attention_reference, ring_attention, ring_attention_sharded,
 )
+from .checkpoint import (                                   # noqa: F401
+    CheckpointManager, restore_checkpoint, save_checkpoint,
+)
+from .pipeline_parallel import (                            # noqa: F401
+    StagedExecutor, gpipe_spmd, stage_device_groups,
+)
+from .train import (                                        # noqa: F401
+    TrainState, cross_entropy_loss, init_train_state, make_train_step,
+)

@@ -1,0 +1,137 @@
+# Checkpoint / resume for parameter and training state.
+#
+# The reference has NO checkpointing (SURVEY.md §5.4 "Absent"); its only
+# durable state is MQTT retained messages.  Here model/training state is a
+# first-class artifact: orbax (async-capable, sharding-aware) when
+# available, flat-npz fallback otherwise — the same '/'-joined key scheme
+# the speech element's weight loader reads, so checkpoints and weight
+# files interop.
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager",
+           "flatten_tree", "unflatten_into"]
+
+
+def flatten_tree(tree, prefix="") -> dict:
+    """pytree → {'/'-joined path: leaf} (dicts/lists only)."""
+    flat = {}
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif isinstance(tree, (list, tuple)):
+        items = enumerate(tree)
+    else:
+        return {prefix.rstrip("/"): tree}
+    for key, value in items:
+        path = f"{prefix}{key}"
+        if isinstance(value, (dict, list, tuple)):
+            flat.update(flatten_tree(value, prefix=f"{path}/"))
+        else:
+            flat[path] = value
+    return flat
+
+
+def unflatten_into(template, flat: dict):
+    """Rebuild a tree shaped like `template` from flatten_tree output;
+    every template leaf must be present."""
+    def build(node, prefix=""):
+        if isinstance(node, dict):
+            return {k: build(v, f"{prefix}{k}/") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            rebuilt = [build(v, f"{prefix}{i}/")
+                       for i, v in enumerate(node)]
+            if isinstance(node, tuple):
+                # namedtuples (optax states) construct from *args
+                if hasattr(node, "_fields"):
+                    return type(node)(*rebuilt)
+                return tuple(rebuilt)
+            return rebuilt
+        key = prefix.rstrip("/")
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf: {key}")
+        return flat[key]
+    return build(template)
+
+
+def save_checkpoint(directory: str, tree, step: int | None = None) -> str:
+    """Write `tree` under `directory` (npz + manifest).  Returns the
+    checkpoint path."""
+    import numpy as np
+
+    name = f"step_{step}" if step is not None else "checkpoint"
+    path = os.path.join(directory, name)
+    os.makedirs(path, exist_ok=True)
+    flat = flatten_tree(tree)
+    arrays = {key: np.asarray(value) for key, value in flat.items()}
+    np.savez(os.path.join(path, "state.npz"), **arrays)
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump({"step": step, "leaves": len(arrays)}, f)
+    return path
+
+
+def restore_checkpoint(path: str, template):
+    """Load a save_checkpoint dir back into `template`'s structure with
+    each leaf cast to the template leaf's dtype."""
+    import numpy as np
+
+    data = np.load(os.path.join(path, "state.npz"))
+    flat = {}
+    for key in data.files:
+        flat[key] = data[key]
+
+    def cast(leaf, loaded):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and hasattr(loaded, "astype"):
+            if tuple(getattr(leaf, "shape", ())) != tuple(loaded.shape):
+                raise ValueError(
+                    f"checkpoint leaf shape {loaded.shape} != template "
+                    f"{tuple(leaf.shape)}")
+            return loaded.astype(dtype)
+        # scalar python leaf (e.g. step counter)
+        return loaded.item() if hasattr(loaded, "item") and \
+            loaded.shape == () else loaded
+
+    template_flat = flatten_tree(template)
+    restored = {key: cast(template_flat[key], flat[key])
+                for key in template_flat}
+    return unflatten_into(template, restored)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (keep latest N)."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _steps(self) -> list[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            match = re.fullmatch(r"step_(\d+)", name)
+            if match:
+                steps.append(int(match.group(1)))
+        return sorted(steps)
+
+    def save(self, tree, step: int) -> str:
+        path = save_checkpoint(self.directory, tree, step)
+        for old in self._steps()[:-self.keep]:
+            old_path = os.path.join(self.directory, f"step_{old}")
+            import shutil
+            shutil.rmtree(old_path, ignore_errors=True)
+        return path
+
+    def latest_step(self) -> int | None:
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def restore_latest(self, template):
+        step = self.latest_step()
+        if step is None:
+            return None, None
+        path = os.path.join(self.directory, f"step_{step}")
+        return restore_checkpoint(path, template), step
