@@ -1,0 +1,77 @@
+# Native extension loader: compiles aiko_native.cpp (a CPython extension
+# module) on first use with g++, caches the .so next to the source, and
+# degrades gracefully when no toolchain is present — callers keep their
+# pure-Python fallbacks.
+#
+# Disable with AIKO_TPU_NATIVE=0 (e.g. to benchmark the fallbacks).
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+__all__ = ["load", "native_topic_matches", "native_parse_sexpr",
+           "NATIVE_AVAILABLE"]
+
+_here = os.path.dirname(os.path.abspath(_file_ := __file__))
+_source = os.path.join(_here, "aiko_native.cpp")
+_module = None
+_load_attempted = False
+
+
+def _build_path() -> str:
+    tag = sysconfig.get_config_var("SOABI") or "native"
+    return os.path.join(_here, f"_aiko_native.{tag}.so")
+
+
+def load():
+    """Compile (if needed) and import the extension; None on failure."""
+    global _module, _load_attempted
+    if _module is not None or _load_attempted:
+        return _module
+    _load_attempted = True
+    if os.environ.get("AIKO_TPU_NATIVE", "1") == "0":
+        return None
+    so_path = _build_path()
+    try:
+        if not os.path.exists(so_path) or \
+                os.path.getmtime(so_path) < os.path.getmtime(_source):
+            include = sysconfig.get_path("include")
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", f"-I{include}",
+                 "-o", so_path, _source],
+                check=True, capture_output=True, timeout=180)
+        spec = importlib.util.spec_from_file_location("_aiko_native",
+                                                      so_path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        from ..utils.sexpr import ParseError
+        module.set_parse_error(ParseError)
+        _module = module
+    except Exception:
+        _module = None
+    return _module
+
+
+def native_topic_matches(pattern: str, topic: str) -> bool:
+    module = load()
+    if module is None:
+        raise RuntimeError("native extension unavailable")
+    return module.topic_matches(pattern, topic)
+
+
+def native_parse_sexpr(payload: str):
+    """Parse via the C extension.  Raises RuntimeError for payloads the
+    native path does not cover (non-ASCII: length prefixes count
+    characters, the native scanner counts bytes)."""
+    module = load()
+    if module is None:
+        raise RuntimeError("native extension unavailable")
+    if not payload.isascii():
+        raise RuntimeError("non-ascii payload")
+    return module.parse_sexpr(payload)
+
+
+NATIVE_AVAILABLE = load() is not None

@@ -14,8 +14,7 @@ from typing import Callable
 __all__ = ["Message", "topic_matches"]
 
 
-def topic_matches(pattern: str, topic: str) -> bool:
-    """MQTT-style topic match: '+' one level, '#' trailing multi-level."""
+def _py_topic_matches(pattern: str, topic: str) -> bool:
     if pattern == topic:
         return True
     p_parts = pattern.split("/")
@@ -28,6 +27,29 @@ def topic_matches(pattern: str, topic: str) -> bool:
         if p != "+" and p != t_parts[i]:
             return False
     return len(p_parts) == len(t_parts)
+
+
+def _select_topic_matches():
+    try:
+        from ..native import NATIVE_AVAILABLE, native_topic_matches
+        if NATIVE_AVAILABLE:
+            return native_topic_matches
+    except Exception:
+        pass
+    return _py_topic_matches
+
+
+_impl_topic_matches = None
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT-style topic match: '+' one level, '#' trailing multi-level.
+    Native (C++) implementation when the toolchain built it; Python
+    fallback otherwise (parity tested in tests/test_native.py)."""
+    global _impl_topic_matches
+    if _impl_topic_matches is None:
+        _impl_topic_matches = _select_topic_matches()
+    return _impl_topic_matches(pattern, topic)
 
 
 class Message:

@@ -66,13 +66,35 @@ class _Raw(str):
     """An atom produced from a length-prefixed token (never a dict key)."""
 
 
+_native_parse = None
+
+
 def parse_sexpr(payload: str):
     """Parse a payload into nested Python lists/dicts of strings.
 
     A parenthesised group whose members all look like "key:" value pairs is
     returned as a dict (insertion-ordered); otherwise a list.  Top level must
     be a single expression; bare atoms are returned as-is.
-    """
+
+    Dispatches to the C extension (native/aiko_native.cpp) when built;
+    this function is the reference implementation and the fallback."""
+    global _native_parse
+    if _native_parse is None:
+        try:
+            from ..native import NATIVE_AVAILABLE, native_parse_sexpr
+            _native_parse = native_parse_sexpr if NATIVE_AVAILABLE \
+                else False
+        except Exception:
+            _native_parse = False
+    if _native_parse:
+        try:
+            return _native_parse(payload)
+        except RuntimeError:
+            pass        # non-ascii payload: fall through to Python
+    return _parse_sexpr_py(payload)
+
+
+def _parse_sexpr_py(payload: str):
     tokens = list(_tokenize(payload))
     if not tokens:
         return []
