@@ -21,9 +21,19 @@ from collections import deque
 from .share import ECConsumer, ServicesCache
 from .utils import generate
 
-__all__ = ["DashboardState", "run_dashboard"]
+__all__ = ["DashboardState", "run_dashboard", "register_plugin"]
 
 _LOG_LIMIT = 256
+
+# Plugin pages keyed by protocol name (reference: dashboard.py:719-723 +
+# dashboard_plugins.py): a plugin renders extra lines for a selected
+# service of its protocol.
+_PLUGINS: dict = {}
+
+
+def register_plugin(protocol_name: str, render) -> None:
+    """render(state, fields) -> list[str] shown under the share table."""
+    _PLUGINS[protocol_name] = render
 
 
 class DashboardState:
@@ -105,6 +115,21 @@ class DashboardState:
         self.close_log()
         self.page = "services"
 
+    def plugin_lines(self) -> list:
+        """Extra page content from the plugin registered for the selected
+        service's protocol."""
+        fields = self.selected()
+        if fields is None:
+            return []
+        protocol_name = fields.protocol.rsplit("/", 1)[-1].split(":")[0]
+        plugin = _PLUGINS.get(protocol_name)
+        if plugin is None:
+            return []
+        try:
+            return list(plugin(self, fields))
+        except Exception as exc:
+            return [f"plugin error: {exc!r}"]
+
     def flat_share(self) -> list:
         rows = []
         for key, value in sorted(self.share.items()):
@@ -145,9 +170,11 @@ def _render(screen, state: DashboardState) -> None:
         fields = state.selected()
         screen.addnstr(1, 0, f"share: {fields.name if fields else '?'}",
                        width - 1, curses.A_BOLD)
-        for row, (key, value) in enumerate(
-                state.flat_share()[:height - 3]):
-            screen.addnstr(2 + row, 0, f"{key:40.40s} {value}", width - 1)
+        rows = [f"{key:40.40s} {value}"
+                for key, value in state.flat_share()]
+        rows += state.plugin_lines()
+        for row, line in enumerate(rows[:height - 3]):
+            screen.addnstr(2 + row, 0, line, width - 1)
         footer = "b back · q quit"
     else:
         screen.addnstr(1, 0, f"log: {state._log_topic}", width - 1,

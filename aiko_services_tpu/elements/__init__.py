@@ -31,6 +31,7 @@ from .video import (                                        # noqa: F401
     PE_Tracker, PE_VideoCameraRead, PE_VideoReadFile, PE_VideoShow,
     PE_VideoWriteFile,
 )
+from .detect import PE_Detect, PE_LlamaAgent                # noqa: F401
 
 __all__ = [
     "PE_GenerateNumbers", "PE_Metrics", "PE_Identity",
@@ -44,4 +45,5 @@ __all__ = [
     "PE_ImageReadFile", "PE_ImageResize", "PE_ImageWriteFile",
     "PE_Tracker", "PE_VideoCameraRead", "PE_VideoReadFile", "PE_VideoShow",
     "PE_VideoWriteFile",
+    "PE_Detect", "PE_LlamaAgent",
 ]

@@ -1,0 +1,180 @@
+# Detection + agent elements: the BASELINE config 4 detect stage and the
+# config 5 LLM agent stage.
+
+from __future__ import annotations
+
+from ..pipeline import DEFERRED, Frame, FrameOutput, PipelineElement
+
+__all__ = ["PE_Detect", "PE_LlamaAgent"]
+
+
+class PE_Detect(PipelineElement):
+    """Batched object detection through the ComputeRuntime (the detect
+    stage of video → detect → tracker).  Emits {"boxes": [[x1,y1,x2,y2]..],
+    "scores", "classes"} with zero-score detections stripped host-side.
+
+    Parameters: preset (detector_r18/detector_test), image_size, mode,
+    score_threshold, max_batch, max_wait, compute."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._program = f"detect.{self.definition.name}"
+        self._setup_done = False
+
+    def _setup(self) -> None:
+        if self._setup_done:
+            return
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.detector import (
+            DETECTOR_PRESETS, detect, detector_axes, detector_init)
+
+        preset, _ = self.get_parameter("preset", "detector_r18")
+        image_size, _ = self.get_parameter("image_size", 256)
+        threshold, _ = self.get_parameter("score_threshold", 0.3)
+        max_batch, _ = self.get_parameter("max_batch", 16)
+        max_wait, _ = self.get_parameter("max_wait", 0.05)
+        self.mode, _ = self.get_parameter("mode", "batched")
+        self.image_size = int(image_size)
+
+        compute_name, _ = self.get_parameter("compute", "compute")
+        self.compute = self.runtime.service_by_name(compute_name)
+        if self.compute is None:
+            raise RuntimeError(f"detect element {self.name}: no "
+                               f"ComputeRuntime named {compute_name!r}")
+        config = DETECTOR_PRESETS[str(preset)]
+        params = detector_init(jax.random.PRNGKey(0), config)
+        self.params = self.compute.place_params(params,
+                                                detector_axes(params))
+        forward = jax.jit(functools.partial(
+            detect, config=config, score_threshold=float(threshold)))
+
+        def run_bucket(_bucket, images):
+            return forward(self.params, images=images)
+
+        def collate(_bucket, payloads):
+            return jnp.asarray(
+                np.stack([np.asarray(p, "float32") / 255.0
+                          for p in payloads]))
+
+        def split(results, count):
+            boxes, scores, classes = (np.asarray(r) for r in results)
+            out = []
+            for i in range(count):
+                keep = scores[i] > 0.0
+                out.append({"boxes": boxes[i][keep].tolist(),
+                            "scores": scores[i][keep].tolist(),
+                            "classes": classes[i][keep].tolist()})
+            return out
+
+        self.compute.register_batched(
+            self._program, run_bucket, [self.image_size], collate, split,
+            max_batch=int(max_batch), max_wait=float(max_wait))
+        self._setup_done = True
+
+    def start_stream(self, stream) -> None:
+        self._setup()
+
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        import numpy as np
+
+        self._setup()
+        image = np.asarray(image)
+        if image.shape[:2] != (self.image_size, self.image_size):
+            from PIL import Image
+            image = np.asarray(Image.fromarray(image.astype("uint8"))
+                               .resize((self.image_size,
+                                        self.image_size)))
+
+        if self.mode == "sync":
+            box = {}
+            self.compute.submit(self._program, frame.stream_id, image,
+                                self.image_size,
+                                lambda _sid, r: box.setdefault("r", r))
+            self.compute.programs[self._program].scheduler.drain(
+                force=True)
+            result = box["r"]
+            if isinstance(result, Exception):
+                return FrameOutput(False, diagnostic=repr(result))
+            return FrameOutput(True, result)
+
+        def callback(_sid, result):
+            self.pipeline.post("resume_frame", frame,
+                               self.definition.name, result)
+
+        self.compute.submit(self._program, frame.stream_id, image,
+                            self.image_size, callback)
+        return FrameOutput(True, DEFERRED)
+
+
+class PE_LlamaAgent(PipelineElement):
+    """LLM agent stage (BASELINE config 5: vision+ASR+Llama agent).
+
+    Takes `text` (e.g. an ASR transcript + telemetry), prompts the
+    decoder-only model, emits {"response", "response_tokens"}.  The model
+    is TP-sharded over the ComputeRuntime's mesh via its logical axes.
+
+    Tokenization is a pluggable hook (parameter-free byte fallback keeps
+    the element self-contained; a real BPE tokenizer drops in via the
+    `tokenizer`/`detokenizer` attributes)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._setup_done = False
+        self.tokenizer = lambda text: [b % 250 for b in
+                                       text.encode("utf-8")][:120]
+        self.detokenizer = lambda tokens: " ".join(str(t) for t in tokens)
+
+    def _setup(self) -> None:
+        if self._setup_done:
+            return
+        import jax
+
+        from ..models.llama import (
+            LLAMA_PRESETS, llama_axes, llama_greedy_decode, llama_init)
+
+        preset, _ = self.get_parameter("preset", "tiny")
+        max_tokens, _ = self.get_parameter("max_tokens", 16)
+        self.prompt_length, _ = self.get_parameter("prompt_length", 128)
+
+        compute_name, _ = self.get_parameter("compute", "compute")
+        self.compute = self.runtime.service_by_name(compute_name)
+        if self.compute is None:
+            raise RuntimeError(f"agent element {self.name}: no "
+                               f"ComputeRuntime named {compute_name!r}")
+        config = LLAMA_PRESETS[str(preset)]
+        params = llama_init(jax.random.PRNGKey(0), config)
+        self.params = self.compute.place_params(params,
+                                                llama_axes(config))
+        tokens = int(max_tokens)
+        self.compute.register_program(
+            f"agent.{self.definition.name}",
+            lambda params, prompt: llama_greedy_decode(
+                params, config, prompt, max_tokens=tokens))
+        self._pad = 0
+        self._setup_done = True
+
+    def start_stream(self, stream) -> None:
+        self._setup()
+
+    def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
+        import jax.numpy as jnp
+        import numpy as np
+
+        self._setup()
+        tokens = self.tokenizer(str(text)) or [1]
+        length = int(self.prompt_length)
+        padded = ([self._pad] * max(0, length - len(tokens)) +
+                  tokens)[-length:]
+        prompt = jnp.asarray([padded], jnp.int32)
+        generated = self.compute.run(f"agent.{self.definition.name}",
+                                     self.params, prompt)
+        generated = np.asarray(generated)[0].tolist()
+        return FrameOutput(True, {
+            "response_tokens": generated,
+            "response": self.detokenizer(generated),
+        })

@@ -1,0 +1,147 @@
+# Object detector: anchor-free center-point detection, TPU-native.
+#
+# Parity target: BASELINE.md config 4 ("gstreamer video → YOLOv8 detect →
+# tracker") — the reference names YOLO but ships no detector (SURVEY.md
+# §2).  Architecture: ResNet backbone → upsampled feature map → three
+# conv heads (class heatmap, box size, center offset), CenterNet-style.
+# Chosen over anchor-box designs because decode is pure tensor ops
+# (3×3 max-pool peak detection + top-k) — no NMS loops, no dynamic
+# shapes, everything jits onto the MXU/VPU.
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .resnet import (
+    ResNetConfig, _basic_block, _basic_block_init, _bn, _bn_init, _conv,
+    _conv_init, resnet_axes, resnet_init)
+
+__all__ = ["DetectorConfig", "detector_init", "detector_axes",
+           "detector_forward", "detect", "DETECTOR_PRESETS"]
+
+
+@dataclass(frozen=True)
+class DetectorConfig:
+    num_classes: int = 80
+    backbone: ResNetConfig = ResNetConfig(stage_sizes=(2, 2, 2, 2),
+                                          num_classes=1)
+    head_channels: int = 64
+    max_detections: int = 32
+    dtype: object = jnp.float32
+
+
+DETECTOR_PRESETS = {
+    "detector_r18": DetectorConfig(),
+    # CI/smoke geometry
+    "detector_test": DetectorConfig(
+        num_classes=4,
+        backbone=ResNetConfig(stage_sizes=(1, 1), num_classes=1, width=8),
+        head_channels=8, max_detections=8),
+}
+
+
+def detector_init(key, config: DetectorConfig):
+    keys = jax.random.split(key, 6)
+    dtype = config.dtype
+    backbone = resnet_init(keys[0], config.backbone)
+    backbone.pop("head")                # classification head unused
+    feature_ch = config.backbone.width * \
+        (2 ** (len(config.backbone.stage_sizes) - 1))
+    ch = config.head_channels
+    return {
+        "backbone": backbone,
+        "neck": _conv_init(keys[1], 3, feature_ch, ch, dtype),
+        "bn_neck": _bn_init(ch, dtype),
+        "head_heat": _conv_init(keys[2], 3, ch, config.num_classes,
+                                dtype),
+        "head_size": _conv_init(keys[3], 3, ch, 2, dtype),
+        "head_offset": _conv_init(keys[4], 3, ch, 2, dtype),
+    }
+
+
+def detector_axes(params):
+    backbone_axes = resnet_axes(
+        {**params["backbone"], "head": {"w": None, "b": None}})
+    backbone_axes.pop("head")
+    return {
+        "backbone": backbone_axes,
+        "neck": (None, None, None, "channels"),
+        "bn_neck": {"scale": ("channels",), "bias": ("channels",)},
+        "head_heat": (None, None, None, None),
+        "head_size": (None, None, None, None),
+        "head_offset": (None, None, None, None),
+    }
+
+
+def _backbone_features(params, config: ResNetConfig, images):
+    x = images
+    x = jax.nn.relu(_bn(params["bn_stem"], _conv(params["stem"], x, 2)))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for stage, stage_params in enumerate(params["stages"]):
+        for i, block in enumerate(stage_params):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            x = _basic_block(block, x, stride)
+    return x
+
+
+def detector_forward(params, config: DetectorConfig, images):
+    """images [B, H, W, 3] → (heatmap [B, h, w, C] logits,
+    sizes [B, h, w, 2], offsets [B, h, w, 2]) at backbone stride."""
+    x = images.astype(config.dtype)
+    features = _backbone_features(params["backbone"], config.backbone, x)
+    neck = jax.nn.relu(_bn(params["bn_neck"],
+                           _conv(params["neck"], features)))
+    heatmap = _conv(params["head_heat"], neck)
+    sizes = jax.nn.softplus(_conv(params["head_size"], neck))
+    offsets = _conv(params["head_offset"], neck)
+    return heatmap, sizes, offsets
+
+
+def detect(params, config: DetectorConfig, images,
+           score_threshold: float = 0.3):
+    """Full detection: forward + peak decode.  Returns
+    (boxes [B, K, 4] in input pixels (x1,y1,x2,y2), scores [B, K],
+    classes [B, K]) with K = config.max_detections, zero-padded —
+    static shapes throughout (one compilation per image size)."""
+    heatmap, sizes, offsets = detector_forward(params, config, images)
+    b, h, w, c = heatmap.shape
+    stride = images.shape[1] // h
+    scores_map = jax.nn.sigmoid(heatmap.astype(jnp.float32))
+
+    # peaks: a cell survives when it equals its 3x3 neighbourhood max
+    pooled = jax.lax.reduce_window(
+        scores_map, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 1, 1, 1),
+        "SAME")
+    peaks = jnp.where(scores_map == pooled, scores_map, 0.0)
+
+    flat = peaks.reshape(b, h * w * c)
+    k = min(config.max_detections, h * w * c)
+    top_scores, top_idx = jax.lax.top_k(flat, k)
+    cell = top_idx // c
+    classes = top_idx % c
+    ys = (cell // w).astype(jnp.float32)
+    xs = (cell % w).astype(jnp.float32)
+
+    def gather_hw(grid):
+        flat_grid = grid.reshape(b, h * w, grid.shape[-1])
+        return jnp.take_along_axis(flat_grid, cell[..., None], axis=1)
+
+    size = gather_hw(sizes)                          # [B, K, 2] in cells
+    offset = jnp.tanh(gather_hw(offsets))            # [-1,1] cell units
+
+    cx = (xs + 0.5 + offset[..., 0]) * stride
+    cy = (ys + 0.5 + offset[..., 1]) * stride
+    half_w = size[..., 0] * stride * 0.5
+    half_h = size[..., 1] * stride * 0.5
+    boxes = jnp.stack([cx - half_w, cy - half_h,
+                       cx + half_w, cy + half_h], axis=-1)
+    keep = top_scores >= score_threshold
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = jnp.where(keep, top_scores, 0.0)
+    classes = jnp.where(keep, classes, -1)
+    return boxes, scores, classes

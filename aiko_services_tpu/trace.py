@@ -1,0 +1,104 @@
+# Method tracing: AOP-style interceptors on service objects.
+#
+# Capability parity with the reference proxy layer
+# (reference: aiko_services/proxy.py:39-72 — wrapt-based ProxyAllMethods +
+# proxy_trace enter/exit): wraps every public method of an instance with
+# an interceptor.  No wrapt dependency; wrapping is per-instance
+# (instance attributes shadow class methods) and reversible.
+#
+# Beyond the reference: TraceCollector records structured spans (name,
+# wall time, nesting depth) instead of printing — feeding the same
+# metrics surface the pipeline uses (SURVEY.md §5.1: the reference has
+# "no span/trace IDs").
+
+from __future__ import annotations
+
+import functools
+import itertools
+import time
+
+__all__ = ["trace_all_methods", "untrace", "print_tracer",
+           "TraceCollector", "Span"]
+
+_span_ids = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("span_id", "parent_id", "name", "start", "duration",
+                 "error")
+
+    def __init__(self, span_id, parent_id, name, start):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = start
+        self.duration = None
+        self.error = None
+
+    def __repr__(self):
+        ms = f"{self.duration * 1e3:.2f}ms" if self.duration is not None \
+            else "…"
+        return f"Span({self.name} {ms})"
+
+
+class TraceCollector:
+    """Interceptor that records spans with caller/callee nesting."""
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self.spans: list[Span] = []
+        self._stack: list[Span] = []
+
+    def __call__(self, name, method, args, kwargs):
+        parent = self._stack[-1].span_id if self._stack else None
+        span = Span(next(_span_ids), parent, name, self.clock())
+        self.spans.append(span)
+        self._stack.append(span)
+        try:
+            return method(*args, **kwargs)
+        except Exception as exc:
+            span.error = repr(exc)
+            raise
+        finally:
+            span.duration = self.clock() - span.start
+            self._stack.pop()
+
+
+def print_tracer(name, method, args, kwargs):
+    """The reference's proxy_trace equivalent: enter/exit prints."""
+    print(f"TRACE enter {name}{args!r}")
+    try:
+        return method(*args, **kwargs)
+    finally:
+        print(f"TRACE exit  {name}")
+
+
+def trace_all_methods(instance, interceptor, only=None) -> list[str]:
+    """Wrap every public bound method of `instance` with
+    interceptor(name, method, args, kwargs).  Returns the wrapped names.
+    `only` restricts to the given method names."""
+    wrapped = []
+    for name in dir(instance):
+        if name.startswith("_"):
+            continue
+        if only is not None and name not in only:
+            continue
+        method = getattr(instance, name)
+        if not callable(method) or not hasattr(method, "__self__"):
+            continue
+
+        @functools.wraps(method)
+        def wrapper(*args, _name=name, _method=method, **kwargs):
+            return interceptor(_name, _method, args, kwargs)
+
+        wrapper.__traced__ = method
+        instance.__dict__[name] = wrapper
+        wrapped.append(name)
+    return wrapped
+
+
+def untrace(instance) -> None:
+    """Remove all trace wrappers installed by trace_all_methods."""
+    for name, value in list(instance.__dict__.items()):
+        if hasattr(value, "__traced__"):
+            del instance.__dict__[name]

@@ -16,10 +16,12 @@ import dataclasses
 __all__ = [
     "get_namespace", "get_hostname", "get_pid", "get_username",
     "TransportConfig", "get_transport_configuration",
+    "BootstrapResponder", "discover_bootstrap", "BOOTSTRAP_PORT",
 ]
 
 _DEFAULT_NAMESPACE = "aiko"
 _DEFAULT_MQTT_PORT = 1883
+BOOTSTRAP_PORT = 4149       # reference: utilities/configuration.py:136-162
 
 
 def _env(name: str, default=None):
@@ -73,3 +75,74 @@ def get_transport_configuration() -> TransportConfig:
         password=_env("PASSWORD"),
         tls=str(_env("MQTT_TLS", "")).lower() in ("1", "true", "yes"),
     )
+
+
+# -- UDP broadcast bootstrap (DNS-less device discovery) ---------------------
+# Protocol parity with the reference (utilities/configuration.py:136-162):
+# a device broadcasts "boot?" on BOOTSTRAP_PORT; any host running a
+# responder answers "boot <host> <port>" with its transport endpoint.
+
+class BootstrapResponder:
+    """Answers "boot?" broadcasts with this host's transport endpoint.
+    Runs a small daemon thread (network I/O, not event-loop work)."""
+
+    def __init__(self, host: str | None = None, port: int | None = None,
+                 bind: str = "", bootstrap_port: int = BOOTSTRAP_PORT):
+        config = get_transport_configuration()
+        self.host = host or config.host
+        self.port = port or config.port
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind, bootstrap_port))
+        self._sock.settimeout(0.5)
+        self._running = True
+        import threading
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while self._running:
+            try:
+                data, address = self._sock.recvfrom(64)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if data.strip() == b"boot?":
+                reply = f"boot {self.host} {self.port}".encode()
+                try:
+                    self._sock.sendto(reply, address)
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._running = False
+        self._sock.close()
+
+
+def discover_bootstrap(timeout: float = 2.0,
+                       bootstrap_port: int = BOOTSTRAP_PORT):
+    """Broadcast "boot?" and return (host, port) of the first responder,
+    or None — lets DNS-less devices find the control-plane broker."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(b"boot?", ("255.255.255.255", bootstrap_port))
+    except OSError:
+        # broadcast unavailable (containers): try loopback
+        try:
+            sock.sendto(b"boot?", ("127.0.0.1", bootstrap_port))
+        except OSError:
+            sock.close()
+            return None
+    try:
+        while True:
+            data, _address = sock.recvfrom(128)
+            parts = data.decode(errors="replace").split()
+            if len(parts) == 3 and parts[0] == "boot":
+                return parts[1], int(parts[2])
+    except (socket.timeout, ValueError):
+        return None
+    finally:
+        sock.close()

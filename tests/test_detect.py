@@ -1,0 +1,132 @@
+# Detection model + detect/tracker/agent pipeline tests.
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aiko_services_tpu.compute import ComputeRuntime
+from aiko_services_tpu.models.detector import (
+    DETECTOR_PRESETS, detect, detector_axes, detector_forward,
+    detector_init)
+from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+
+TEST_CONFIG = DETECTOR_PRESETS["detector_test"]
+
+
+def element(name, inputs=(), outputs=(), parameters=None):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "parameters": parameters or {}}
+
+
+# -- model -------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def detector_params():
+    return detector_init(jax.random.PRNGKey(0), TEST_CONFIG)
+
+
+def test_detector_forward_shapes(detector_params):
+    images = jnp.zeros((2, 64, 64, 3))
+    heatmap, sizes, offsets = detector_forward(detector_params,
+                                               TEST_CONFIG, images)
+    # stride 8: stem /2, maxpool /2, stage1 stride 2 (width 8, 2 stages)
+    assert heatmap.shape[0] == 2 and heatmap.shape[-1] == 4
+    assert sizes.shape[-1] == 2 and offsets.shape[-1] == 2
+    assert heatmap.shape[1] == heatmap.shape[2]
+
+
+def test_detect_static_shapes_and_jit(detector_params):
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    fn = jax.jit(lambda x: detect(detector_params, TEST_CONFIG, x,
+                                  score_threshold=0.0))
+    boxes, scores, classes = fn(images)
+    k = TEST_CONFIG.max_detections
+    assert boxes.shape == (2, k, 4)
+    assert scores.shape == (2, k) and classes.shape == (2, k)
+    # scores sorted descending (top_k contract)
+    s = np.asarray(scores)
+    assert np.all(np.diff(s, axis=1) <= 1e-6)
+
+
+def test_detect_threshold_zeroes(detector_params):
+    images = jnp.zeros((1, 64, 64, 3))
+    boxes, scores, classes = detect(detector_params, TEST_CONFIG, images,
+                                    score_threshold=1.1)  # nothing passes
+    assert np.all(np.asarray(scores) == 0.0)
+    assert np.all(np.asarray(classes) == -1)
+    assert np.all(np.asarray(boxes) == 0.0)
+
+
+def test_detector_params_shard(detector_params):
+    from aiko_services_tpu.parallel import create_mesh, shard_pytree
+    mesh = create_mesh({"data": 8})
+    placed = shard_pytree(detector_params, detector_axes(detector_params),
+                          mesh)
+    assert placed["neck"].shape == detector_params["neck"].shape
+
+
+# -- detect -> tracker pipeline ---------------------------------------------
+
+def test_detect_tracker_pipeline(make_runtime, engine):
+    runtime = make_runtime("det_host").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_det", "runtime": "jax",
+        "graph": ["(PE_Detect PE_Tracker)"],
+        "parameters": {
+            "PE_Detect.preset": "detector_test",
+            "PE_Detect.image_size": 64,
+            "PE_Detect.mode": "sync",
+            "PE_Detect.score_threshold": 0.0,
+        },
+        "elements": [
+            element("PE_Detect", ["image"],
+                    ["boxes", "scores", "classes"]),
+            element("PE_Tracker", ["boxes"], ["tracks"]),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+    rng = np.random.default_rng(0)
+    image = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    ok, swag = pipeline.process_frame("s1", {"image": image})
+    assert ok
+    assert len(swag["boxes"]) > 0            # threshold 0: peaks survive
+    assert len(swag["tracks"]) == len(swag["boxes"])
+    # same image again: tracker keeps ids stable
+    first_ids = [t["track_id"] for t in swag["tracks"]]
+    ok, swag = pipeline.process_frame("s1", {"image": image})
+    assert [t["track_id"] for t in swag["tracks"]] == first_ids
+
+
+# -- agent -------------------------------------------------------------------
+
+def test_llama_agent_element(make_runtime, engine):
+    runtime = make_runtime("agent_host").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_agent", "runtime": "jax",
+        "graph": ["(PE_LlamaAgent)"],
+        "parameters": {
+            "PE_LlamaAgent.preset": "tiny",
+            "PE_LlamaAgent.max_tokens": 4,
+            "PE_LlamaAgent.prompt_length": 16,
+        },
+        "elements": [
+            element("PE_LlamaAgent", ["text"],
+                    ["response", "response_tokens"]),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+    ok, swag = pipeline.process_frame("s1", {"text": "move forward"})
+    assert ok
+    assert len(swag["response_tokens"]) == 4
+    assert isinstance(swag["response"], str)
+    # deterministic greedy decode
+    ok, swag2 = pipeline.process_frame("s1", {"text": "move forward"})
+    assert swag2["response_tokens"] == swag["response_tokens"]

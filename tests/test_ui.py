@@ -88,3 +88,102 @@ def test_cli_pipeline_show_invalid(tmp_path):
     path.write_text(json.dumps(definition))
     result = CliRunner().invoke(cli_main, ["pipeline", "show", str(path)])
     assert result.exit_code != 0
+
+
+def test_dashboard_plugin_renders(make_runtime, engine):
+    from aiko_services_tpu.dashboard import register_plugin, _PLUGINS
+    reg_rt = make_runtime("regp_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+    state = DashboardState(reg_rt)
+    settle(engine, 10)
+    register_plugin(
+        "registrar",
+        lambda st, fields: [f"services: {len(st.services())}"])
+    try:
+        idx = [f.name for f in state.services()].index("registrar")
+        state.selected_index = idx
+        lines = state.plugin_lines()
+        assert lines == [f"services: {len(state.services())}"]
+    finally:
+        _PLUGINS.clear()
+        state.terminate()
+
+
+def test_trace_collector_spans(make_runtime):
+    from aiko_services_tpu.trace import (
+        TraceCollector, trace_all_methods, untrace)
+
+    class Thing:
+        def outer(self, x):
+            return self.inner(x) + 1
+
+        def inner(self, x):
+            return x * 2
+
+    thing = Thing()
+    collector = TraceCollector()
+    wrapped = trace_all_methods(thing, collector)
+    assert set(wrapped) == {"outer", "inner"}
+    assert thing.outer(5) == 11
+    names = [s.name for s in collector.spans]
+    assert names == ["outer", "inner"]
+    # nesting: inner's parent is outer
+    assert collector.spans[1].parent_id == collector.spans[0].span_id
+    assert all(s.duration is not None for s in collector.spans)
+    untrace(thing)
+    thing.outer(1)
+    assert len(collector.spans) == 2          # wrappers removed
+
+
+def test_legacy_stream_element(make_runtime):
+    from aiko_services_tpu.legacy import StreamElement, StreamElementState
+    from aiko_services_tpu.pipeline import (
+        Pipeline, parse_pipeline_definition)
+
+    events = []
+
+    class OldStyle(StreamElement):
+        def stream_start_handler(self, stream, stream_id):
+            events.append(("start", stream_id))
+            return True, {}
+
+        def stream_frame_handler(self, stream, frame_id, swag):
+            events.append(("frame", frame_id))
+            return True, {"doubled": swag["number"] * 2}
+
+        def stream_stop_handler(self, stream, stream_id):
+            events.append(("stop", stream_id))
+            return True, {}
+
+    runtime = make_runtime("legacy_host").initialize()
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_legacy", "runtime": "python",
+        "graph": ["(OldStyle)"],
+        "elements": [{"name": "OldStyle",
+                      "input": [{"name": "number"}],
+                      "output": [{"name": "doubled"}]}],
+    })
+    pipeline = Pipeline(runtime, definition,
+                        element_classes={"OldStyle": OldStyle},
+                        stream_lease_time=0)
+    stream = pipeline.create_stream("s1", lease_time=0)
+    element = pipeline.graph.node("OldStyle").element
+    assert element.get_state(stream) == StreamElementState.RUN
+    ok, swag = pipeline.process_frame("s1", {"number": 21})
+    assert ok and swag["doubled"] == 42
+    pipeline.destroy_stream("s1")
+    assert events == [("start", "s1"), ("frame", 0), ("stop", "s1")]
+
+
+def test_bootstrap_discovery_loopback():
+    from aiko_services_tpu.utils.configuration import (
+        BootstrapResponder, discover_bootstrap)
+    responder = BootstrapResponder(host="broker.local", port=1883,
+                                   bootstrap_port=41491)
+    try:
+        result = discover_bootstrap(timeout=3.0, bootstrap_port=41491)
+        assert result == ("broker.local", 1883)
+    finally:
+        responder.stop()
