@@ -170,9 +170,11 @@ def _render(screen, state: DashboardState) -> None:
         fields = state.selected()
         screen.addnstr(1, 0, f"share: {fields.name if fields else '?'}",
                        width - 1, curses.A_BOLD)
-        rows = [f"{key:40.40s} {value}"
-                for key, value in state.flat_share()]
-        rows += state.plugin_lines()
+        # plugin lines first: they must stay visible even when the share
+        # table alone exceeds the screen
+        rows = state.plugin_lines()
+        rows += [f"{key:40.40s} {value}"
+                 for key, value in state.flat_share()]
         for row, line in enumerate(rows[:height - 3]):
             screen.addnstr(2 + row, 0, line, width - 1)
         footer = "b back · q quit"

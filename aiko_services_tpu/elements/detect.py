@@ -133,6 +133,8 @@ class PE_LlamaAgent(PipelineElement):
         if self._setup_done:
             return
         import jax
+        import jax.numpy as jnp
+        import numpy as np
 
         from ..models.llama import (
             LLAMA_PRESETS, llama_axes, llama_greedy_decode, llama_init)
@@ -140,6 +142,10 @@ class PE_LlamaAgent(PipelineElement):
         preset, _ = self.get_parameter("preset", "tiny")
         max_tokens, _ = self.get_parameter("max_tokens", 16)
         self.prompt_length, _ = self.get_parameter("prompt_length", 128)
+        max_batch, _ = self.get_parameter("max_batch", 8)
+        max_wait, _ = self.get_parameter("max_wait", 0.05)
+        self.mode, _ = self.get_parameter("mode", "batched")
+        self._program = f"agent.{self.definition.name}"
 
         compute_name, _ = self.get_parameter("compute", "compute")
         self.compute = self.runtime.service_by_name(compute_name)
@@ -151,30 +157,63 @@ class PE_LlamaAgent(PipelineElement):
         self.params = self.compute.place_params(params,
                                                 llama_axes(config))
         tokens = int(max_tokens)
-        self.compute.register_program(
-            f"agent.{self.definition.name}",
-            lambda params, prompt: llama_greedy_decode(
-                params, config, prompt, max_tokens=tokens))
-        self._pad = 0
+        decode = jax.jit(lambda params, prompt: llama_greedy_decode(
+            params, config, prompt, max_tokens=tokens))
+
+        def run_bucket(_bucket, prompts):
+            return decode(self.params, prompts)
+
+        def collate(_bucket, payloads):
+            return jnp.asarray(np.stack(payloads), jnp.int32)
+
+        def split(results, count):
+            generated = np.asarray(results)
+            return [generated[i].tolist() for i in range(count)]
+
+        self.compute.register_batched(
+            self._program, run_bucket, [int(self.prompt_length)],
+            collate, split, max_batch=int(max_batch),
+            max_wait=float(max_wait))
         self._setup_done = True
 
     def start_stream(self, stream) -> None:
         self._setup()
 
-    def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
-        import jax.numpy as jnp
+    def _pad_prompt(self, text):
         import numpy as np
 
-        self._setup()
         tokens = self.tokenizer(str(text)) or [1]
         length = int(self.prompt_length)
-        padded = ([self._pad] * max(0, length - len(tokens)) +
-                  tokens)[-length:]
-        prompt = jnp.asarray([padded], jnp.int32)
-        generated = self.compute.run(f"agent.{self.definition.name}",
-                                     self.params, prompt)
-        generated = np.asarray(generated)[0].tolist()
-        return FrameOutput(True, {
-            "response_tokens": generated,
-            "response": self.detokenizer(generated),
-        })
+        padded = ([0] * max(0, length - len(tokens)) + tokens)[-length:]
+        return np.asarray(padded, np.int32)
+
+    def _to_outputs(self, generated):
+        return {"response_tokens": generated,
+                "response": self.detokenizer(generated)}
+
+    def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
+        self._setup()
+        prompt = self._pad_prompt(text)
+        length = int(self.prompt_length)
+
+        if self.mode == "sync":
+            box = {}
+            self.compute.submit(self._program, frame.stream_id, prompt,
+                                length,
+                                lambda _sid, r: box.setdefault("r", r))
+            self.compute.programs[self._program].scheduler.drain(
+                force=True)
+            result = box["r"]
+            if isinstance(result, Exception):
+                return FrameOutput(False, diagnostic=repr(result))
+            return FrameOutput(True, self._to_outputs(result))
+
+        def callback(_sid, result):
+            outputs = result if isinstance(result, Exception) else \
+                self._to_outputs(result)
+            self.pipeline.post("resume_frame", frame,
+                               self.definition.name, outputs)
+
+        self.compute.submit(self._program, frame.stream_id, prompt,
+                            length, callback)
+        return FrameOutput(True, DEFERRED)

@@ -17,8 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from .resnet import (
-    ResNetConfig, _basic_block, _basic_block_init, _bn, _bn_init, _conv,
-    _conv_init, resnet_axes, resnet_init)
+    ResNetConfig, _bn, _bn_init, _conv, _conv_init, resnet_axes,
+    resnet_features, resnet_init)
 
 __all__ = ["DetectorConfig", "detector_init", "detector_axes",
            "detector_forward", "detect", "DETECTOR_PRESETS"]
@@ -77,23 +77,11 @@ def detector_axes(params):
     }
 
 
-def _backbone_features(params, config: ResNetConfig, images):
-    x = images
-    x = jax.nn.relu(_bn(params["bn_stem"], _conv(params["stem"], x, 2)))
-    x = jax.lax.reduce_window(
-        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
-    for stage, stage_params in enumerate(params["stages"]):
-        for i, block in enumerate(stage_params):
-            stride = 2 if (stage > 0 and i == 0) else 1
-            x = _basic_block(block, x, stride)
-    return x
-
-
 def detector_forward(params, config: DetectorConfig, images):
     """images [B, H, W, 3] → (heatmap [B, h, w, C] logits,
     sizes [B, h, w, 2], offsets [B, h, w, 2]) at backbone stride."""
     x = images.astype(config.dtype)
-    features = _backbone_features(params["backbone"], config.backbone, x)
+    features = resnet_features(params["backbone"], x)
     neck = jax.nn.relu(_bn(params["bn_neck"],
                            _conv(params["neck"], features)))
     heatmap = _conv(params["head_heat"], neck)
@@ -140,7 +128,10 @@ def detect(params, config: DetectorConfig, images,
     half_h = size[..., 1] * stride * 0.5
     boxes = jnp.stack([cx - half_w, cy - half_h,
                        cx + half_w, cy + half_h], axis=-1)
-    keep = top_scores >= score_threshold
+    # suppressed cells carry exactly 0.0 after the peak mask: require a
+    # strictly positive score so padding rows honour the zero-padded
+    # contract even at threshold <= 0
+    keep = (top_scores >= score_threshold) & (top_scores > 0.0)
     boxes = jnp.where(keep[..., None], boxes, 0.0)
     scores = jnp.where(keep, top_scores, 0.0)
     classes = jnp.where(keep, classes, -1)

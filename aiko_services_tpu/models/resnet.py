@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["ResNetConfig", "resnet_init", "resnet_axes", "resnet_forward",
-           "RESNET_PRESETS"]
+           "resnet_features", "RESNET_PRESETS"]
 
 
 @dataclass(frozen=True)
@@ -128,9 +128,11 @@ def resnet_axes(params):
     }
 
 
-def resnet_forward(params, config: ResNetConfig, images):
-    """images: [B, H, W, 3] → logits [B, num_classes]."""
-    x = images.astype(config.dtype)
+def resnet_features(params, images):
+    """Backbone feature extractor: images [B, H, W, 3] → feature map at
+    the final stage's stride (shared by the classifier head here and the
+    detector in models/detector.py)."""
+    x = images
     x = jax.nn.relu(_bn(params["bn_stem"], _conv(params["stem"], x, 2)))
     x = jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
@@ -138,6 +140,12 @@ def resnet_forward(params, config: ResNetConfig, images):
         for i, block in enumerate(stage_params):
             stride = 2 if (stage > 0 and i == 0) else 1
             x = _basic_block(block, x, stride)
+    return x
+
+
+def resnet_forward(params, config: ResNetConfig, images):
+    """images: [B, H, W, 3] → logits [B, num_classes]."""
+    x = resnet_features(params, images.astype(config.dtype))
     x = jnp.mean(x, axis=(1, 2))                       # global avg pool
     logits = x.astype(jnp.float32) @ params["head"]["w"].astype(
         jnp.float32) + params["head"]["b"]

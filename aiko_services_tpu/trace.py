@@ -77,11 +77,18 @@ def trace_all_methods(instance, interceptor, only=None) -> list[str]:
     """Wrap every public bound method of `instance` with
     interceptor(name, method, args, kwargs).  Returns the wrapped names.
     `only` restricts to the given method names."""
+    import inspect
+
     wrapped = []
     for name in dir(instance):
         if name.startswith("_"):
             continue
         if only is not None and name not in only:
+            continue
+        # inspect statically first: plain getattr would EXECUTE property
+        # getters (service classes here define several, with side effects)
+        static = inspect.getattr_static(instance, name, None)
+        if not (inspect.isfunction(static) or inspect.ismethod(static)):
             continue
         method = getattr(instance, name)
         if not callable(method) or not hasattr(method, "__self__"):

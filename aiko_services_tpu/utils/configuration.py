@@ -140,9 +140,13 @@ def discover_bootstrap(timeout: float = 2.0,
         while True:
             data, _address = sock.recvfrom(128)
             parts = data.decode(errors="replace").split()
-            if len(parts) == 3 and parts[0] == "boot":
+            if len(parts) != 3 or parts[0] != "boot":
+                continue            # stray datagram: keep listening
+            try:
                 return parts[1], int(parts[2])
-    except (socket.timeout, ValueError):
+            except ValueError:
+                continue            # malformed port: keep listening
+    except socket.timeout:
         return None
     finally:
         sock.close()

@@ -115,6 +115,7 @@ def test_llama_agent_element(make_runtime, engine):
             "PE_LlamaAgent.preset": "tiny",
             "PE_LlamaAgent.max_tokens": 4,
             "PE_LlamaAgent.prompt_length": 16,
+            "PE_LlamaAgent.mode": "sync",
         },
         "elements": [
             element("PE_LlamaAgent", ["text"],
@@ -130,3 +131,37 @@ def test_llama_agent_element(make_runtime, engine):
     # deterministic greedy decode
     ok, swag2 = pipeline.process_frame("s1", {"text": "move forward"})
     assert swag2["response_tokens"] == swag["response_tokens"]
+
+
+def test_llama_agent_batched_coalesces(make_runtime, engine):
+    """Deferred agent frames from several streams batch into one decode."""
+    runtime = make_runtime("agentb_host").initialize()
+    compute = ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_agentb", "runtime": "jax",
+        "graph": ["(PE_LlamaAgent)"],
+        "parameters": {
+            "PE_LlamaAgent.preset": "tiny",
+            "PE_LlamaAgent.max_tokens": 2,
+            "PE_LlamaAgent.prompt_length": 16,
+            "PE_LlamaAgent.max_wait": 0.02,
+        },
+        "elements": [
+            element("PE_LlamaAgent", ["text"],
+                    ["response", "response_tokens"]),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    for i in range(4):
+        pipeline.create_stream(f"s{i}", lease_time=0)
+        pipeline.post("process_frame", f"s{i}", {"text": f"cmd {i}"})
+    for _ in range(400):
+        if len(done) == 4:
+            break
+        engine.clock.advance(0.005)
+        engine.step()
+    assert len(done) == 4
+    stats = compute.programs["agent.PE_LlamaAgent"].scheduler.stats
+    assert stats["items"] == 4 and stats["batches"] <= 2
