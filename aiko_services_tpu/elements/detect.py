@@ -153,6 +153,18 @@ class PE_LlamaAgent(PipelineElement):
             raise RuntimeError(f"agent element {self.name}: no "
                                f"ComputeRuntime named {compute_name!r}")
         config = LLAMA_PRESETS[str(preset)]
+        tokenizer_path, _ = self.get_parameter("tokenizer", "")
+        if tokenizer_path:
+            from ..models.tokenizer import load_tokenizer
+            bpe = load_tokenizer(str(tokenizer_path))
+            limit = int(self.prompt_length)
+            vocab = config.vocab
+            # drop ids the model's embedding can't represent — jnp.take
+            # would clamp them silently (same guard greedy_decode applies
+            # to whisper specials)
+            self.tokenizer = lambda text: [
+                t for t in bpe.encode(text) if t < vocab][:limit]
+            self.detokenizer = bpe.decode
         params = llama_init(jax.random.PRNGKey(0), config)
         self.params = self.compute.place_params(params,
                                                 llama_axes(config))

@@ -27,6 +27,7 @@ from ..utils import LRUCache, get_logger
 __all__ = [
     "PE_AudioFraming", "PE_LogMel", "PE_WhisperASR", "PE_Synthesize",
     "PE_AudioReadFile", "PE_AudioWriteFile", "load_wav", "save_wav",
+    "load_flat_npz", "save_flat_npz",
 ]
 
 SAMPLE_RATE = 16000         # voice rate (reference: audio_io.py:224-228)
@@ -110,6 +111,9 @@ class PE_WhisperASR(PipelineElement):
         self.logger = get_logger(f"asr.{self.name}")
         self._program = f"whisper_asr.{self.definition.name}"
         self._setup_done = False
+        # pluggable id→text hook (parameter `tokenizer` loads a real BPE
+        # vocab in _setup; the default mirrors PE_LlamaAgent's seam)
+        self.detokenizer = lambda ids: " ".join(str(t) for t in ids)
 
     # -- model + program setup (lazy: first stream) -------------------------
     def _setup(self) -> None:
@@ -144,7 +148,11 @@ class PE_WhisperASR(PipelineElement):
             n_text_ctx=max_tokens + 8, n_vocab=base.n_vocab,
             dim=base.dim, num_heads=base.num_heads,
             enc_layers=base.enc_layers, dec_layers=base.dec_layers,
-            dtype=jnp.bfloat16)
+            dtype=jnp.bfloat16, sot=base.sot, eot=base.eot)
+        tokenizer_path, _ = self.get_parameter("tokenizer", "")
+        if tokenizer_path:
+            from ..models.tokenizer import load_tokenizer
+            self.detokenizer = load_tokenizer(str(tokenizer_path)).decode
         weights, _ = self.get_parameter("weights", "")
         params = whisper_init(jax.random.PRNGKey(0), self.config)
         if weights:
@@ -155,13 +163,9 @@ class PE_WhisperASR(PipelineElement):
         per_bucket_config = {}
 
         def make_fn(bucket):
-            config = WhisperConfig(
-                n_mels=self.config.n_mels, n_audio_ctx=bucket // 2,
-                n_text_ctx=self.config.n_text_ctx,
-                n_vocab=self.config.n_vocab, dim=self.config.dim,
-                num_heads=self.config.num_heads,
-                enc_layers=self.config.enc_layers,
-                dec_layers=self.config.dec_layers, dtype=jnp.bfloat16)
+            import dataclasses
+            config = dataclasses.replace(
+                self.config, n_audio_ctx=bucket // 2)
             import functools
             return jax.jit(functools.partial(
                 greedy_decode, config=config, max_tokens=max_tokens))
@@ -223,7 +227,7 @@ class PE_WhisperASR(PipelineElement):
 
     def _to_outputs(self, result):
         tokens, length = result
-        text = " ".join(str(t) for t in tokens[:length])
+        text = self.detokenizer([int(t) for t in tokens[:length]])
         return {"tokens": tokens, "text": text}
 
 
@@ -241,24 +245,50 @@ def load_flat_npz(params, pathname: str):
 
     flat = dict(np.load(pathname))
 
-    def path_str(path):
-        parts = []
-        for entry in path:
-            key = getattr(entry, "key", getattr(entry, "idx", None))
-            parts.append(str(key))
-        return "/".join(parts)
-
     def overlay(path, leaf):
-        key = path_str(path)
+        key = _tree_path_str(path)
         if key not in flat:
             return leaf
         loaded = flat[key]
-        if loaded.shape != tuple(leaf.shape):
-            raise ValueError(f"weights[{key}]: shape {loaded.shape} != "
-                             f"model {tuple(leaf.shape)}")
+        shape = tuple(leaf.shape)
+        if loaded.shape != shape:
+            # position tables may be longer in the checkpoint than the
+            # serving context (e.g. 448-token pos_embed, 24-token server):
+            # a leading-dim prefix is the correct slice for them
+            if (loaded.ndim == leaf.ndim and
+                    loaded.shape[1:] == shape[1:] and
+                    loaded.shape[0] > shape[0] and
+                    key.rsplit("/", 1)[-1].startswith("pos_embed")):
+                loaded = loaded[:shape[0]]
+            else:
+                raise ValueError(f"weights[{key}]: shape {loaded.shape} "
+                                 f"!= model {shape}")
         return loaded.astype(leaf.dtype)
 
     return jax.tree_util.tree_map_with_path(overlay, params)
+
+
+def _tree_path_str(path) -> str:
+    """jax tree path → the '/'-joined key scheme of the flat-npz format."""
+    parts = []
+    for entry in path:
+        key = getattr(entry, "key", getattr(entry, "idx", None))
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def save_flat_npz(params, pathname: str) -> None:
+    """Inverse of load_flat_npz: write a param tree as an npz of
+    '/'-joined tree paths (the checkpoint interchange scheme the weight
+    converter in tools/convert_whisper.py also produces)."""
+    import numpy as np
+    import jax
+
+    flat = {}
+    jax.tree_util.tree_map_with_path(
+        lambda path, leaf: flat.__setitem__(_tree_path_str(path),
+                                            np.asarray(leaf)), params)
+    np.savez(pathname, **flat)
 
 
 class PE_Synthesize(PipelineElement):

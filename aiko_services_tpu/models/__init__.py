@@ -17,4 +17,10 @@ from .llama import (                                        # noqa: F401
     LLAMA_PRESETS, LlamaConfig, llama_init, llama_axes, llama_forward,
     llama_decode_step, llama_greedy_decode, init_llama_caches,
 )
+from .moe import (                                          # noqa: F401
+    MoeConfig, moe_init, moe_axes, moe_forward,
+)
+from .tokenizer import (                                    # noqa: F401
+    BPETokenizer, ByteTokenizer, WhisperTokens, load_tokenizer,
+)
 from . import layers                                        # noqa: F401

@@ -90,8 +90,7 @@ def moe_forward(params, config: MoeConfig, x):
     combine = dispatch * top_probs[..., None, None].astype(tokens.dtype)
 
     # route → expert batches [E, C, D]
-    expert_in = jnp.einsum("nked,nd->ecd",
-                           dispatch.transpose(0, 1, 2, 3), tokens,
+    expert_in = jnp.einsum("nkec,nd->ecd", dispatch, tokens,
                            preferred_element_type=jnp.float32
                            ).astype(tokens.dtype)
     hidden = jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"],
@@ -100,12 +99,14 @@ def moe_forward(params, config: MoeConfig, x):
     expert_out = jnp.einsum("ecf,efd->ecd", hidden, params["w_out"],
                             preferred_element_type=jnp.float32
                             ).astype(tokens.dtype)
-    y = jnp.einsum("nked,ecd->nd", combine, expert_out,
+    y = jnp.einsum("nkec,ecd->nd", combine, expert_out,
                    preferred_element_type=jnp.float32).astype(tokens.dtype)
 
-    # load-balancing auxiliary loss (Switch-style)
+    # load-balancing auxiliary loss (Switch-style): fraction of tokens
+    # whose top-1 choice actually landed in each expert × mean router
+    # probability per expert, both [E].
     routed_fraction = jnp.mean(
-        (one_hot[:, 0] * keep[:, :1, None]).astype(jnp.float32), axis=0)
+        (one_hot[:, 0] * keep[:, 0:1]).astype(jnp.float32), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux_loss = e * jnp.sum(routed_fraction * mean_prob)
     return y.reshape(b, s, d), aux_loss

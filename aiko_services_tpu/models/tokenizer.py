@@ -1,0 +1,177 @@
+# Tokenizers: byte-level BPE (the GPT-2 scheme Whisper and Llama-2-era
+# checkpoints use on disk) plus a byte-direct tokenizer for tests.
+#
+# Capability parity: the reference gets text out of faster-whisper's
+# bundled tokenizer (reference: examples/speech/speech_elements.py:217-250
+# — transcription segments arrive as strings).  This framework runs the
+# model math itself, so it needs its own id↔text path: a self-contained
+# BPE implementation that loads standard vocab.json/merges.txt files
+# (produced from a real checkpoint by tools/convert_whisper.py) with no
+# network or external tokenizer library.
+#
+# Implemented fresh from the published BPE algorithm (Sennrich et al.;
+# byte-level variant per GPT-2): greedy lowest-rank pair merging over a
+# reversible byte→unicode alphabet.
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+__all__ = ["BPETokenizer", "ByteTokenizer", "WhisperTokens",
+           "load_tokenizer", "byte_to_unicode"]
+
+
+def byte_to_unicode() -> dict:
+    """Reversible byte→printable-unicode map (byte-level BPE alphabet).
+
+    Printable ASCII + two latin-1 ranges map to themselves; the remaining
+    68 bytes map to 256+n so every byte has a distinct printable symbol
+    and vocab files stay valid JSON text."""
+    keep = (list(range(ord("!"), ord("~") + 1)) +
+            list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    mapping = {}
+    next_code = 256
+    for byte in range(256):
+        if byte in keep:
+            mapping[byte] = chr(byte)
+        else:
+            mapping[byte] = chr(next_code)
+            next_code += 1
+    return mapping
+
+
+# GPT-2's pre-tokenizer split (contractions, letter runs, digit runs,
+# punctuation runs, whitespace) expressed with re's unicode classes:
+# [^\W\d_] ≈ \p{L}.  Merges never cross these boundaries — required for
+# canonical ids vs the checkpoint's tokenizer, and it bounds the merge
+# loop to one word instead of the whole text (O(w²) per word, not O(L²)).
+_PRETOKENIZE = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d"
+    r"| ?[^\W\d_]+| ?\d+| ?[^\s\w]+|_+|\s+(?!\S)|\s+")
+
+
+class BPETokenizer:
+    """Byte-level BPE over a vocab dict + ranked merge list.
+
+    encode: text → pre-token split → utf-8 bytes → unicode alphabet →
+    greedy merges per pre-token → ids.
+    decode: ids → tokens → bytes → utf-8 text (special ids skipped)."""
+
+    def __init__(self, vocab: dict, merges: list, special_ids=()):
+        self.vocab = dict(vocab)                      # token str → id
+        self.inverse = {i: t for t, i in self.vocab.items()}
+        self.ranks = {tuple(pair): rank
+                      for rank, pair in enumerate(merges)}
+        self.special_ids = set(int(i) for i in special_ids)
+        self._b2u = byte_to_unicode()
+        self._u2b = {u: b for b, u in self._b2u.items()}
+
+    def _merge_word(self, symbols: list) -> list:
+        while len(symbols) > 1:
+            best_rank, best_i = None, None
+            for i in range(len(symbols) - 1):
+                rank = self.ranks.get((symbols[i], symbols[i + 1]))
+                if rank is not None and (best_rank is None or
+                                         rank < best_rank):
+                    best_rank, best_i = rank, i
+            if best_i is None:
+                break
+            symbols = (symbols[:best_i] +
+                       [symbols[best_i] + symbols[best_i + 1]] +
+                       symbols[best_i + 2:])
+        return symbols
+
+    def encode(self, text: str) -> list:
+        ids = []
+        for word in _PRETOKENIZE.findall(text):
+            symbols = [self._b2u[b] for b in word.encode("utf-8")]
+            for symbol in self._merge_word(symbols):
+                if symbol in self.vocab:
+                    ids.append(self.vocab[symbol])
+                else:   # unmergeable multi-byte run: emit per-byte ids
+                    ids.extend(self.vocab[ch] for ch in symbol
+                               if ch in self.vocab)
+        return ids
+
+    def decode(self, ids) -> str:
+        data = bytearray()
+        for token_id in ids:
+            token_id = int(token_id)
+            if token_id in self.special_ids:
+                continue
+            token = self.inverse.get(token_id)
+            if token is None:
+                continue
+            data.extend(self._u2b.get(ch, ord("?")) for ch in token)
+        return data.decode("utf-8", errors="replace")
+
+
+class ByteTokenizer:
+    """Id == byte value (vocab 256): the deterministic tokenizer for the
+    'test' whisper preset (sot=254, eot=255 double as bytes the test
+    language never uses).  Lets golden transcription tests run with no
+    vocab files."""
+
+    def __init__(self, special_ids=(254, 255)):
+        self.special_ids = set(special_ids)
+
+    def encode(self, text: str) -> list:
+        return [b for b in text.encode("utf-8")
+                if b not in self.special_ids]
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) for i in ids
+                     if int(i) not in self.special_ids and 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class WhisperTokens:
+    """Special-token ids for the multilingual whisper vocabulary, derived
+    from the vocab size (matches openai/whisper's layout: specials start
+    right after the text vocab at 50257)."""
+
+    def __init__(self, vocab_size: int = 51865):
+        base = 50257
+        self.eot = base
+        self.sot = base + 1
+        self.translate = base + 100 + 1
+        self.transcribe = base + 100 + 2
+        self.no_timestamps = base + 106
+        self.timestamp_begin = base + 107
+        # timestamps run to the end of the model's output space
+        # (51865 for the multilingual layout), NOT just to len(vocab.json)
+        self.vocab_size = vocab_size
+
+    def special_ids(self):
+        """Everything decode should skip: control tokens + timestamps."""
+        return set(range(self.eot, self.vocab_size))
+
+
+def load_tokenizer(path: str):
+    """Load a tokenizer from a path.
+
+    - "builtin:byte" → ByteTokenizer (test preset).
+    - directory with vocab.json + merges.txt (the converter's output or a
+      checkpoint directory) → BPETokenizer with whisper special ids
+      skipped on decode."""
+    if path == "builtin:byte":
+        return ByteTokenizer()
+    vocab_file = os.path.join(path, "vocab.json")
+    merges_file = os.path.join(path, "merges.txt")
+    with open(vocab_file, encoding="utf-8") as handle:
+        vocab = json.load(handle)
+    merges = []
+    with open(merges_file, encoding="utf-8") as handle:
+        for line in handle:
+            line = line.rstrip("\n")
+            if not line or line.startswith("#version"):
+                continue
+            parts = line.split(" ")
+            if len(parts) == 2:
+                merges.append((parts[0], parts[1]))
+    special = set()
+    if len(vocab) >= 50257 or any(t.startswith("<|") for t in vocab):
+        special = WhisperTokens(max(len(vocab), 51865)).special_ids()
+    return BPETokenizer(vocab, merges, special)

@@ -37,6 +37,11 @@ class WhisperConfig:
     enc_layers: int = 12
     dec_layers: int = 12
     dtype: object = jnp.float32
+    # special tokens (multilingual tokenizer defaults, as in openai
+    # whisper); presets with small vocabularies override them so the ids
+    # stay in-range — greedy_decode asserts this
+    sot: int = 50258
+    eot: int = 50257
 
     @property
     def head_dim(self):
@@ -49,7 +54,7 @@ WHISPER_PRESETS = {
     # not a real whisper size: CI/smoke geometry (real 80-mel frontend,
     # toy transformer) so end-to-end speech tests run in seconds on CPU
     "test":   WhisperConfig(dim=64,   num_heads=4,  enc_layers=2,
-                            dec_layers=2, n_vocab=256),
+                            dec_layers=2, n_vocab=256, sot=254, eot=255),
     "tiny":   WhisperConfig(dim=384,  num_heads=6,  enc_layers=4,
                             dec_layers=4),
     "base":   WhisperConfig(dim=512,  num_heads=8,  enc_layers=6,
@@ -221,13 +226,21 @@ def decode_step(params, config: WhisperConfig, tokens, cross_kv, caches,
 
 
 def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
-                  sot_sequence=(SOT,)):
+                  sot_sequence=None):
     """Batched greedy decoding as one compiled program.
 
     mel: [B, T_frames, n_mels] → (tokens [B, max_tokens], lengths [B]).
     The token loop is a lax.scan over static-shape KV caches; finished
     sequences (EOT emitted) keep writing EOT — no dynamic shapes, so one
     compilation serves every utterance in the bucket."""
+    if sot_sequence is None:
+        sot_sequence = (config.sot,)
+    eot = config.eot
+    if max(max(sot_sequence), eot) >= config.n_vocab:
+        raise ValueError(
+            f"special tokens {tuple(sot_sequence)}/eot={eot} out of range "
+            f"for n_vocab={config.n_vocab}: embedding lookups would "
+            f"silently clamp and early-stop could never fire")
     total = len(sot_sequence) + max_tokens
     if total > config.n_text_ctx:
         raise ValueError(
@@ -250,16 +263,16 @@ def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
             params, config, token[:, None], cross_kv, caches,
             position_offset=position)
         next_token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        next_token = jnp.where(done, EOT, next_token)
-        done = done | (next_token == EOT)
+        next_token = jnp.where(done, eot, next_token)
+        done = done | (next_token == eot)
         return (next_token, caches, done), token
 
     positions = len(sot_sequence) + jnp.arange(max_tokens)
-    done0 = first == EOT
+    done0 = first == eot
     (_, _, done), tokens = jax.lax.scan(
         step, (first, caches, done0), positions)
     tokens = jnp.moveaxis(tokens, 0, 1)            # [B, max_tokens]
-    lengths = jnp.sum((tokens != EOT).astype(jnp.int32), axis=1)
+    lengths = jnp.sum((tokens != eot).astype(jnp.int32), axis=1)
     return tokens, lengths
 
 

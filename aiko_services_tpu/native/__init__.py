@@ -39,10 +39,19 @@ def load():
         if not os.path.exists(so_path) or \
                 os.path.getmtime(so_path) < os.path.getmtime(_source):
             include = sysconfig.get_path("include")
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", f"-I{include}",
-                 "-o", so_path, _source],
-                check=True, capture_output=True, timeout=180)
+            # Compile to a private temp name and rename into place so
+            # concurrent processes never import a half-written .so
+            # (rename is atomic on POSIX; last writer wins).
+            tmp_path = f"{so_path}.{os.getpid()}.tmp"
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", f"-I{include}",
+                     "-o", tmp_path, _source],
+                    check=True, capture_output=True, timeout=180)
+                os.rename(tmp_path, so_path)
+            finally:
+                if os.path.exists(tmp_path):
+                    os.unlink(tmp_path)
         spec = importlib.util.spec_from_file_location("_aiko_native",
                                                       so_path)
         module = importlib.util.module_from_spec(spec)

@@ -13,6 +13,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["mel_filterbank", "log_mel_spectrogram", "stft",
            "WHISPER_SAMPLE_RATE", "WHISPER_N_FFT", "WHISPER_HOP"]
@@ -39,32 +40,36 @@ def _hz_to_mel(hz: float) -> float:
 
 def _mel_to_hz(mels):
     linear = mels / _LIN_SLOPE
-    log = _MIN_LOG_HZ * jnp.exp(_LOG_STEP * (mels - _MIN_LOG_MEL))
-    return jnp.where(mels < _MIN_LOG_MEL, linear, log)
+    log = _MIN_LOG_HZ * np.exp(_LOG_STEP * (mels - _MIN_LOG_MEL))
+    return np.where(mels < _MIN_LOG_MEL, linear, log)
 
 
 @functools.lru_cache(maxsize=8)
 def mel_filterbank(num_mels: int = 80, n_fft: int = WHISPER_N_FFT,
                    sample_rate: int = WHISPER_SAMPLE_RATE,
                    fmin: float = 0.0, fmax: float | None = None):
-    """Slaney-scale triangular mel filterbank: [n_fft//2+1, num_mels]."""
+    """Slaney-scale triangular mel filterbank: [n_fft//2+1, num_mels].
+
+    Computed in numpy: it is a compile-time constant, and the lru_cache
+    must hold concrete arrays — building it with jnp under an enclosing
+    jit would cache a tracer (leak) on first traced use."""
     fmax = fmax if fmax is not None else sample_rate / 2.0
     num_bins = n_fft // 2 + 1
-    fft_freqs = jnp.linspace(0.0, sample_rate / 2.0, num_bins)
-    mel_points = jnp.linspace(_hz_to_mel(fmin), _hz_to_mel(fmax),
-                              num_mels + 2)
+    fft_freqs = np.linspace(0.0, sample_rate / 2.0, num_bins)
+    mel_points = np.linspace(_hz_to_mel(fmin), _hz_to_mel(fmax),
+                             num_mels + 2)
     hz_points = _mel_to_hz(mel_points)
 
     lower = hz_points[:-2][None, :]
     centre = hz_points[1:-1][None, :]
     upper = hz_points[2:][None, :]
     freqs = fft_freqs[:, None]
-    up_slope = (freqs - lower) / jnp.maximum(centre - lower, 1e-10)
-    down_slope = (upper - freqs) / jnp.maximum(upper - centre, 1e-10)
-    weights = jnp.maximum(0.0, jnp.minimum(up_slope, down_slope))
+    up_slope = (freqs - lower) / np.maximum(centre - lower, 1e-10)
+    down_slope = (upper - freqs) / np.maximum(upper - centre, 1e-10)
+    weights = np.maximum(0.0, np.minimum(up_slope, down_slope))
     # Slaney area normalization
     enorm = 2.0 / (hz_points[2:] - hz_points[:-2])
-    return weights * enorm[None, :]
+    return (weights * enorm[None, :]).astype(np.float32)
 
 
 def stft(audio, n_fft: int = WHISPER_N_FFT, hop: int = WHISPER_HOP):

@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 
 from aiko_services_tpu.models import (
-    LlamaConfig, WhisperConfig, ResNetConfig,
+    LlamaConfig, WhisperConfig, ResNetConfig, MoeConfig,
+    moe_init, moe_axes, moe_forward,
     whisper_init, whisper_axes, encode, decode_step, greedy_decode, forward,
     resnet_init, resnet_axes, resnet_forward,
     llama_init, llama_axes, llama_forward, llama_decode_step,
@@ -22,7 +23,7 @@ from aiko_services_tpu.parallel import create_mesh, shard_pytree
 
 TINY_WHISPER = WhisperConfig(n_mels=8, n_audio_ctx=16, n_text_ctx=32,
                              n_vocab=64, dim=32, num_heads=4, enc_layers=2,
-                             dec_layers=2)
+                             dec_layers=2, sot=62, eot=63)
 TINY_LLAMA = LlamaConfig(vocab=64, dim=32, ffn_dim=64, num_layers=2,
                          num_heads=4, num_kv_heads=2, max_seq_len=64)
 TINY_RESNET = ResNetConfig(stage_sizes=(1, 1), num_classes=10, width=8)
@@ -186,3 +187,98 @@ def test_whisper_greedy_rejects_overlong_decode(whisper_params):
     with pytest.raises(ValueError, match="n_text_ctx"):
         greedy_decode(whisper_params, TINY_WHISPER, mel,
                       max_tokens=TINY_WHISPER.n_text_ctx + 1)
+
+
+# -- mixture of experts ------------------------------------------------------
+
+TINY_MOE = MoeConfig(dim=16, ffn_dim=32, num_experts=4, top_k=2)
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return moe_init(jax.random.PRNGKey(3), TINY_MOE)
+
+
+def test_moe_forward_shapes_and_finite(moe_params):
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 16))
+    y, aux = moe_forward(moe_params, TINY_MOE, x)
+    assert y.shape == x.shape
+    assert jnp.isfinite(aux)
+    assert np.isfinite(np.asarray(y)).all()
+    # routed tokens actually contribute (not all dropped/zero)
+    assert float(jnp.abs(y).sum()) > 0.0
+
+
+def test_moe_jits_and_is_deterministic(moe_params):
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, 16))
+    fn = jax.jit(lambda x: moe_forward(moe_params, TINY_MOE, x))
+    y1, aux1 = fn(x)
+    y2, aux2 = fn(x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert float(aux1) == float(aux2)
+
+
+def test_moe_aux_loss_penalizes_imbalance():
+    """Router biased hard toward expert 0 (ample capacity): every token's
+    top-1 lands and stays on expert 0, so routed_fraction=(1,0,0,0),
+    mean_prob≈(1,0,0,0), aux ≈ E·(1·1) = E — the maximal-imbalance value
+    (a balanced router would give 1)."""
+    config = MoeConfig(dim=16, ffn_dim=32, num_experts=4, top_k=1,
+                       capacity_factor=float(4 * 64))
+    params = moe_init(jax.random.PRNGKey(6), config)
+    bias = np.zeros((16, 4), np.float32)
+    bias[:, 0] = 10.0                 # every row votes expert 0
+    params = dict(params, router={"w": jnp.asarray(bias)})
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(7), (1, 64, 16)))
+    _, aux = moe_forward(params, config, x)
+    assert abs(float(aux) - config.num_experts) < 0.1
+
+
+def test_moe_aux_loss_counts_only_kept_tokens():
+    """Same all-to-expert-0 routing but capacity 1: only 1 of 64 tokens
+    is kept, so routed_fraction_0 = 1/64 and aux ≈ E/64 — verifying the
+    keep mask feeds the loss (without it aux would be ≈ E)."""
+    config = MoeConfig(dim=16, ffn_dim=32, num_experts=4, top_k=1,
+                       capacity_factor=1e-9)
+    params = moe_init(jax.random.PRNGKey(6), config)
+    bias = np.zeros((16, 4), np.float32)
+    bias[:, 0] = 10.0
+    params = dict(params, router={"w": jnp.asarray(bias)})
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(7), (1, 64, 16)))
+    _, aux = moe_forward(params, config, x)
+    assert abs(float(aux) - config.num_experts / 64) < 0.05
+
+
+def test_moe_matches_dense_when_single_expert():
+    """num_experts=1, top_k=1, ample capacity → exactly a dense gelu MLP
+    (softmax prob 1.0 scales combine to identity)."""
+    config = MoeConfig(dim=16, ffn_dim=32, num_experts=1, top_k=1,
+                      capacity_factor=2.0)
+    params = moe_init(jax.random.PRNGKey(8), config)
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 8, 16))
+    y, _ = moe_forward(params, config, x)
+    tokens = x.reshape(-1, 16)
+    hidden = jax.nn.gelu(tokens @ params["w_in"][0])
+    dense = (hidden @ params["w_out"][0]).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """capacity 1 with every token routed to one expert: only the first
+    token per expert survives, the rest output zero."""
+    config = MoeConfig(dim=16, ffn_dim=32, num_experts=2, top_k=1,
+                      capacity_factor=1e-9)      # capacity clamps to 1
+    params = moe_init(jax.random.PRNGKey(10), config)
+    x = jnp.tile(jax.random.normal(jax.random.PRNGKey(11), (1, 1, 16)),
+                 (1, 8, 1))                       # identical tokens
+    y, _ = moe_forward(params, config, x)
+    nonzero = np.abs(np.asarray(y)[0]).sum(axis=-1) > 1e-6
+    assert nonzero.sum() == 1                     # one slot, one survivor
+
+
+def test_moe_params_shard_over_expert_axis(moe_params):
+    mesh = create_mesh({"data": 2, "expert": 4})
+    placed = shard_pytree(moe_params, moe_axes(), mesh)
+    from jax.sharding import PartitionSpec as P
+    assert placed["w_in"].sharding.spec == P("expert", None, None)

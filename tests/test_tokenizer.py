@@ -1,0 +1,84 @@
+# Tokenizer tests: byte-level BPE correctness (merge order, reversible
+# byte alphabet, special-id skipping) and the byte tokenizer used by the
+# golden transcription test.
+
+import json
+
+from aiko_services_tpu.models.tokenizer import (
+    BPETokenizer, ByteTokenizer, WhisperTokens, byte_to_unicode,
+    load_tokenizer)
+
+
+def test_byte_unicode_map_reversible():
+    mapping = byte_to_unicode()
+    assert len(mapping) == 256
+    assert len(set(mapping.values())) == 256        # injective
+    assert mapping[ord("A")] == "A"                 # printable identity
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello world"
+    ids = tok.encode(text)
+    assert ids == list(text.encode("utf-8"))
+    assert tok.decode(ids) == text
+    # specials skipped on decode
+    assert tok.decode([254] + ids + [255]) == text
+
+
+def _tiny_bpe():
+    mapping = byte_to_unicode()
+    space = mapping[ord(" ")]                       # "Ġ"-style symbol
+    base = {mapping[b]: b for b in range(256)}
+    vocab = dict(base)
+    vocab["he"] = 256
+    vocab["ll"] = 257
+    vocab["hell" ] = 258
+    vocab[space + "w"] = 259
+    merges = [("h", "e"), ("l", "l"), ("he", "ll"), (space, "w")]
+    return BPETokenizer(vocab, merges)
+
+
+def test_bpe_applies_merges_in_rank_order():
+    tok = _tiny_bpe()
+    ids = tok.encode("hello world")
+    # "hello world" → hell|o|Ġw|o|r|l|d  (ll merged before he+ll possible)
+    assert ids[0] == 258                            # "hell"
+    assert 259 in ids                               # "Ġw"
+    assert tok.decode(ids) == "hello world"
+
+
+def test_bpe_roundtrips_non_ascii():
+    tok = BPETokenizer({u: b for b, u in byte_to_unicode().items()}, [])
+    text = "héllo ⊕ 日本"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_bpe_skips_special_ids():
+    vocab = {u: b for b, u in byte_to_unicode().items()}
+    vocab["<|endoftext|>"] = 256
+    tok = BPETokenizer(vocab, [], special_ids=[256])
+    assert tok.decode([ord("h"), ord("i"), 256]) == "hi"
+
+
+def test_whisper_special_token_layout():
+    tokens = WhisperTokens()
+    assert tokens.eot == 50257
+    assert tokens.sot == 50258
+    assert tokens.transcribe == 50359
+    assert tokens.no_timestamps == 50363
+    assert tokens.timestamp_begin == 50364
+    assert tokens.eot in tokens.special_ids()
+    assert 50256 not in tokens.special_ids()        # text vocab kept
+
+
+def test_load_tokenizer_from_files(tmp_path):
+    mapping = byte_to_unicode()
+    vocab = {mapping[b]: b for b in range(256)}
+    vocab["th"] = 256
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text("#version: 0.2\nt h\n")
+    tok = load_tokenizer(str(tmp_path))
+    assert tok.encode("th") == [256]
+    assert tok.decode([256, ord("e")]) == "the"
+    assert load_tokenizer("builtin:byte").decode([104, 105]) == "hi"
