@@ -57,6 +57,19 @@ class Actor(Service):
                  share: dict | None = None):
         super().__init__(runtime, name, protocol or PROTOCOL_ACTOR, tags)
         self.logger = get_logger(f"actor.{name}")
+        # distributed logging (runtime-gated): this actor's records also
+        # publish to {topic_path}/log, where the Recorder's namespace
+        # filter and the dashboard log page pick them up
+        self._transport_log_handler = None
+        if getattr(runtime, "log_transport", False):
+            import logging as _logging
+            from .utils.logger import TransportLoggingHandler
+            handler = TransportLoggingHandler(lambda: runtime.message,
+                                              self.topic_log)
+            handler.setFormatter(_logging.Formatter(
+                "%(levelname)s %(name)s: %(message)s"))
+            self.logger.addHandler(handler)
+            self._transport_log_handler = handler
         base_share = {
             "lifecycle": "ready",
             "log_level": "INFO",
@@ -114,6 +127,11 @@ class Actor(Service):
         self.stop()
 
     def stop(self) -> None:
+        if self._transport_log_handler is not None:
+            # loggers are global by name — leaked handlers would double-
+            # publish for a later same-named actor
+            self.logger.removeHandler(self._transport_log_handler)
+            self._transport_log_handler = None
         self.runtime.event.remove_mailbox_handler(self._mailbox_control)
         self.runtime.event.remove_mailbox_handler(self._mailbox_in)
         self.runtime.remove_message_handler(self._topic_in_handler,

@@ -31,7 +31,12 @@ from .video import (                                        # noqa: F401
     PE_Tracker, PE_VideoCameraRead, PE_VideoReadFile, PE_VideoShow,
     PE_VideoWriteFile,
 )
+from .video_stream import (                                 # noqa: F401
+    MJPEGStreamServer, PE_VideoStreamRead, PE_VideoStreamServe,
+    PE_VideoUDPReceive, PE_VideoUDPSend,
+)
 from .detect import PE_Detect, PE_LlamaAgent                # noqa: F401
+from .tts import PE_NeuralTTS                               # noqa: F401
 
 __all__ = [
     "PE_GenerateNumbers", "PE_Metrics", "PE_Identity",
@@ -43,7 +48,9 @@ __all__ = [
     "PE_MicrophoneSim", "PE_RemoteReceive", "PE_RemoteSend", "PE_Speaker",
     "PE_ImageAnnotate", "PE_ImageClassify", "PE_ImageOverlay",
     "PE_ImageReadFile", "PE_ImageResize", "PE_ImageWriteFile",
+    "MJPEGStreamServer", "PE_VideoStreamRead", "PE_VideoStreamServe",
+    "PE_VideoUDPReceive", "PE_VideoUDPSend",
     "PE_Tracker", "PE_VideoCameraRead", "PE_VideoReadFile", "PE_VideoShow",
     "PE_VideoWriteFile",
-    "PE_Detect", "PE_LlamaAgent",
+    "PE_Detect", "PE_LlamaAgent", "PE_NeuralTTS",
 ]

@@ -145,13 +145,22 @@ class PE_Speaker(PipelineElement):
         rate, _ = self.get_parameter("rate", SAMPLE_RATE, frame.stream)
         try:
             import sounddevice
-            sounddevice.play(np.asarray(audio), int(rate))
-        except Exception:
-            key = "speaker.audio"
-            existing = frame.stream.variables.get(key)
-            audio = np.asarray(audio)
-            frame.stream.variables[key] = audio if existing is None else \
-                np.concatenate([existing, audio])
+        except ImportError:
+            sounddevice = None
+        if sounddevice is not None:
+            # a failure INSIDE the audio stack is a real fault and must
+            # surface — only a missing library selects the test sink
+            try:
+                sounddevice.play(np.asarray(audio), int(rate))
+            except Exception as exc:
+                return FrameOutput(
+                    False, diagnostic=f"audio playback failed: {exc!r}")
+            return FrameOutput(True, {})
+        key = "speaker.audio"
+        existing = frame.stream.variables.get(key)
+        audio = np.asarray(audio)
+        frame.stream.variables[key] = audio if existing is None else \
+            np.concatenate([existing, audio])
         return FrameOutput(True, {})
 
 

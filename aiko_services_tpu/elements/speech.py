@@ -83,18 +83,34 @@ class PE_AudioFraming(PipelineElement):
 
 
 class PE_LogMel(PipelineElement):
-    """audio [T_samples] → log-mel [T_frames, 80] (jax, on device)."""
+    """audio [T_samples] → log-mel [T_frames, 80] (jax).
+
+    Parameter `device`: "default" runs on the accelerator (co-located
+    serving: mel stays on device for the encoder); "cpu" pins the
+    frontend to the host CPU backend — right when the accelerator is
+    behind a thin link and the batched ASR program uploads mel itself
+    (mel is 4× smaller than raw f32 audio over the wire)."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         import jax
         from ..ops.audio import log_mel_spectrogram
         self._fn = jax.jit(log_mel_spectrogram)
+        self._cpu = None
 
     def process_frame(self, frame: Frame, audio=None, **_) -> FrameOutput:
         import numpy as np
 
-        mel = self._fn(np.asarray(audio, dtype="float32")[None])
+        device, _ = self.get_parameter("device", "default", frame.stream)
+        batch = np.asarray(audio, dtype="float32")[None]
+        if device == "cpu":
+            import jax
+            if self._cpu is None:
+                self._cpu = jax.devices("cpu")[0]
+            with jax.default_device(self._cpu):
+                mel = self._fn(batch)
+        else:
+            mel = self._fn(batch)
         return FrameOutput(True, {"mel": mel[0]})
 
 
@@ -102,9 +118,12 @@ class PE_WhisperASR(PipelineElement):
     """Batched Whisper ASR through a ComputeRuntime.
 
     Parameters: preset (tiny/base/small/...), mode ("batched"|"sync"),
-    max_tokens, buckets (mel-frame bucket ladder).  The compute runtime is
-    found by service name via parameter `compute` (default "compute").
-    Emits {"tokens": int32[T], "text": str}."""
+    max_tokens, buckets (mel-frame bucket ladder), frontend ("mel" takes
+    a host-computed mel input; "audio" takes raw samples and fuses the
+    log-mel frontend INTO the batched device program — one jit from
+    samples to tokens, no per-frame host feature dispatch).  The compute
+    runtime is found by service name via parameter `compute` (default
+    "compute").  Emits {"tokens": int32[T], "text": str}."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -132,6 +151,7 @@ class PE_WhisperASR(PipelineElement):
         max_batch, _ = self.get_parameter("max_batch", 32)
         max_wait, _ = self.get_parameter("max_wait", 0.05)
         self.mode, _ = self.get_parameter("mode", "batched")
+        self.frontend, _ = self.get_parameter("frontend", "mel")
         max_tokens = int(max_tokens)
 
         compute_name, _ = self.get_parameter("compute", "compute")
@@ -162,22 +182,51 @@ class PE_WhisperASR(PipelineElement):
 
         per_bucket_config = {}
 
+        audio_frontend = self.frontend == "audio"
+
         def make_fn(bucket):
             import dataclasses
             config = dataclasses.replace(
                 self.config, n_audio_ctx=bucket // 2)
-            import functools
-            return jax.jit(functools.partial(
-                greedy_decode, config=config, max_tokens=max_tokens))
+            if audio_frontend:
+                from ..ops.audio import log_mel_spectrogram
 
-        def run_bucket(bucket, mel_batch):
+                def fused(params, audio):
+                    mel = log_mel_spectrogram(
+                        audio, num_mels=config.n_mels)
+                    return greedy_decode(params, config,
+                                         mel.astype(config.dtype),
+                                         max_tokens=max_tokens)
+                return jax.jit(fused)
+            return jax.jit(lambda params, mel: greedy_decode(
+                params, config, mel, max_tokens=max_tokens))
+
+        def run_bucket(bucket, batch):
             if bucket not in per_bucket_config:
                 per_bucket_config[bucket] = make_fn(bucket)
-            return per_bucket_config[bucket](self.params, mel=mel_batch)
+            return per_bucket_config[bucket](self.params, batch)
+
+        # batched mode pads the batch dim to max_batch so each bucket
+        # compiles exactly ONE program (a partial batch otherwise means a
+        # fresh XLA compile per distinct size — a recompilation storm in
+        # serving); split() slices the real rows back out.
+        pad_batch, _ = self.get_parameter("pad_batch",
+                                          self.mode == "batched")
+
+        def rows(count):
+            return int(max_batch) if pad_batch else count
 
         def collate(bucket, payloads):
-            batch = np.zeros((len(payloads), bucket, self.config.n_mels),
-                             dtype="float32")
+            if audio_frontend:
+                from ..ops.audio import WHISPER_HOP
+                batch = np.zeros((rows(len(payloads)),
+                                  bucket * WHISPER_HOP), dtype="float32")
+                for i, audio in enumerate(payloads):
+                    t = min(audio.shape[0], batch.shape[1])
+                    batch[i, :t] = np.asarray(audio)[:t]
+                return jnp.asarray(batch)
+            batch = np.zeros((rows(len(payloads)), bucket,
+                              self.config.n_mels), dtype="float32")
             for i, mel in enumerate(payloads):
                 t = min(mel.shape[0], bucket)
                 batch[i, :t] = np.asarray(mel)[:t]
@@ -190,17 +239,25 @@ class PE_WhisperASR(PipelineElement):
             return [(tokens[i, :lengths[i]], int(lengths[i]))
                     for i in range(count)]
 
+        pipelined, _ = self.get_parameter("pipelined", False)
         self.compute.register_batched(
             self._program, run_bucket, buckets, collate, split,
-            max_batch=int(max_batch), max_wait=float(max_wait))
+            max_batch=int(max_batch), max_wait=float(max_wait),
+            pipelined=bool(pipelined))
         self._setup_done = True
 
     def start_stream(self, stream) -> None:
         self._setup()
 
-    def process_frame(self, frame: Frame, mel=None, **_) -> FrameOutput:
+    def process_frame(self, frame: Frame, mel=None, audio=None,
+                      **_) -> FrameOutput:
         self._setup()
-        length = int(mel.shape[0])
+        if self.frontend == "audio":
+            from ..ops.audio import WHISPER_HOP
+            mel = audio                    # payload is raw samples
+            length = int(audio.shape[0]) // WHISPER_HOP
+        else:
+            length = int(mel.shape[0])
         if self.mode == "sync":
             box = {}
             self.compute.submit(self._program, frame.stream_id, mel,

@@ -1,0 +1,134 @@
+# Neural TTS pipeline element: batched text→speech on the ComputeRuntime.
+#
+# Replaces the sine-stack placeholder (PE_Synthesize stays as the
+# dependency-free fallback) with the jax acoustic model + Griffin-Lim
+# vocoder from models/tts.py — the same batched serving pattern as
+# PE_WhisperASR: frames from many streams coalesce into one device
+# program (reference wraps Coqui VITS inline on the event loop:
+# examples/speech/speech_elements.py:96-131).
+
+from __future__ import annotations
+
+from ..pipeline import DEFERRED, Frame, FrameOutput, PipelineElement
+from ..utils import get_logger
+
+__all__ = ["PE_NeuralTTS"]
+
+
+class PE_NeuralTTS(PipelineElement):
+    """text → audio.  Parameters: preset (test/base), weights (flat npz),
+    tokenizer (vocab dir or builtin:byte), mode ("batched"|"sync"),
+    max_tokens, max_batch, max_wait, gl_iters.
+    Emits {"audio": float32[samples], "sample_rate"}."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.logger = get_logger(f"tts.{self.name}")
+        self._program = f"neural_tts.{self.definition.name}"
+        self._setup_done = False
+        self.tokenizer = None
+
+    def _setup(self) -> None:
+        if self._setup_done:
+            return
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.tokenizer import ByteTokenizer, load_tokenizer
+        from ..models.tts import TTS_PRESETS, tts_axes, tts_init, synthesize
+
+        preset, _ = self.get_parameter("preset", "test")
+        weights, _ = self.get_parameter("weights", "")
+        tokenizer_path, _ = self.get_parameter("tokenizer", "builtin:byte")
+        max_batch, _ = self.get_parameter("max_batch", 16)
+        max_wait, _ = self.get_parameter("max_wait", 0.05)
+        gl_iters, _ = self.get_parameter("gl_iters", 32)
+        self.mode, _ = self.get_parameter("mode", "batched")
+
+        compute_name, _ = self.get_parameter("compute", "compute")
+        self.compute = self.runtime.service_by_name(compute_name)
+        if self.compute is None:
+            raise RuntimeError(f"TTS element {self.name}: no "
+                               f"ComputeRuntime named {compute_name!r}")
+
+        self.config = TTS_PRESETS[str(preset)]
+        max_tokens, _ = self.get_parameter("max_tokens",
+                                           self.config.max_tokens)
+        self.max_tokens = min(int(max_tokens), self.config.max_tokens)
+        self.tokenizer = ByteTokenizer() if tokenizer_path == \
+            "builtin:byte" else load_tokenizer(str(tokenizer_path))
+        params = tts_init(jax.random.PRNGKey(0), self.config)
+        if weights:
+            from .speech import load_flat_npz
+            params = load_flat_npz(params, str(weights))
+        self.params = self.compute.place_params(params,
+                                                tts_axes(self.config))
+        config = self.config
+        gl_iters = int(gl_iters)
+
+        fn = jax.jit(lambda params, tokens: synthesize(
+            params, config, tokens, n_iter=gl_iters))
+
+        def run_bucket(bucket, token_batch):
+            return fn(self.params, token_batch)
+
+        def collate(bucket, payloads):
+            batch = np.zeros((len(payloads), bucket), dtype="int32")
+            for i, ids in enumerate(payloads):
+                t = min(len(ids), bucket)
+                batch[i, :t] = np.asarray(ids[:t], dtype="int32")
+            return jnp.asarray(batch)
+
+        def split(results, count):
+            audio = np.asarray(results, dtype=np.float32)
+            return [audio[i] for i in range(count)]
+
+        pipelined, _ = self.get_parameter("pipelined", False)
+        self.compute.register_batched(
+            self._program, run_bucket, [self.max_tokens],
+            collate, split, max_batch=int(max_batch),
+            max_wait=float(max_wait), pipelined=bool(pipelined))
+        self._setup_done = True
+
+    def start_stream(self, stream) -> None:
+        self._setup()
+
+    def _trim(self, audio, n_tokens: int):
+        """Drop synthesis of the pad tail: the model was never trained on
+        pad-token frames (they synthesize artifacts)."""
+        from ..ops.audio import WHISPER_HOP
+        samples = n_tokens * self.config.frames_per_token * WHISPER_HOP
+        return audio[:samples]
+
+    def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
+        self._setup()
+        from ..ops.audio import WHISPER_SAMPLE_RATE
+
+        ids = self.tokenizer.encode(str(text))[:self.max_tokens]
+        if not ids:
+            ids = [32]                                   # space: silence
+        if self.mode == "sync":
+            box = {}
+            self.compute.submit(self._program, frame.stream_id, ids,
+                                len(ids),
+                                lambda _sid, r: box.setdefault("r", r))
+            self.compute.programs[self._program].scheduler.drain(
+                force=True)
+            result = box["r"]
+            if isinstance(result, Exception):
+                return FrameOutput(False, diagnostic=repr(result))
+            return FrameOutput(True, {
+                "audio": self._trim(result, len(ids)),
+                "sample_rate": WHISPER_SAMPLE_RATE})
+
+        def callback(_sid, result):
+            outputs = result if isinstance(result, Exception) else \
+                {"audio": self._trim(result, len(ids)),
+                 "sample_rate": WHISPER_SAMPLE_RATE}
+            self.pipeline.post("resume_frame", frame,
+                               self.definition.name, outputs)
+
+        self.compute.submit(self._program, frame.stream_id, ids, len(ids),
+                            callback)
+        return FrameOutput(True, DEFERRED)

@@ -1,0 +1,333 @@
+# Streaming video I/O: network ingest and egress.
+#
+# Capability parity with the reference's GStreamer stream path —
+# VideoStreamReader (RTSP/RTP H.264 ingest,
+# reference: gstreamer/video_stream_reader.py:22-98) and
+# VideoStreamWriter (RTP/RTMP egress,
+# reference: gstreamer/video_stream_writer.py:27-80).
+#
+# Design for this framework (no GStreamer in the serving image; OpenCV is
+# built with FFMPEG):
+#   * PE_VideoStreamRead — URL ingest (rtsp:// udp:// http:// ...)
+#     through OpenCV's FFMPEG backend, with reconnect + exponential
+#     backoff and drop-to-latest real-time semantics (the reference
+#     bounds its queue at 30 frames; a live pipeline wants the newest
+#     frame, not a backlog).
+#   * MJPEGStreamServer / PE_VideoStreamServe — HTTP multipart-MJPEG
+#     egress (stdlib http.server): any browser, OpenCV, or ffmpeg client
+#     can consume it; also the loopback peer the integration tests use.
+#   * PE_VideoUDPSend / PE_VideoUDPReceive — low-latency JPEG-over-UDP
+#     with a tiny chunking header (frame, part, count), the functional
+#     stand-in for the reference's RTP/UDP leg; datagram loss drops that
+#     frame only (live semantics again).
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+from ..pipeline import Frame, FrameOutput, PipelineElement
+from ..utils import get_logger
+
+__all__ = ["PE_VideoStreamRead", "PE_VideoStreamServe", "MJPEGStreamServer",
+           "PE_VideoUDPSend", "PE_VideoUDPReceive", "encode_jpeg",
+           "decode_jpeg"]
+
+_BOUNDARY = "aikoframe"
+
+
+def encode_jpeg(image_rgb, quality: int = 80) -> bytes:
+    import cv2
+    import numpy as np
+
+    bgr = np.asarray(image_rgb).astype("uint8")[:, :, ::-1]
+    ok, data = cv2.imencode(".jpg", bgr,
+                            [cv2.IMWRITE_JPEG_QUALITY, int(quality)])
+    if not ok:
+        raise ValueError("jpeg encode failed")
+    return data.tobytes()
+
+
+def decode_jpeg(data: bytes):
+    import cv2
+    import numpy as np
+
+    bgr = cv2.imdecode(np.frombuffer(data, "uint8"), cv2.IMREAD_COLOR)
+    if bgr is None:
+        raise ValueError("jpeg decode failed")
+    return bgr[:, :, ::-1]
+
+
+class PE_VideoStreamRead(PipelineElement):
+    """Network stream source: `url` parameter (rtsp://, udp://, http://
+    MJPEG, ...) decoded by OpenCV/FFMPEG on a capture thread.
+
+    Real-time semantics: the capture thread always overwrites the latest
+    frame; a timer emits it at `rate` — a slow pipeline sees fresh frames,
+    never a stale backlog.  Lost connections reconnect with exponential
+    backoff (`backoff` initial seconds, doubling to `backoff_limit`)."""
+
+    def start_stream(self, stream) -> None:
+        url, found = self.get_parameter("url", stream=stream)
+        if not found:
+            raise ValueError(f"{self.name}: no url parameter")
+        rate, _ = self.get_parameter("rate", 20.0, stream)
+        backoff, _ = self.get_parameter("backoff", 0.5, stream)
+        backoff_limit, _ = self.get_parameter("backoff_limit", 8.0, stream)
+        logger = get_logger(f"videostream.{self.name}")
+        state = {"latest": None, "stop": False, "connected": False,
+                 "reconnects": -1}       # first connect isn't a reconnect
+        stream.variables[f"{self.definition.name}.state"] = state
+
+        def capture_loop():
+            import cv2
+
+            delay = float(backoff)
+            while not state["stop"]:
+                capture = cv2.VideoCapture(str(url))
+                if not capture.isOpened():
+                    capture.release()
+                    state["connected"] = False
+                    logger.warning("%s: cannot open %s; retry in %.1fs",
+                                   self.name, url, delay)
+                    time.sleep(delay)
+                    delay = min(delay * 2, float(backoff_limit))
+                    continue
+                state["connected"] = True
+                state["reconnects"] += 1
+                delay = float(backoff)           # healthy: reset backoff
+                while not state["stop"]:
+                    ok, bgr = capture.read()
+                    if not ok:
+                        break                    # EOF / connection lost
+                    state["latest"] = bgr[:, :, ::-1]
+                capture.release()
+                state["connected"] = False
+
+        state["thread"] = threading.Thread(
+            target=capture_loop, name=f"{self.name}.capture", daemon=True)
+        state["thread"].start()
+
+        def tick():
+            latest = state["latest"]
+            if latest is not None:
+                state["latest"] = None           # emit each frame once
+                self.create_frame(stream, {"image": latest})
+
+        state["timer"] = self.runtime.event.add_timer_handler(
+            tick, 1.0 / float(rate))
+
+    def stop_stream(self, stream) -> None:
+        state = stream.variables.get(f"{self.definition.name}.state")
+        if state:
+            state["stop"] = True
+            self.runtime.event.remove_timer_handler(state["timer"])
+
+    def process_frame(self, frame: Frame, **_) -> FrameOutput:
+        return FrameOutput(True, {})
+
+
+class MJPEGStreamServer:
+    """Minimal multipart-MJPEG HTTP server (stdlib only).
+
+    publish(jpeg_bytes) hands every connected client the newest frame;
+    slow clients skip frames rather than queueing them."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import http.server
+
+        server_self = self
+        self._condition = threading.Condition()
+        self._frame: bytes | None = None
+        self._sequence = 0
+        self.clients_served = 0
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):                    # noqa: N802 (stdlib API)
+                server_self.clients_served += 1
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    f"multipart/x-mixed-replace; boundary={_BOUNDARY}")
+                self.end_headers()
+                last_sequence = -1
+                try:
+                    while True:
+                        with server_self._condition:
+                            server_self._condition.wait_for(
+                                lambda: server_self._sequence !=
+                                last_sequence or server_self._closing,
+                                timeout=5.0)
+                            if server_self._closing:
+                                return
+                            frame = server_self._frame
+                            last_sequence = server_self._sequence
+                        if frame is None:
+                            continue
+                        self.wfile.write(
+                            f"--{_BOUNDARY}\r\nContent-Type: image/jpeg"
+                            f"\r\nContent-Length: {len(frame)}"
+                            f"\r\n\r\n".encode())
+                        self.wfile.write(frame)
+                        self.wfile.write(b"\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    return
+
+            def log_message(self, *args):        # quiet
+                pass
+
+        import http.server as hs
+        self._closing = False
+        self.server = hs.ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://{host}:{self.port}/stream.mjpg"
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="mjpeg.server", daemon=True)
+        self._thread.start()
+
+    def publish(self, jpeg: bytes) -> None:
+        with self._condition:
+            self._frame = jpeg
+            self._sequence += 1
+            self._condition.notify_all()
+
+    def close(self) -> None:
+        with self._condition:
+            self._closing = True
+            self._condition.notify_all()
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class PE_VideoStreamServe(PipelineElement):
+    """Egress sink: serves the pipeline's frames as HTTP multipart-MJPEG
+    (parameter `port`, 0 = ephemeral; the bound URL lands in the EC share
+    as `stream_url`)."""
+
+    def start_stream(self, stream) -> None:
+        port, _ = self.get_parameter("port", 0, stream)
+        quality, _ = self.get_parameter("quality", 80, stream)
+        server = MJPEGStreamServer(port=int(port))
+        stream.variables[f"{self.definition.name}.server"] = server
+        stream.variables[f"{self.definition.name}.quality"] = int(quality)
+        self.ec_producer.update("stream_url", server.url)
+
+    def stop_stream(self, stream) -> None:
+        server = stream.variables.get(f"{self.definition.name}.server")
+        if server is not None:
+            server.close()
+
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        server = frame.stream.variables[f"{self.definition.name}.server"]
+        quality = frame.stream.variables[f"{self.definition.name}.quality"]
+        server.publish(encode_jpeg(image, quality))
+        return FrameOutput(True, {})
+
+
+# -- JPEG over UDP -----------------------------------------------------------
+# datagram = header(frame_id u32, part u16, part_count u16) + jpeg chunk
+_UDP_HEADER = struct.Struct("!IHH")
+_UDP_CHUNK = 60000                  # stay under the 64 KiB datagram cap
+
+
+class PE_VideoUDPSend(PipelineElement):
+    """Low-latency egress: JPEG frames chunked over UDP to host:port
+    (the functional stand-in for the reference's RTP/UDP writer leg)."""
+
+    def start_stream(self, stream) -> None:
+        state = {
+            "socket": socket.socket(socket.AF_INET, socket.SOCK_DGRAM),
+            "frame_id": 0,
+        }
+        stream.variables[f"{self.definition.name}.state"] = state
+
+    def stop_stream(self, stream) -> None:
+        state = stream.variables.get(f"{self.definition.name}.state")
+        if state:
+            state["socket"].close()
+
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        host, _ = self.get_parameter("host", "127.0.0.1", frame.stream)
+        port, found = self.get_parameter("port", stream=frame.stream)
+        if not found:
+            return FrameOutput(False, diagnostic="no port parameter")
+        quality, _ = self.get_parameter("quality", 80, frame.stream)
+        state = frame.stream.variables[f"{self.definition.name}.state"]
+        payload = encode_jpeg(image, int(quality))
+        chunks = [payload[i:i + _UDP_CHUNK]
+                  for i in range(0, len(payload), _UDP_CHUNK)] or [b""]
+        frame_id = state["frame_id"] = (state["frame_id"] + 1) & 0xFFFFFFFF
+        address = (str(host), int(port))
+        for part, chunk in enumerate(chunks):
+            header = _UDP_HEADER.pack(frame_id, part, len(chunks))
+            state["socket"].sendto(header + chunk, address)
+        return FrameOutput(True, {})
+
+
+class PE_VideoUDPReceive(PipelineElement):
+    """Source: reassembles JPEG-over-UDP frames from PE_VideoUDPSend.
+    Incomplete frames (datagram loss) are dropped, not queued — live
+    semantics.  Parameter `port` (0 = ephemeral; bound port lands in the
+    EC share as `udp_port`)."""
+
+    def start_stream(self, stream) -> None:
+        port, _ = self.get_parameter("port", 0, stream)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("0.0.0.0", int(port)))
+        sock.settimeout(0.25)
+        state = {"socket": sock, "stop": False, "latest": None}
+        stream.variables[f"{self.definition.name}.state"] = state
+        self.ec_producer.update("udp_port", sock.getsockname()[1])
+
+        def receive_loop():
+            parts: dict = {}
+            current = -1
+            while not state["stop"]:
+                try:
+                    datagram = sock.recv(65535)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if len(datagram) < _UDP_HEADER.size:
+                    continue
+                frame_id, part, count = _UDP_HEADER.unpack(
+                    datagram[:_UDP_HEADER.size])
+                if frame_id != current:
+                    parts = {}
+                    current = frame_id
+                parts[part] = datagram[_UDP_HEADER.size:]
+                if len(parts) == count:
+                    data = b"".join(parts[i] for i in range(count))
+                    try:
+                        state["latest"] = decode_jpeg(data)
+                    except ValueError:
+                        pass
+                    parts = {}
+
+        state["thread"] = threading.Thread(
+            target=receive_loop, name=f"{self.name}.udp", daemon=True)
+        state["thread"].start()
+
+        rate, _ = self.get_parameter("rate", 20.0, stream)
+
+        def tick():
+            latest = state["latest"]
+            if latest is not None:
+                state["latest"] = None
+                self.create_frame(stream, {"image": latest})
+
+        state["timer"] = self.runtime.event.add_timer_handler(
+            tick, 1.0 / float(rate))
+
+    def stop_stream(self, stream) -> None:
+        state = stream.variables.get(f"{self.definition.name}.state")
+        if state:
+            state["stop"] = True
+            self.runtime.event.remove_timer_handler(state["timer"])
+            state["socket"].close()
+
+    def process_frame(self, frame: Frame, **_) -> FrameOutput:
+        return FrameOutput(True, {})

@@ -23,4 +23,7 @@ from .moe import (                                          # noqa: F401
 from .tokenizer import (                                    # noqa: F401
     BPETokenizer, ByteTokenizer, WhisperTokens, load_tokenizer,
 )
+from .tts import (                                          # noqa: F401
+    TTSConfig, TTS_PRESETS, tts_init, tts_axes, tts_forward, synthesize,
+)
 from . import layers                                        # noqa: F401

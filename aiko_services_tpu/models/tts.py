@@ -1,0 +1,118 @@
+# Neural text-to-speech: compact FastSpeech-style acoustic model + the
+# Griffin-Lim vocoder leg from ops/audio.
+#
+# Capability parity target: the reference's TTS element wraps Coqui VITS
+# on the host (reference: examples/speech/speech_elements.py:96-131).
+# Here the acoustic model is a jax conv-transformer: byte/BPE tokens →
+# hidden states → fixed-factor upsample → log-mel frames, all static
+# shapes so batched synthesis jits onto the MXU alongside the ASR
+# programs; mel → waveform is mel_to_linear + griffin_lim (deterministic,
+# weight-free).  Weights load via the same flat-npz scheme as whisper
+# (elements/speech.py load_flat_npz), so a trained checkpoint drops in.
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+__all__ = ["TTSConfig", "TTS_PRESETS", "tts_init", "tts_axes",
+           "tts_forward", "synthesize"]
+
+
+@dataclass(frozen=True)
+class TTSConfig:
+    vocab: int = 256              # byte-level input
+    dim: int = 128
+    num_layers: int = 4
+    num_heads: int = 4
+    n_mels: int = 80
+    frames_per_token: int = 8     # fixed-length regulator (~12 chars/s)
+    max_tokens: int = 128
+    dtype: object = jnp.float32
+
+
+TTS_PRESETS = {
+    "test": TTSConfig(dim=64, num_layers=2, num_heads=4,
+                      frames_per_token=6, max_tokens=32),
+    "base": TTSConfig(),
+}
+
+
+def _block_init(key, config: TTSConfig):
+    keys = jax.random.split(key, 3)
+    dim, dtype = config.dim, config.dtype
+    return {
+        "ln_attn": L.layer_norm_init(dim, dtype),
+        "attn": L.mha_init(keys[0], dim, config.num_heads, dtype=dtype),
+        "ln_mlp": L.layer_norm_init(dim, dtype),
+        "mlp_in": L.linear_init(keys[1], dim, dim * 4, dtype=dtype),
+        "mlp_out": L.linear_init(keys[2], dim * 4, dim, dtype=dtype),
+    }
+
+
+def _block_axes():
+    return {
+        "ln_attn": L.layer_norm_axes(),
+        "attn": L.mha_axes(),
+        "ln_mlp": L.layer_norm_axes(),
+        "mlp_in": L.linear_axes("embed", "ffn"),
+        "mlp_out": L.linear_axes("ffn", "embed"),
+    }
+
+
+def tts_init(key, config: TTSConfig):
+    keys = jax.random.split(key, config.num_layers + 3)
+    return {
+        "embed": L.embedding_init(keys[0], config.vocab, config.dim,
+                                  config.dtype),
+        "blocks": [_block_init(keys[i + 1], config)
+                   for i in range(config.num_layers)],
+        "ln_out": L.layer_norm_init(config.dim, config.dtype),
+        "mel_head": L.linear_init(keys[-1], config.dim, config.n_mels,
+                                  dtype=config.dtype),
+    }
+
+
+def tts_axes(config: TTSConfig):
+    return {
+        "embed": L.embedding_axes(),
+        "blocks": [_block_axes()] * config.num_layers,
+        "ln_out": L.layer_norm_axes(),
+        "mel_head": L.linear_axes("embed", None),
+    }
+
+
+def tts_forward(params, config: TTSConfig, tokens):
+    """tokens: [B, S] int32 (pad with 0) →
+    log-mel [B, S * frames_per_token, n_mels] (whisper-normalized)."""
+    x = L.embedding(params["embed"], tokens).astype(config.dtype)
+    positions = L.sinusoid_position_encoding(tokens.shape[1], config.dim)
+    x = x + positions[None].astype(x.dtype)
+    for block in params["blocks"]:
+        attn_out, _ = L.mha(block["attn"],
+                            L.layer_norm(block["ln_attn"], x),
+                            num_heads=config.num_heads)
+        x = x + attn_out
+        x = x + L.linear(block["mlp_out"], L.gelu(
+            L.linear(block["mlp_in"],
+                     L.layer_norm(block["ln_mlp"], x))))
+    x = L.layer_norm(params["ln_out"], x)
+    # length regulator: every token expands to frames_per_token frames
+    # (static-shape stand-in for a duration predictor — XLA-friendly)
+    x = jnp.repeat(x, config.frames_per_token, axis=1)
+    return L.linear(params["mel_head"], x)
+
+
+def synthesize(params, config: TTSConfig, tokens, n_iter: int = 32):
+    """tokens → waveform [B, samples] via mel → linear → Griffin-Lim.
+    One jittable program: batched synthesis runs on device end-to-end."""
+    from ..ops.audio import griffin_lim, mel_to_linear
+
+    mel = tts_forward(params, config, tokens)
+    magnitude = mel_to_linear(mel.astype(jnp.float32),
+                              num_mels=config.n_mels)
+    return griffin_lim(magnitude, n_iter=n_iter)

@@ -132,14 +132,30 @@ def flash_attention(q, k, v, causal: bool = False,
     return out.reshape(b, h, s, d)
 
 
+# Measured crossover on the v5e bench chip (2026-07-29, b=32 h=12 d=64):
+# at s=256 the flash kernel is ~15 ms SLOWER inside the whisper encoder
+# than XLA's fused attention (full-model p50 154→173 ms), while at
+# s=1536 flash wins 14.4 vs 21.2 ms/op — blockwise streaming only pays
+# once the s×s score tensor is big enough that XLA must materialize it
+# through HBM.  Dispatch accordingly.
+FLASH_MIN_SEQ = 1024
+
+# trace-time path counters: which implementation the dispatcher chose for
+# each compiled program (bench --debug asserts on these)
+dispatch_stats = {"flash": 0, "xla": 0}
+
+
 def attention(q, k, v, causal: bool = False, scale: float | None = None):
-    """Dispatch: pallas flash kernel on TPU when shapes tile cleanly,
-    plain XLA attention otherwise (XLA fuses well for small shapes)."""
+    """Dispatch: pallas flash kernel on TPU for long sequences (where
+    blockwise streaming beats materializing the score tensor), plain XLA
+    attention otherwise — the measured winner at short sequences."""
     import jax
 
     s, d = q.shape[2], q.shape[3]
-    if jax.default_backend() == "tpu" and s >= 256 and s % 128 == 0 \
-            and d % 64 == 0:
+    if jax.default_backend() == "tpu" and s >= FLASH_MIN_SEQ \
+            and s % 128 == 0 and d % 64 == 0:
+        dispatch_stats["flash"] += 1
         return flash_attention(q, k, v, causal=causal, scale=scale)
+    dispatch_stats["xla"] += 1
     from ..parallel.ring_attention import attention_reference
     return attention_reference(q, k, v, causal=causal, scale=scale)

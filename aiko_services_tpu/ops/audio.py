@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["mel_filterbank", "log_mel_spectrogram", "stft",
+           "stft_complex", "istft", "mel_to_linear", "mel_inverse_filterbank",
+           "griffin_lim",
            "WHISPER_SAMPLE_RATE", "WHISPER_N_FFT", "WHISPER_HOP"]
 
 WHISPER_SAMPLE_RATE = 16000
@@ -101,3 +103,80 @@ def log_mel_spectrogram(audio, num_mels: int = 80,
                            jnp.max(log_spec, axis=(1, 2),
                                    keepdims=True) - 8.0)
     return (log_spec + 4.0) / 4.0
+
+
+# -- inverse path: spectrogram → waveform (the TTS vocoder leg) --------------
+
+def stft_complex(audio, n_fft: int = WHISPER_N_FFT, hop: int = WHISPER_HOP):
+    """audio: [B, T_samples] → complex spectrum [B, T_frames, n_fft//2+1]
+    (Hann window, centred — the invertible counterpart of stft())."""
+    pad = n_fft // 2
+    audio = jnp.pad(audio, ((0, 0), (pad, pad)), mode="reflect")
+    num_frames = 1 + (audio.shape[1] - n_fft) // hop
+    idx = (jnp.arange(num_frames)[:, None] * hop +
+           jnp.arange(n_fft)[None, :])
+    frames = audio[:, idx]
+    window = jnp.hanning(n_fft + 1)[:-1].astype(audio.dtype)
+    return jnp.fft.rfft(frames * window, axis=-1)
+
+
+def istft(spectrum, n_fft: int = WHISPER_N_FFT, hop: int = WHISPER_HOP):
+    """Inverse STFT by windowed overlap-add with COLA normalization.
+    spectrum: [B, T_frames, n_fft//2+1] complex → audio [B, T_samples]."""
+    frames = jnp.fft.irfft(spectrum, n=n_fft, axis=-1)   # [B, T, n_fft]
+    window = jnp.hanning(n_fft + 1)[:-1].astype(frames.dtype)
+    frames = frames * window
+    batch, num_frames, _ = frames.shape
+    length = n_fft + hop * (num_frames - 1)
+
+    # overlap-add via scatter: positions[t] = t*hop + arange(n_fft)
+    positions = (jnp.arange(num_frames)[:, None] * hop +
+                 jnp.arange(n_fft)[None, :]).reshape(-1)
+    flat = frames.reshape(batch, -1)
+    audio = jnp.zeros((batch, length), frames.dtype).at[:, positions].add(
+        flat)
+    # window-square normalization (COLA)
+    norm = jnp.zeros((length,), frames.dtype).at[positions].add(
+        jnp.tile(window * window, (num_frames,)))
+    audio = audio / jnp.maximum(norm, 1e-8)[None, :]
+    pad = n_fft // 2
+    return audio[:, pad:length - pad]
+
+
+@functools.lru_cache(maxsize=4)
+def mel_inverse_filterbank(num_mels: int = 80, n_fft: int = WHISPER_N_FFT,
+                           sample_rate: int = WHISPER_SAMPLE_RATE):
+    """Pseudo-inverse of the mel filterbank: [num_mels, n_fft//2+1]
+    (numpy constant — same lru_cache/tracer rule as mel_filterbank)."""
+    forward_bank = np.asarray(mel_filterbank(num_mels, n_fft, sample_rate))
+    return np.linalg.pinv(forward_bank).astype(np.float32)
+
+
+def mel_to_linear(log_mel, num_mels: int = 80, n_fft: int = WHISPER_N_FFT,
+                  sample_rate: int = WHISPER_SAMPLE_RATE):
+    """Invert whisper log-mel normalization back to a linear magnitude
+    spectrogram estimate: [B, T, mels] → [B, T, n_fft//2+1]."""
+    log10 = log_mel * 4.0 - 4.0                 # undo (x+4)/4
+    mels = jnp.power(10.0, log10)               # undo log10
+    linear = mels @ jnp.asarray(
+        mel_inverse_filterbank(num_mels, n_fft, sample_rate))
+    return jnp.sqrt(jnp.maximum(linear, 0.0))   # power → magnitude
+
+
+def griffin_lim(magnitude, n_iter: int = 32, n_fft: int = WHISPER_N_FFT,
+                hop: int = WHISPER_HOP):
+    """Phase recovery: magnitude [B, T, n_fft//2+1] → audio [B, samples].
+    Classic Griffin-Lim as a lax.fori_loop (static shapes, jits clean)."""
+    def project(audio):
+        spectrum = stft_complex(audio, n_fft, hop)
+        phase = spectrum / jnp.maximum(jnp.abs(spectrum), 1e-8)
+        t = min(phase.shape[1], magnitude.shape[1])
+        return istft(magnitude[:, :t].astype(jnp.complex64) *
+                     phase[:, :t], n_fft, hop)
+
+    audio = istft(magnitude.astype(jnp.complex64), n_fft, hop)
+
+    def body(_, audio):
+        return project(audio)
+
+    return jax.lax.fori_loop(0, n_iter, body, audio)

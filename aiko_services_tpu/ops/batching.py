@@ -140,10 +140,18 @@ class BatchingScheduler:
                 batch = [queue.popleft()
                          for _ in range(min(self.max_batch, len(queue)))]
             # items are already popped: every callback MUST fire, or the
-            # stream's frame silently vanishes — errors fan out as results
+            # stream's frame silently vanishes — errors fan out as results.
+            # process_batch may return None: it took ownership of the
+            # items and will fire their callbacks itself (the pipelined
+            # results path: device work dispatched async, a worker thread
+            # syncs + delivers, and the NEXT batch collates while this one
+            # computes — host↔device transfer overlaps device compute).
+            deferred = False
             try:
                 results = self.process_batch(bucket_key, batch)
-                if len(results) != len(batch):
+                if results is None:
+                    deferred = True
+                elif len(results) != len(batch):
                     raise RuntimeError(
                         f"process_batch returned {len(results)} results "
                         f"for {len(batch)} items")
@@ -156,8 +164,9 @@ class BatchingScheduler:
                 int(len(batch) >= self.max_batch)
             self.stats["wait_sum"] += sum(now - i.enqueue_time
                                           for i in batch)
-            for item, result in zip(batch, results):
-                item.callback(item.stream_id, result)
+            if not deferred:
+                for item, result in zip(batch, results):
+                    item.callback(item.stream_id, result)
             processed += len(batch)
 
     def attach(self, engine, period: float = 0.005) -> int:

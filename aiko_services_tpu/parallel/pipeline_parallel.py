@@ -65,7 +65,9 @@ class StagedExecutor:
 
     def submit(self, x):
         """Enqueue one frame through all stages; returns the (device-
-        resident, still-computing) final value immediately."""
+        resident, still-computing) final value immediately.  in_flight
+        counts frames submitted but not yet collect()ed — the occupancy
+        the dashboard/EC shares report."""
         import jax
 
         for index, fn in enumerate(self._fns):
@@ -74,9 +76,17 @@ class StagedExecutor:
         self.in_flight += 1
         return x
 
+    def collect(self, y):
+        """Block for a submitted frame's value (host numpy) and retire it
+        from the in-flight count."""
+        value = self.result(y)
+        self.in_flight = max(0, self.in_flight - 1)
+        return value
+
     @staticmethod
     def result(y):
-        """Block for a submitted frame's value (host numpy)."""
+        """Block for a submitted frame's value (host numpy) without
+        touching occupancy bookkeeping."""
         import numpy as np
 
         return np.asarray(y)
@@ -85,7 +95,7 @@ class StagedExecutor:
         """Pipeline a sequence: submit everything (filling all stages),
         then collect in order."""
         pending = [self.submit(frame) for frame in frames]
-        return [self.result(y) for y in pending]
+        return [self.collect(y) for y in pending]
 
 
 def gpipe_spmd(stage_fn, mesh, num_microbatches: int,

@@ -34,6 +34,7 @@
 
 from __future__ import annotations
 
+import itertools
 import json
 import time
 from dataclasses import dataclass, field
@@ -265,6 +266,7 @@ class Frame:
     metrics: dict = field(default_factory=dict)
     deferred_at: int | None = None      # topo index parked at (batching)
     deferred_since: float = 0.0
+    reply_to: tuple | None = None       # (topic, hop_id): remote serving
 
     @property
     def stream_id(self) -> str:
@@ -397,7 +399,8 @@ class Pipeline(PipelineElement):
                  element_classes: dict | None = None,
                  services_cache: ServicesCache | None = None,
                  stream_lease_time: float = STREAM_LEASE_TIME,
-                 auto_create_streams: bool = False):
+                 auto_create_streams: bool = False,
+                 remote_timeout: float = 30.0):
         self._element_classes = element_classes or {}
         self.graph = PipelineGraph.from_definition(definition)
         self.graph.validate(definition)
@@ -420,6 +423,11 @@ class Pipeline(PipelineElement):
         self._remote: dict[str, _RemoteElementPlaceholder] = {}
         self._services_cache = services_cache
         self._frame_handlers: list[Callable] = []
+        # outstanding request/response remote hops: hop_id → (frame,
+        # node_name, timeout lease)
+        self.remote_timeout = remote_timeout
+        self._pending_remote: dict = {}
+        self._hop_counter = itertools.count(1)
         self._create_elements()
         self._precompute_schedule()
         self.ec_producer.update("element_count", len(self.graph))
@@ -556,9 +564,12 @@ class Pipeline(PipelineElement):
 
     # -- frame engine (reference hot loop: pipeline.py:623-715) -------------
     def process_frame(self, frame_or_stream_id, swag: dict | None = None,
+                      _reply_to: tuple | None = None,
                       **_kwargs) -> FrameOutput:
         """Dual interface: called with (Frame, **inputs) when nested as an
-        element, or with (stream_id, swag) via the actor mailbox."""
+        element, or with (stream_id, swag) via the actor mailbox.
+        _reply_to (internal, set by process_frame_remote): address the
+        final swag back to a remote caller when the walk completes."""
         if isinstance(frame_or_stream_id, Frame):
             # nested as an element: isolate the walk on a swag copy so a
             # nested failure or scratch value never mutates the parent frame;
@@ -586,7 +597,7 @@ class Pipeline(PipelineElement):
                                         frame_or_stream_id)
                     return FrameOutput(False, diagnostic="unknown stream")
             frame = Frame(stream=stream, frame_id=stream.next_frame_id(),
-                          swag=dict(swag or {}))
+                          swag=dict(swag or {}), reply_to=_reply_to)
         if stream.lease is not None:
             stream.lease.extend()
 
@@ -634,7 +645,8 @@ class Pipeline(PipelineElement):
             element_start = time.perf_counter()
 
             if isinstance(element, _RemoteElementPlaceholder):
-                ok, outputs = self._process_remote(element, frame, inputs)
+                ok, outputs = self._process_remote(element, frame,
+                                                   inputs, node.name)
             else:
                 try:
                     result = element.process_frame(frame, **inputs)
@@ -664,6 +676,8 @@ class Pipeline(PipelineElement):
             time.perf_counter() - frame.metrics["time_pipeline_start"]
         for handler in self._frame_handlers:
             handler(frame)
+        if frame.reply_to is not None:
+            self._send_remote_reply(frame, True, swag)
         return FrameOutput(True, dict(swag))
 
     def _merge_outputs(self, node, element_def, outputs, swag) -> None:
@@ -701,11 +715,21 @@ class Pipeline(PipelineElement):
                     renamed[dst] = outputs[src]
         swag.update(renamed)
 
-    def _process_remote(self, placeholder, frame, inputs):
-        """Fire a frame at a discovered remote pipeline.  Fire-and-forget,
-        like the reference (pipeline.py:693-695: result return is an
-        acknowledged TODO there; our data plane handles co-located tensor
-        handoff on-device instead).
+    def _process_remote(self, placeholder, frame, inputs, node_name):
+        """Ship a frame to a discovered remote pipeline.
+
+        Result semantics (this framework's contract — the reference's hop
+        is fire-and-forget with result return an acknowledged TODO,
+        reference pipeline.py:693-695):
+
+        * remote node declares NO outputs → one-way: publish and continue
+          the walk (sink semantics, e.g. remote recorder/speaker);
+        * remote node declares outputs → request/response: the frame
+          DEFERS here, the serving pipeline walks its own graph and
+          replies with its final swag to our topic_in
+          (resume_remote_frame), which resumes the walk with the declared
+          outputs merged; a lease fails the frame if no reply arrives
+          within remote_timeout.
 
         The serving pipeline should run with auto_create_streams=True so
         frames for upstream-created streams are accepted.  Values cross the
@@ -714,14 +738,67 @@ class Pipeline(PipelineElement):
         plane bypasses this entirely for co-located elements)."""
         if not placeholder.found:
             return False, None
-        placeholder.proxy.process_frame(frame.stream_id, inputs)
-        return True, {}
+        element_def = self._element_defs[node_name]
+        if not element_def.output:
+            placeholder.proxy.process_frame(frame.stream_id, inputs)
+            return True, {}
+        hop_id = f"{self.name}.{next(self._hop_counter)}"
+        lease = Lease(self.runtime.event, self.remote_timeout, hop_id,
+                      lease_expired_handler=self._remote_hop_expired)
+        self._pending_remote[hop_id] = (frame, node_name, lease)
+        placeholder.proxy.process_frame_remote(
+            frame.stream_id, inputs, self.topic_in, hop_id)
+        return True, DEFERRED
+
+    def _remote_hop_expired(self, hop_id) -> None:
+        pending = self._pending_remote.pop(str(hop_id), None)
+        if pending is None:
+            return
+        frame, node_name, _lease = pending
+        self.resume_frame(frame, node_name, TimeoutError(
+            f"remote element {node_name}: no reply within "
+            f"{self.remote_timeout}s"))
+
+    def resume_remote_frame(self, hop_id, ok, outputs=None):
+        """Reply entry (invoked over the wire by the serving pipeline)."""
+        pending = self._pending_remote.pop(str(hop_id), None)
+        if pending is None:
+            self.logger.warning("pipeline %s: stale remote reply %r",
+                                self.name, hop_id)
+            return
+        frame, node_name, lease = pending
+        lease.terminate()
+        if str(ok) not in ("true", "True"):
+            self.resume_frame(frame, node_name, RuntimeError(
+                f"remote element {node_name} failed: {outputs!r}"))
+            return
+        self.resume_frame(frame, node_name, dict(outputs or {}))
+
+    def process_frame_remote(self, stream_id, inputs, reply_topic, hop_id):
+        """Serving entry: walk a frame for a remote caller and reply with
+        the final swag when it completes (including through DEFERRED
+        elements)."""
+        self.process_frame(stream_id, dict(inputs or {}),
+                           _reply_to=(str(reply_topic), str(hop_id)))
 
     def _fail_frame(self, frame, node_name, diagnostic) -> None:
         self.logger.error("pipeline %s stream %s frame %s: element %s "
                           "failed: %s", self.name, frame.stream_id,
                           frame.frame_id, node_name, diagnostic)
+        if frame.reply_to is not None:
+            self._send_remote_reply(frame, False,
+                                    {"diagnostic": str(diagnostic)})
         self.destroy_stream(frame.stream_id)
+
+    def _send_remote_reply(self, frame, ok: bool, outputs: dict) -> None:
+        from .utils import generate
+        topic, hop_id = frame.reply_to
+        # only wire-expressible values cross back: tensors must be
+        # PE_DataEncode'd (to str) by the serving graph before its end
+        wire = {k: v for k, v in outputs.items()
+                if isinstance(v, (str, int, float, bool))}
+        self.runtime.publish(topic, generate(
+            "resume_remote_frame", [hop_id, ok, wire]))
 
     def stop(self) -> None:
         for stream_id in list(self.streams):

@@ -42,7 +42,8 @@ class ProcessRuntime:
     def __init__(self, name: str | None = None, engine: EventEngine = None,
                  transport_factory=None, namespace: str | None = None,
                  process_id: str | None = None,
-                 terminate_on_registrar_absent: bool = False):
+                 terminate_on_registrar_absent: bool = False,
+                 log_transport: bool | None = None):
         self.namespace = namespace or get_namespace()
         self.hostname = get_hostname()
         # unique id even when many runtimes share one OS process (tests)
@@ -56,6 +57,11 @@ class ProcessRuntime:
             f"{self.namespace}/{REGISTRAR_BOOT_SUFFIX}"
         self.name = name or self.process_id
         self.logger = get_logger(f"process.{self.name}")
+        # distributed logging: actors publish their records to
+        # {topic_path}/{sid}/log (reference gate: AIKO_LOG_MQTT,
+        # process.py:103-113 there)
+        self.log_transport = log_transport if log_transport is not None \
+            else os.environ.get("AIKO_TPU_LOG_TRANSPORT", "0") == "1"
 
         self.event = engine or EventEngine()
         self.connection = Connection()

@@ -164,6 +164,12 @@ class ECProducer:
         for name, value in items:
             publish(response_topic,
                     generate("add", [name, generate_sexpr(value)]))
+        # end-of-snapshot marker on the response topic: per-publisher FIFO
+        # ordering makes this arrive after every snapshot item, so the
+        # consumer synchronizes on it rather than counting adds (counting
+        # mis-fires when live deltas interleave with the snapshot);
+        # topic_out carries it too for observers (reference: share.py:322-333)
+        publish(response_topic, generate("sync", [response_topic]))
         publish(self.service.topic_out,
                 generate("sync", [response_topic]))
 
@@ -235,23 +241,21 @@ class ECConsumer:
         except Exception:
             return
         if command == "item_count" and params:
-            self._expected = parse_int(params[0])
+            self._expected = parse_int(params[0])    # diagnostic only
         elif command in ("add", "update") and len(params) >= 2:
             self.cache[params[0]] = _decode_value(params[1])
             self._fire(command, params[0], self.cache[params[0]])
-            if self._expected is not None:
-                self._expected -= 1
-                if self._expected <= 0:
-                    self._expected = None
-                    self.synchronized = True
-                    self._fire("sync", None, None)
         elif command == "remove" and params:
             self.cache.pop(params[0], None)
             self._fire("remove", params[0], None)
-        if command == "item_count" and self._expected == 0:
+        elif command == "sync":
+            # end-of-snapshot marker: ordered after every snapshot item
+            # by per-publisher FIFO, immune to interleaved live deltas
+            # (counting adds is not — they decrement the count early)
             self._expected = None
-            self.synchronized = True
-            self._fire("sync", None, None)
+            if not self.synchronized:
+                self.synchronized = True
+                self._fire("sync", None, None)
 
     def _fire(self, command, name, value) -> None:
         for handler in list(self._handlers):

@@ -51,8 +51,10 @@ def get_logger(name: str, level=None, handler=None) -> logging.Logger:
 class TransportLoggingHandler(logging.Handler):
     """Publishes log records to `topic` on a Message transport.
 
-    Records emitted before the transport is connected are ring-buffered
-    (up to 128) and flushed on first successful publish.
+    `message` may be the transport itself or a zero-arg callable
+    returning it (lazy: actors are often built before the runtime's
+    transport connects).  Records emitted before the transport is up are
+    ring-buffered (up to 128) and flushed on first successful publish.
     """
 
     def __init__(self, message, topic: str):
@@ -61,14 +63,18 @@ class TransportLoggingHandler(logging.Handler):
         self.topic = topic
         self._ring: deque = deque(maxlen=_RING_SIZE)
 
+    def _transport(self):
+        return self.message() if callable(self.message) else self.message
+
     def emit(self, record):
         try:
             payload = self.format(record)
         except Exception:
             return
-        if self.message is not None and self.message.connected():
+        transport = self._transport()
+        if transport is not None and transport.connected():
             while self._ring:
-                self.message.publish(self.topic, self._ring.popleft())
-            self.message.publish(self.topic, payload)
+                transport.publish(self.topic, self._ring.popleft())
+            transport.publish(self.topic, payload)
         else:
             self._ring.append(payload)

@@ -1,0 +1,105 @@
+# Distributed logging end-to-end: an actor's ordinary logger calls
+# publish to its {topic_path}/log topic (runtime-gated, mirroring the
+# reference's AIKO_LOG_MQTT: utilities/logger.py:128-164 +
+# process.py:103-113 there), the Recorder's namespace filter aggregates
+# them, and the dashboard log page tails them live.
+
+import logging
+
+from aiko_services_tpu.actor import Actor
+from aiko_services_tpu.dashboard import DashboardState
+from aiko_services_tpu.recorder import Recorder
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.utils.logger import TransportLoggingHandler
+
+
+def settle(engine, steps=10):
+    for _ in range(steps):
+        engine.step()
+
+
+def test_actor_logs_reach_recorder_and_dashboard(make_runtime, engine):
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    ops_rt = make_runtime("ops_host").initialize()
+    recorder = Recorder(ops_rt)
+    state = DashboardState(ops_rt)
+    settle(engine, 15)
+
+    app_rt = make_runtime("app_host", log_transport=True).initialize()
+    worker = Actor(app_rt, "log_worker")
+    settle(engine, 15)
+
+    # select the worker in the dashboard and open its log page
+    names = [f.name for f in state.services()]
+    state.selected_index = names.index("log_worker")
+    state.open_log()
+
+    worker.logger.warning("thermal threshold crossed")
+    settle(engine, 10)
+
+    # recorder aggregated it under the worker's log topic
+    assert worker.topic_log in recorder.topics()
+    tail = recorder.tail(worker.topic_log)
+    assert any("thermal threshold crossed" in line for line in tail)
+    # dashboard log page sees the same record live
+    assert any("thermal threshold crossed" in line
+               for line in state.log_lines)
+
+    # records carry level + logger name for the ops reader
+    assert any("WARNING" in line and "log_worker" in line
+               for line in tail)
+    state.terminate()
+
+
+def test_log_transport_off_by_default(make_runtime, engine):
+    rt = make_runtime("quiet_host").initialize()
+    recorder = Recorder(rt)
+    worker = Actor(rt, "quiet_worker")
+    settle(engine)
+    worker.logger.warning("should stay local")
+    settle(engine, 10)
+    assert worker.topic_log not in recorder.topics()
+
+
+def test_stop_removes_transport_handler(make_runtime, engine):
+    rt = make_runtime("stop_host", log_transport=True).initialize()
+    worker = Actor(rt, "stoppable")
+    handler = worker._transport_log_handler
+    assert handler in worker.logger.handlers
+    worker.stop()
+    assert handler not in worker.logger.handlers
+
+
+def test_transport_handler_rings_until_connected():
+    """Records logged before the transport connects are buffered and
+    flushed on the first publish after connection."""
+    published = []
+
+    class FakeTransport:
+        def __init__(self):
+            self.up = False
+
+        def connected(self):
+            return self.up
+
+        def publish(self, topic, payload, retain=False):
+            published.append((topic, payload))
+
+    transport = FakeTransport()
+    handler = TransportLoggingHandler(transport, "ns/h/p/1/log")
+    logger = logging.getLogger("test.ring")
+    logger.handlers = [handler]
+    logger.propagate = False
+    logger.setLevel(logging.INFO)
+
+    logger.info("early one")
+    logger.info("early two")
+    assert published == []
+    transport.up = True
+    logger.info("after connect")
+    assert [p for _, p in published] == ["early one", "early two",
+                                        "after connect"]

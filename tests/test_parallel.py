@@ -261,7 +261,8 @@ def test_staged_executor_overlaps_dispatch():
     executor = StagedExecutor(stages, devices=jax.devices()[:2])
     pending = [executor.submit(jnp.ones((64, 64)) * i) for i in range(8)]
     assert executor.in_flight == 8          # all dispatched, none forced
-    outs = [StagedExecutor.result(y) for y in pending]
+    outs = [executor.collect(y) for y in pending]
+    assert executor.in_flight == 0          # occupancy retires on collect
     np.testing.assert_allclose(outs[3], np.ones((64, 64)) * 12.0)
 
 
@@ -291,3 +292,30 @@ def test_gpipe_spmd_matches_sequential():
         expected = jnp.tanh(expected @ weights[stage])
     np.testing.assert_allclose(np.asarray(result), np.asarray(expected),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_collectives_mesh_fabric_and_sizes():
+    """Mesh-aware helpers: fabric classification (single-host mesh is all
+    ICI), resharding, and collective wire-byte estimates."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from aiko_services_tpu.parallel.collectives import (
+        axis_fabric, collective_bytes, mesh_fabric_report, reshard)
+
+    mesh = create_mesh({"data": 2, "model": 4})
+    report = mesh_fabric_report(mesh)
+    assert report == {"data": "ici", "model": "ici"}
+    assert axis_fabric(mesh, "model") == "ici"
+
+    x = jnp.ones((8, 16), jnp.bfloat16)
+    placed = reshard(x, mesh, P("data", "model"))
+    assert placed.sharding.spec == P("data", "model")
+
+    # 8x16 bf16 = 256 bytes; all_gather over model(4) moves 3x payload
+    assert collective_bytes(x, "model", mesh, "all_gather") == 256 * 3
+    assert collective_bytes(x, "model", mesh, "reduce_scatter") == \
+        256 * 3 // 4
+    assert collective_bytes(x, "model", mesh, "ppermute") == 256
+    with pytest.raises(ValueError):
+        collective_bytes(x, "model", mesh, "gossip")

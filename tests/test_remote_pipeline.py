@@ -1,0 +1,224 @@
+# Remote pipeline elements, end-to-end across two runtimes on the memory
+# broker: discovery swap (absent → found → absent → found), the tensor
+# boundary (PE_DataEncode/Decode), and this framework's request/response
+# result semantics — the serving pipeline replies with its final swag and
+# the calling frame resumes with the remote node's declared outputs
+# merged (the reference's hop is fire-and-forget with result return an
+# acknowledged TODO: reference pipeline.py:693-695).
+
+import numpy as np
+
+from aiko_services_tpu.pipeline import (
+    DEFERRED, Frame, FrameOutput, Pipeline, PipelineElement,
+    parse_pipeline_definition)
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.share import ServicesCache
+
+
+def settle(engine, steps=20):
+    for _ in range(steps):
+        engine.step()
+
+
+def element(name, inputs=(), outputs=(), parameters=None, deploy=None):
+    return {
+        "name": name,
+        "input": [{"name": n} for n in inputs],
+        "output": [{"name": n} for n in outputs],
+        "parameters": parameters or {},
+        "deploy": deploy or {},
+    }
+
+
+class PE_MakeTensor(PipelineElement):
+    def process_frame(self, frame: Frame, **_) -> FrameOutput:
+        return FrameOutput(True, {"data": np.arange(6, dtype=np.float32)})
+
+
+class PE_TensorTotal(PipelineElement):
+    """Serving-side work: sum the decoded tensor."""
+
+    def process_frame(self, frame: Frame, data=None, **_) -> FrameOutput:
+        return FrameOutput(True, {"total": float(np.asarray(data).sum())})
+
+
+class PE_UseTotal(PipelineElement):
+    def process_frame(self, frame: Frame, total=0, **_) -> FrameOutput:
+        return FrameOutput(True, {"final": float(total) + 0.5})
+
+
+def serving_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "serve_pipe", "runtime": "python",
+        "graph": ["(PE_DataDecode (PE_TensorTotal))"],
+        "elements": [
+            element("PE_DataDecode", ["data"], ["data"]),
+            element("PE_TensorTotal", ["data"], ["total"]),
+        ],
+    })
+
+
+def calling_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "call_pipe", "runtime": "python",
+        "graph": ["(PE_MakeTensor (PE_DataEncode (remote_total "
+                  "(PE_UseTotal))))"],
+        "elements": [
+            element("PE_MakeTensor", [], ["data"]),
+            element("PE_DataEncode", ["data"], ["data"]),
+            element("remote_total", ["data"], ["total"],
+                    deploy={"remote": {"service_filter":
+                                       {"name": "serve_pipe"}}}),
+            element("PE_UseTotal", ["total"], ["final"]),
+        ],
+    })
+
+
+CALLER_CLASSES = {"PE_MakeTensor": PE_MakeTensor, "PE_UseTotal": PE_UseTotal}
+
+
+def build_system(make_runtime, engine):
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    serve_rt = make_runtime("serve_host").initialize()
+    serving = Pipeline(serve_rt, serving_definition(),
+                       element_classes={"PE_TensorTotal": PE_TensorTotal},
+                       auto_create_streams=True, stream_lease_time=0)
+
+    call_rt = make_runtime("call_host").initialize()
+    caller = Pipeline(call_rt, calling_definition(),
+                      element_classes=CALLER_CLASSES,
+                      services_cache=ServicesCache(call_rt),
+                      stream_lease_time=0, remote_timeout=10.0)
+    settle(engine, 30)
+    return serve_rt, serving, call_rt, caller
+
+
+def test_remote_request_response_across_runtimes(make_runtime, engine):
+    _, serving, _, caller = build_system(make_runtime, engine)
+    assert caller.remote_elements_ready()
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 40)
+
+    assert done, "remote frame never completed"
+    swag = done[0].swag
+    # tensor crossed encoded, served total came back, local tail consumed
+    assert float(swag["total"]) == 15.0
+    assert swag["final"] == 15.5
+    # serving side walked its own stream for the caller's stream id
+    assert "s1" in serving.streams or serving.auto_create_streams
+    # the hop is settled: no pending leases left ticking
+    assert not caller._pending_remote
+
+
+def test_remote_element_discovery_swap_both_directions(make_runtime,
+                                                      engine):
+    serve_rt, serving, _, caller = build_system(make_runtime, engine)
+    placeholder = caller._remote["remote_total"]
+    assert placeholder.found
+
+    # serving pipeline leaves → placeholder reverts to absent
+    serving.stop()
+    serve_rt.terminate()
+    settle(engine, 40)
+    assert not placeholder.found
+
+    # frames now fail cleanly (stream destroyed, not process exit)
+    caller.create_stream("s2", lease_time=0)
+    ok, _ = caller.process_frame("s2", {})
+    assert not ok
+    assert "s2" not in caller.streams
+
+    # a replacement serving pipeline appears → swap back in
+    serve_rt2 = make_runtime("serve_host2").initialize()
+    Pipeline(serve_rt2, serving_definition(),
+             element_classes={"PE_TensorTotal": PE_TensorTotal},
+             auto_create_streams=True, stream_lease_time=0)
+    settle(engine, 40)
+    assert placeholder.found
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s3", lease_time=0)
+    caller.post("process_frame", "s3", {})
+    settle(engine, 40)
+    assert done and done[0].swag["final"] == 15.5
+
+
+def test_remote_hop_times_out_without_reply(make_runtime, engine):
+    """A serving pipeline that never replies must not wedge the caller:
+    the hop lease expires and the frame fails."""
+    _, serving, _, caller = build_system(make_runtime, engine)
+
+    # break the serving side AFTER discovery: swallow frames silently
+    serving.process_frame_remote = lambda *args, **kwargs: None
+
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 20)
+    assert caller._pending_remote          # hop outstanding
+
+    engine.clock.advance(11.0)             # > remote_timeout
+    settle(engine, 20)
+    assert not caller._pending_remote
+    assert "s1" not in caller.streams      # frame failed, stream destroyed
+
+
+def test_remote_one_way_when_no_outputs_declared(make_runtime, engine):
+    """A remote node with no declared outputs is a sink: the caller's walk
+    continues immediately (fire-and-forget semantics, explicit)."""
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    serve_rt = make_runtime("serve_host").initialize()
+    received = []
+    serving = Pipeline(serve_rt, serving_definition(),
+                       element_classes={"PE_TensorTotal": PE_TensorTotal},
+                       auto_create_streams=True, stream_lease_time=0)
+    serving.add_frame_handler(received.append)
+
+    call_rt = make_runtime("call_host").initialize()
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "oneway", "runtime": "python",
+        "graph": ["(PE_MakeTensor (PE_DataEncode (remote_sink) "
+                  "(PE_After)))"],
+        "elements": [
+            element("PE_MakeTensor", [], ["data"]),
+            element("PE_DataEncode", ["data"], ["data"]),
+            element("remote_sink", ["data"], [],
+                    deploy={"remote": {"service_filter":
+                                       {"name": "serve_pipe"}}}),
+            element("PE_After", ["data"], ["tail_ran"]),
+        ],
+    })
+
+    class PE_After(PipelineElement):
+        def process_frame(self, frame, data=None, **_):
+            return FrameOutput(True, {"tail_ran": True})
+
+    caller = Pipeline(call_rt, definition,
+                      element_classes={"PE_MakeTensor": PE_MakeTensor,
+                                       "PE_After": PE_After},
+                      services_cache=ServicesCache(call_rt),
+                      stream_lease_time=0)
+    settle(engine, 30)
+    assert caller.remote_elements_ready()
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 40)
+    # caller completed without waiting; serving side processed the frame
+    assert done and done[0].swag["tail_ran"] is True
+    assert received and float(received[0].swag["total"]) == 15.0
+    assert not caller._pending_remote

@@ -136,3 +136,33 @@ def test_batched_asr_coalesces_streams(make_runtime, engine, tmp_path,
     assert stats["items"] == 6
     assert stats["batches"] <= 2          # coalesced, not one-by-one
     assert program.scheduler.mean_batch_size() >= 3.0
+
+
+def test_speech_pipeline_pipelined_results(make_runtime, engine, tmp_path,
+                                           wav_file):
+    """pipelined=True: the device sync happens on the compute worker
+    thread and completions arrive via the results queue — the frame still
+    finishes, driven by engine steps (real thread, so poll with real
+    sleeps)."""
+    import time as _time
+
+    runtime = make_runtime("pipelined_host").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition_dict = speech_definition(tmp_path, "batched")
+    definition_dict["parameters"]["PE_WhisperASR.pipelined"] = True
+    pipeline = Pipeline(runtime, parse_pipeline_definition(definition_dict),
+                        stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream(
+        "s1", lease_time=0,
+        parameters={"PE_AudioReadFile.pathname": wav_file})
+    pipeline.post("process_frame", "s1", {})
+    deadline = _time.monotonic() + 60.0
+    while not done and _time.monotonic() < deadline:
+        engine.clock.advance(0.01)
+        engine.step()
+        _time.sleep(0.002)
+    assert done, "pipelined speech frame never completed"
+    assert isinstance(done[0].swag["text"], str)
+    assert "time_PE_WhisperASR" in done[0].metrics
