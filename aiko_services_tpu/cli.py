@@ -30,15 +30,21 @@ def _make_runtime(name, transport):
     from .process import ProcessRuntime
 
     if transport == "mqtt":
-        from .transport.mqtt import MQTT_AVAILABLE, MqttMessage
+        from .transport.mqtt import MQTT_AVAILABLE, MQTTMessage
         if not MQTT_AVAILABLE:
             raise click.ClickException(
                 "mqtt transport requested but paho-mqtt is not installed")
 
         def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
-            return MqttMessage(on_message=on_message, lwt_topic=lwt_topic,
+            from .utils.configuration import \
+                get_transport_configuration
+            config = get_transport_configuration()
+            return MQTTMessage(on_message=on_message, lwt_topic=lwt_topic,
                                lwt_payload=lwt_payload,
-                               lwt_retain=lwt_retain)
+                               lwt_retain=lwt_retain,
+                               host=config.host, port=config.port,
+                               username=config.username,
+                               password=config.password, tls=config.tls)
         runtime = ProcessRuntime(name=name, transport_factory=factory)
     else:
         runtime = ProcessRuntime(name=name)

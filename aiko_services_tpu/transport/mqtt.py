@@ -2,15 +2,32 @@
 #
 # Capability parity with the reference MQTT wrapper
 # (reference: aiko_services/message/mqtt.py:64-284): connect with LWT,
-# TLS/credentials, subscribe/unsubscribe, wait-for-publish.  Gated on
-# paho-mqtt being importable; the in-memory broker is the default transport
-# so nothing in the framework requires paho.
+# TLS/credentials, subscribe/unsubscribe, wait-for-publish — plus the
+# robustness the reference lacks: automatic reconnect with exponential
+# backoff, re-subscribe after reconnect, and bounded buffering of
+# publishes made while disconnected (the reference busy-waits up to 2 s
+# and drops, mqtt.py:250-284).
+#
+# Reconnect ownership: a real paho client reconnects ITSELF — its
+# loop_start thread retries with reconnect_delay_set backoff, and racing
+# a second reconnect() against it corrupts the socket state.  So with
+# paho we configure its backoff and stand down; the timer-based
+# machinery below drives reconnection only for injected clients (tests,
+# alternative transports) that do not auto-reconnect.
+#
+# The underlying client is injectable (`client_factory`) so the
+# machinery is testable without a live broker; the default factory
+# builds a real paho client.  Gated on paho-mqtt being importable; the
+# in-memory broker is the default transport so nothing in the framework
+# requires it.
 
 from __future__ import annotations
 
 import threading
+from collections import deque
 
 from .message import Message
+from ..utils import get_logger
 
 __all__ = ["MQTT_AVAILABLE", "MQTTMessage"]
 
@@ -21,24 +38,70 @@ except ImportError:        # pragma: no cover - environment without paho
     _paho = None
     MQTT_AVAILABLE = False
 
+_BACKOFF_MIN = 0.5         # seconds; doubles per failed attempt
+_BACKOFF_MAX = 30.0
+_BUFFER_LIMIT = 1024       # publishes held while disconnected
 
-class MQTTMessage(Message):   # pragma: no cover - needs a live broker
+logger = get_logger("transport.mqtt")
+
+
+def _paho_factory():       # pragma: no cover - needs paho installed
+    if not MQTT_AVAILABLE:
+        raise ImportError(
+            "paho-mqtt is not installed; use the memory transport or "
+            "install paho-mqtt for multi-host control planes")
+    return _paho.Client(
+        callback_api_version=_paho.CallbackAPIVersion.VERSION2)
+
+
+def _is_failure(reason_code) -> bool:
+    """True when a CONNACK reason code reports failure (paho v2 passes a
+    ReasonCode object; fakes/v1 pass an int, 0 = success)."""
+    if hasattr(reason_code, "is_failure"):
+        return bool(reason_code.is_failure)
+    return bool(reason_code)
+
+
+class MQTTMessage(Message):
+    """Message transport over an MQTT broker.
+
+    The client object must expose the paho v2 surface used here:
+    connect/reconnect/disconnect, loop_start/loop_stop, subscribe/
+    unsubscribe, publish, will_set, and the on_connect/on_disconnect/
+    on_message callback slots."""
+
     def __init__(self, on_message=None, subscriptions=(),
                  host="localhost", port=1883, username=None, password=None,
                  tls=False, lwt_topic=None, lwt_payload=None,
-                 lwt_retain=False):
-        if not MQTT_AVAILABLE:
-            raise ImportError(
-                "paho-mqtt is not installed; use the memory transport or "
-                "install paho-mqtt for multi-host control planes")
+                 lwt_retain=False, client_factory=None,
+                 backoff_min=_BACKOFF_MIN, backoff_max=_BACKOFF_MAX,
+                 buffer_limit=_BUFFER_LIMIT):
         super().__init__(on_message, subscriptions)
         self.host, self.port = host, port
+        self.backoff_min, self.backoff_max = backoff_min, backoff_max
+        self._backoff = backoff_min
         self._connected_event = threading.Event()
-        self._client = _paho.Client(
-            callback_api_version=_paho.CallbackAPIVersion.VERSION2)
+        self._closing = False
+        self._lock = threading.RLock()
+        self._pending = deque(maxlen=buffer_limit)   # (topic, payload, retain)
+        self._reconnect_timer = None
+        self.stats = {"reconnects": 0, "buffered": 0, "dropped": 0,
+                      "last_error": None}
+
+        self._client = (client_factory or _paho_factory)()
+        # paho's network-loop thread auto-reconnects; give it our backoff
+        # and let it own reconnection (see module docstring)
+        self._client_reconnects = MQTT_AVAILABLE and \
+            isinstance(self._client, _paho.Client)
+        if self._client_reconnects:              # pragma: no cover - paho
+            # paho takes integer seconds and requires min <= max
+            min_delay = max(1, int(round(backoff_min)))
+            self._client.reconnect_delay_set(
+                min_delay=min_delay,
+                max_delay=max(min_delay, int(round(backoff_max))))
         if username:
             self._client.username_pw_set(username, password)
-        if tls:
+        if tls:                                      # pragma: no cover
             self._client.tls_set()
         if lwt_topic is not None:
             self._client.will_set(lwt_topic, lwt_payload, retain=lwt_retain)
@@ -46,14 +109,29 @@ class MQTTMessage(Message):   # pragma: no cover - needs a live broker
         self._client.on_disconnect = self._on_disconnect
         self._client.on_message = self._on_paho_message
 
-    def _on_connect(self, client, userdata, flags, reason_code, properties):
-        for topic in self.subscriptions:
+    # -- callbacks (broker/network thread) --------------------------------
+    def _on_connect(self, client, userdata, flags, reason_code,
+                    properties=None):
+        if _is_failure(reason_code):
+            # rejected CONNACK (bad credentials, not authorized, ...):
+            # NOT a connection — the broker will close the socket
+            self.stats["last_error"] = f"connect rejected: {reason_code}"
+            logger.warning("MQTT connect rejected by %s:%s: %s",
+                           self.host, self.port, reason_code)
+            return
+        # re-subscribe EVERY topic on EVERY (re)connect: broker-side
+        # session state cannot be assumed (clean-session default)
+        for topic in tuple(self.subscriptions):
             client.subscribe(topic)
+        self._backoff = self.backoff_min
         self._connected_event.set()
+        self._flush_pending()
 
-    def _on_disconnect(self, client, userdata, flags, reason_code,
-                       properties):
+    def _on_disconnect(self, client, userdata, flags, reason_code=None,
+                       properties=None):
         self._connected_event.clear()
+        if not self._closing and not self._client_reconnects:
+            self._schedule_reconnect()
 
     def _on_paho_message(self, client, userdata, message):
         if self.on_message is not None:
@@ -64,12 +142,72 @@ class MQTTMessage(Message):   # pragma: no cover - needs a live broker
                 pass    # binary topic: hand bytes through
             self.on_message(message.topic, payload)
 
+    # -- reconnect machinery (non-paho clients only) -----------------------
+    def _schedule_reconnect(self) -> None:
+        with self._lock:
+            if self._closing or (self._reconnect_timer is not None
+                                 and self._reconnect_timer.is_alive()):
+                return
+            delay = self._backoff
+            self._backoff = min(self._backoff * 2, self.backoff_max)
+            timer = threading.Timer(delay, self._attempt_reconnect)
+            timer.daemon = True
+            self._reconnect_timer = timer
+            timer.start()
+
+    def _attempt_reconnect(self) -> None:
+        # the lock spans the closing-check AND the reconnect so a
+        # concurrent disconnect() cannot interleave (reconnect-after-
+        # shutdown); RLock + fakes calling _on_connect synchronously is
+        # re-entrant-safe
+        with self._lock:
+            self._reconnect_timer = None
+            if self._closing or self.connected():
+                return
+            self.stats["reconnects"] += 1
+            try:
+                self._client.reconnect()
+            except Exception as exc:
+                self.stats["last_error"] = repr(exc)
+                logger.warning("MQTT reconnect to %s:%s failed (%r); "
+                               "retrying in %.1fs",
+                               self.host, self.port, exc, self._backoff)
+                self._schedule_reconnect()    # next try, doubled backoff
+
+    def _flush_pending(self) -> None:
+        # serialized so two threads (on_connect network thread + a
+        # publish() caller hitting the re-check) cannot interleave pops
+        # and reorder the buffered messages
+        with self._lock:
+            while self._pending:
+                try:
+                    topic, payload, retain = self._pending.popleft()
+                except IndexError:        # pragma: no cover - race
+                    break
+                self._client.publish(topic, payload, retain=retain)
+
+    # -- Message interface -------------------------------------------------
     def connect(self, timeout=5.0) -> None:
-        self._client.connect(self.host, self.port)
+        self._closing = False
+        try:
+            self._client.connect(self.host, self.port)
+        except Exception as exc:
+            self.stats["last_error"] = repr(exc)
+            logger.warning("MQTT connect to %s:%s failed (%r)",
+                           self.host, self.port, exc)
+            self._client.loop_start()
+            if not self._client_reconnects:
+                self._schedule_reconnect()
+            return
         self._client.loop_start()
         self._connected_event.wait(timeout)
 
     def disconnect(self) -> None:
+        with self._lock:
+            self._closing = True
+            if self._reconnect_timer is not None:
+                self._reconnect_timer.cancel()
+                self._reconnect_timer = None
         self._client.loop_stop()
         self._client.disconnect()
         self._connected_event.clear()
@@ -77,21 +215,57 @@ class MQTTMessage(Message):   # pragma: no cover - needs a live broker
     def connected(self) -> bool:
         return self._connected_event.is_set()
 
+    def wait_connected(self, timeout=5.0) -> bool:
+        return self._connected_event.wait(timeout)
+
     def publish(self, topic, payload, retain=False, wait=False) -> None:
+        if not self.connected():
+            # wait=True means the caller needs delivery, not buffering
+            # (e.g. presence marker before exit): give the reconnect a
+            # bounded chance first
+            if not (wait and self._connected_event.wait(2.0)):
+                self.stats["buffered"] += 1
+                if len(self._pending) == self._pending.maxlen:
+                    self.stats["dropped"] += 1
+                self._pending.append((topic, payload, retain))
+                # a reconnect may have flushed between the check and the
+                # append — drain again so the message cannot strand
+                if self.connected():
+                    self._flush_pending()
+                return
         info = self._client.publish(topic, payload, retain=retain)
-        if wait:
+        if wait and hasattr(info, "wait_for_publish"):
             info.wait_for_publish(timeout=2.0)
 
     def subscribe(self, topic) -> None:
         self.subscriptions.add(topic)
-        if self.connected():
+        # always forward: if the resubscribe loop in _on_connect already
+        # snapshotted (race), this call lands it; while disconnected paho
+        # returns MQTT_ERR_NO_CONN without raising and the next
+        # _on_connect replays from self.subscriptions
+        try:
             self._client.subscribe(topic)
+        except Exception:
+            pass
 
     def unsubscribe(self, topic) -> None:
         self.subscriptions.discard(topic)
-        if self.connected():
+        try:
             self._client.unsubscribe(topic)
+        except Exception:
+            pass
 
     def set_last_will_and_testament(self, topic, payload,
                                     retain=False) -> None:
+        """LWT can only change on (re)connect: cycle the connection if
+        live (reference behavior: aiko_services/message/mqtt.py:187-196)."""
         self._client.will_set(topic, payload, retain=retain)
+        if self.connected():
+            # paho auto-reconnects only on UNEXPECTED drops; after a
+            # requested disconnect we must redial explicitly
+            self._client.disconnect()
+            if self._client_reconnects:          # pragma: no cover - paho
+                try:
+                    self._client.reconnect()
+                except Exception as exc:
+                    self.stats["last_error"] = repr(exc)

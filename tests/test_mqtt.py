@@ -1,0 +1,341 @@
+# MQTT transport hardening tests — no live broker: a fake paho-surface
+# client backed by an in-process "broker" exercises connect, pub/sub
+# round-trip, LWT on ungraceful drop, reconnect with exponential backoff,
+# re-subscribe after reconnect, and publish buffering while disconnected
+# (reference has zero tests for its MQTT wrapper;
+# aiko_services/message/mqtt.py:64-284).
+
+import threading
+import time
+
+from aiko_services_tpu.transport.message import topic_matches
+from aiko_services_tpu.transport.mqtt import MQTTMessage
+
+
+class FakeBroker:
+    """Minimal broker shared by FakePaho clients: routes published
+    messages to subscribed clients, fires LWT on ungraceful drops."""
+
+    def __init__(self):
+        self.clients = []
+        self.down = False          # simulates broker/network outage
+        self.retained = {}
+
+    def route(self, topic, payload, retain=False):
+        if retain:                 # MQTT: empty retained payload clears
+            if payload in ("", b""):
+                self.retained.pop(topic, None)
+            else:
+                self.retained[topic] = payload
+        for client in list(self.clients):
+            if not client.connected_to_broker:
+                continue
+            for pattern in list(client.subscriptions):
+                if topic_matches(pattern, topic):
+                    client.deliver(topic, payload)
+                    break
+
+    def send_retained(self, client, pattern):
+        for topic, payload in list(self.retained.items()):
+            if topic_matches(pattern, topic):
+                client.deliver(topic, payload)
+
+
+class _PublishInfo:
+    def wait_for_publish(self, timeout=None):
+        return True
+
+
+class FakePaho:
+    """The paho v2 client surface MQTTMessage uses."""
+
+    def __init__(self, broker):
+        self.broker = broker
+        self.subscriptions = set()
+        self.connected_to_broker = False
+        self.will = None
+        self.on_connect = None
+        self.on_disconnect = None
+        self.on_message = None
+        self.connect_attempts = 0
+        broker.clients.append(self)
+
+    # -- connection --------------------------------------------------------
+    def connect(self, host, port):
+        self.connect_attempts += 1
+        if self.broker.down:
+            raise ConnectionRefusedError("broker down")
+        self.connected_to_broker = True
+        # paho fires on_connect from its network thread post-connect
+        if self.on_connect:
+            self.on_connect(self, None, None, 0)
+
+    def reconnect(self):
+        self.subscriptions.clear()     # clean session: broker state gone
+        self.connect(None, None)
+
+    def disconnect(self):
+        # graceful: no LWT
+        was = self.connected_to_broker
+        self.connected_to_broker = False
+        if was and self.on_disconnect:
+            self.on_disconnect(self, None, None, 0)
+
+    def drop(self):
+        """Ungraceful loss (network cut): broker publishes the LWT."""
+        self.connected_to_broker = False
+        if self.will:
+            self.broker.route(*self.will)
+        if self.on_disconnect:
+            self.on_disconnect(self, None, None, 7)
+
+    def loop_start(self):
+        pass
+
+    def loop_stop(self):
+        pass
+
+    # -- messaging ----------------------------------------------------------
+    def subscribe(self, topic):
+        new = topic not in self.subscriptions
+        self.subscriptions.add(topic)
+        if new and self.connected_to_broker:
+            self.broker.send_retained(self, topic)
+
+    def unsubscribe(self, topic):
+        self.subscriptions.discard(topic)
+
+    def publish(self, topic, payload, retain=False):
+        self.broker.route(topic, payload, retain)
+        return _PublishInfo()
+
+    def deliver(self, topic, payload):
+        if self.on_message:
+            message = type("M", (), {"topic": topic,
+                                     "payload": payload.encode()
+                                     if isinstance(payload, str)
+                                     else payload})
+            self.on_message(self, None, message)
+
+    def will_set(self, topic, payload, retain=False):
+        self.will = (topic, payload, retain)
+
+    def username_pw_set(self, username, password):
+        pass
+
+
+def make_pair(broker, topics=(), **kwargs):
+    seen = []
+    fake = {}
+
+    def factory():
+        fake["client"] = FakePaho(broker)
+        return fake["client"]
+
+    message = MQTTMessage(
+        on_message=lambda t, p: seen.append((t, p)),
+        subscriptions=list(topics), client_factory=factory,
+        backoff_min=0.02, backoff_max=0.1, **kwargs)
+    message.connect(timeout=1.0)
+    return message, fake["client"], seen
+
+
+def wait_for(predicate, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+class TestRoundTrip:
+    def test_pub_sub_roundtrip(self):
+        broker = FakeBroker()
+        receiver, _, seen = make_pair(broker, ["ns/+/in"])
+        sender, _, _ = make_pair(broker)
+        sender.publish("ns/host/in", "(hello)")
+        assert seen == [("ns/host/in", "(hello)")]
+
+    def test_binary_payload_passthrough(self):
+        broker = FakeBroker()
+        receiver, _, seen = make_pair(broker, ["bin/#"])
+        sender, _, _ = make_pair(broker)
+        sender.publish("bin/tensor", b"\xff\xfe\x00raw")
+        assert seen == [("bin/tensor", b"\xff\xfe\x00raw")]
+
+    def test_lwt_fires_on_ungraceful_drop(self):
+        broker = FakeBroker()
+        watcher, _, seen = make_pair(broker, ["ns/+/state"])
+        victim, victim_client, _ = make_pair(
+            broker, lwt_topic="ns/victim/state", lwt_payload="(absent)")
+        victim.disconnect()          # graceful first: no LWT
+        assert seen == []
+        victim2, victim2_client, _ = make_pair(
+            broker, lwt_topic="ns/victim/state", lwt_payload="(absent)")
+        victim2_client.drop()
+        assert ("ns/victim/state", "(absent)") in seen
+
+
+class TestReconnect:
+    def test_reconnects_and_resubscribes_after_drop(self):
+        broker = FakeBroker()
+        message, client, seen = make_pair(broker, ["a/b"])
+        client.drop()
+        assert not message.connected()
+        assert wait_for(message.connected)
+        # clean-session reconnect wiped broker-side subscriptions;
+        # the wrapper must have replayed them
+        assert "a/b" in client.subscriptions
+        sender, _, _ = make_pair(broker)
+        sender.publish("a/b", "back")
+        assert seen[-1] == ("a/b", "back")
+
+    def test_publishes_buffer_while_down_and_flush_on_reconnect(self):
+        broker = FakeBroker()
+        receiver, _, seen = make_pair(broker, ["q/#"])
+        sender, sender_client, _ = make_pair(broker)
+        broker.down = True
+        sender_client.drop()
+        for i in range(3):
+            sender.publish(f"q/{i}", f"m{i}")
+        assert sender.stats["buffered"] == 3
+        assert seen == []
+        broker.down = False
+        assert wait_for(sender.connected)
+        assert wait_for(lambda: len(seen) == 3)
+        assert [p for _, p in seen] == ["m0", "m1", "m2"]
+
+    def test_backoff_doubles_while_broker_down(self):
+        broker = FakeBroker()
+        message, client, _ = make_pair(broker)
+        broker.down = True
+        client.drop()
+        # let several attempts fail
+        assert wait_for(lambda: client.connect_attempts >= 3)
+        assert message._backoff > message.backoff_min
+        assert message.stats["reconnects"] >= 2
+        broker.down = False
+        assert wait_for(message.connected)
+        # backoff resets on success
+        assert message._backoff == message.backoff_min
+        message.disconnect()
+
+    def test_connect_retries_when_broker_initially_down(self):
+        broker = FakeBroker()
+        broker.down = True
+        fake = {}
+
+        def factory():
+            fake["client"] = FakePaho(broker)
+            return fake["client"]
+
+        message = MQTTMessage(client_factory=factory, backoff_min=0.02,
+                              backoff_max=0.1)
+        message.connect(timeout=0.1)
+        assert not message.connected()
+        broker.down = False
+        assert wait_for(message.connected)
+        message.disconnect()
+
+    def test_rejected_connack_is_not_a_connection(self):
+        broker = FakeBroker()
+
+        class Rejecting(FakePaho):
+            def connect(self, host, port):
+                self.connect_attempts += 1
+                # broker accepts TCP but rejects auth (rc=5)
+                if self.on_connect:
+                    self.on_connect(self, None, None, 5)
+
+        fake = {}
+
+        def factory():
+            fake["client"] = Rejecting(broker)
+            return fake["client"]
+
+        message = MQTTMessage(client_factory=factory, backoff_min=0.02)
+        message.connect(timeout=0.1)
+        assert not message.connected()
+        assert "rejected" in message.stats["last_error"]
+        message.publish("x", "y")             # buffers, must not flush
+        assert message.stats["buffered"] == 1
+        message.disconnect()
+
+    def test_disconnect_stops_reconnecting(self):
+        broker = FakeBroker()
+        message, client, _ = make_pair(broker)
+        broker.down = True
+        client.drop()
+        message.disconnect()
+        attempts = client.connect_attempts
+        time.sleep(0.3)
+        assert client.connect_attempts == attempts
+
+
+class TestRuntimeOverMQTT:
+    """The whole control plane — ProcessRuntime, Registrar election,
+    actor RPC, LWT-driven failover — running over the MQTT transport
+    (fake broker): the multi-host story executed end-to-end."""
+
+    def make_runtime(self, engine, broker, name):
+        def transport_factory(on_message, lwt_topic, lwt_payload,
+                              lwt_retain):
+            return MQTTMessage(
+                on_message=on_message, lwt_topic=lwt_topic,
+                lwt_payload=lwt_payload, lwt_retain=lwt_retain,
+                client_factory=lambda: FakePaho(broker),
+                backoff_min=0.02, backoff_max=0.1)
+
+        from aiko_services_tpu import ProcessRuntime
+        return ProcessRuntime(name=name, engine=engine,
+                              transport_factory=transport_factory)
+
+    def test_registrar_election_and_rpc_over_mqtt(self):
+        from aiko_services_tpu import Actor, EventEngine, Registrar
+
+        engine = EventEngine()
+        broker = FakeBroker()
+        r1 = self.make_runtime(engine, broker, "host_a").initialize()
+        r2 = self.make_runtime(engine, broker, "host_b").initialize()
+        registrar = Registrar(r1)
+        assert engine.run_until(lambda: registrar.is_primary, timeout=6.0)
+
+        class Echo(Actor):
+            def __init__(self, runtime, name):
+                super().__init__(runtime, name, "echo")
+                self.heard = []
+
+            def echo(self, text):
+                self.heard.append(str(text))
+
+        def registered():
+            return any(f.name == "echo" for f in registrar.services)
+
+        echo = Echo(r2, "echo")
+        assert engine.run_until(registered, timeout=6.0)
+        r1.publish(f"{echo.topic_path}/in", "(echo over-mqtt)")
+        assert engine.run_until(lambda: echo.heard == ["over-mqtt"],
+                                timeout=6.0)
+
+        # ungraceful death of host_b: broker fires its LWT; the registrar
+        # must purge host_b's services
+        for client in broker.clients:
+            if client.will and client.will[0] == r2.topic_state:
+                client.drop()
+        assert engine.run_until(lambda: not registered(), timeout=6.0)
+        r1.terminate()
+
+
+class TestLWTChange:
+    def test_lwt_change_cycles_connection(self):
+        broker = FakeBroker()
+        watcher, _, seen = make_pair(broker, ["ns/+/state"])
+        message, client, _ = make_pair(
+            broker, lwt_topic="ns/me/state", lwt_payload="(absent)")
+        message.set_last_will_and_testament("ns/me/state", "(gone v2)")
+        # cycle: disconnected then auto-reconnected with the new will
+        assert wait_for(message.connected)
+        assert client.will == ("ns/me/state", "(gone v2)", False)
+        client.drop()
+        assert ("ns/me/state", "(gone v2)") in seen
