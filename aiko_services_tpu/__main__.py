@@ -1,0 +1,7 @@
+# `python -m aiko_services_tpu ...` — same surface as the aiko_tpu
+# console script (pyproject [project.scripts]).
+
+from .cli import main
+
+if __name__ == "__main__":
+    main()

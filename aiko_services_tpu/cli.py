@@ -163,5 +163,160 @@ def dashboard(transport) -> None:
     run_dashboard(runtime)
 
 
+# -- system bring-up (reference: scripts/system_start.sh etc.) ---------------
+
+_DEFAULT_STATE_FILE = "~/.aiko_tpu_system.json"
+
+
+def _state_path(state_file: str):
+    import pathlib
+    return pathlib.Path(state_file).expanduser()
+
+
+def _load_state(state_file: str) -> dict:
+    import json
+    path = _state_path(state_file)
+    if path.exists():
+        try:
+            return json.loads(path.read_text())
+        except (ValueError, OSError):
+            return {}
+    return {}
+
+
+def _pid_alive(pid: int) -> bool:
+    import os
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+@main.group()
+def system() -> None:
+    """Bring a whole control plane up/down (registrar, recorder,
+    storage — and mosquitto when the transport is mqtt)."""
+
+
+@system.command("start")
+@transport_option
+@click.option("--state-file", default=_DEFAULT_STATE_FILE,
+              help="where to record the spawned pids")
+@click.option("--services", default="registrar,recorder,storage",
+              help="comma-separated aiko_tpu subcommands to spawn")
+def system_start(transport, state_file, services) -> None:
+    """One-command bring-up (reference: scripts/system_start.sh —
+    mosquitto + registrar + dashboard)."""
+    import json
+    import shutil
+    import subprocess
+    import sys
+
+    state = {name: pid for name, pid in _load_state(state_file).items()
+             if _pid_alive(pid)}
+    if state:
+        raise click.ClickException(
+            f"system already running ({', '.join(state)}); "
+            f"run `aiko_tpu system stop` first")
+
+    if transport == "mqtt" and shutil.which("mosquitto"):
+        from .utils.configuration import get_transport_configuration
+        config = get_transport_configuration()
+        if config.host in ("localhost", "127.0.0.1"):
+            broker = subprocess.Popen(
+                ["mosquitto", "-p", str(config.port)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            state["mosquitto"] = broker.pid
+            click.echo(f"mosquitto: pid {broker.pid} (port {config.port})")
+
+    for name in [s.strip() for s in services.split(",") if s.strip()]:
+        child = subprocess.Popen(
+            [sys.executable, "-m", "aiko_services_tpu", name,
+             "--transport", transport],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        state[name] = child.pid
+        click.echo(f"{name}: pid {child.pid}")
+    _state_path(state_file).write_text(json.dumps(state))
+    if transport == "memory":
+        click.echo("note: memory transport is per-process — these "
+                   "services are isolated; use --transport mqtt for a "
+                   "multi-process system")
+
+
+@system.command("stop")
+@click.option("--state-file", default=_DEFAULT_STATE_FILE)
+def system_stop(state_file) -> None:
+    """Stop everything `system start` spawned (reference:
+    scripts/system_stop.sh)."""
+    import os
+    import signal
+
+    state = _load_state(state_file)
+    if not state:
+        click.echo("nothing recorded as running")
+        return
+    for name, pid in state.items():
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                click.echo(f"{name}: stopped pid {pid}")
+            except OSError as exc:
+                click.echo(f"{name}: pid {pid} — {exc}")
+        else:
+            click.echo(f"{name}: pid {pid} already gone")
+        try:
+            # reap if the child is ours (same-process start/stop);
+            # otherwise init adopts and reaps it
+            os.waitpid(pid, os.WNOHANG)
+        except (ChildProcessError, OSError):
+            pass
+    _state_path(state_file).unlink(missing_ok=True)
+
+
+@system.command("status")
+@click.option("--state-file", default=_DEFAULT_STATE_FILE)
+def system_status(state_file) -> None:
+    """Show what `system start` spawned and whether it is alive."""
+    state = _load_state(state_file)
+    if not state:
+        click.echo("not running")
+        return
+    for name, pid in state.items():
+        click.echo(f"{name}: pid {pid} "
+                   f"{'alive' if _pid_alive(pid) else 'DEAD'}")
+
+
+@system.command("reset")
+@transport_option
+def system_reset(transport) -> None:
+    """Clear durable bootstrap state — the retained registrar boot
+    topic on the broker (reference: scripts/system_reset.sh)."""
+    if transport == "memory":
+        click.echo("memory transport keeps no retained state outside "
+                   "processes; nothing to reset")
+        return
+    from .transport.mqtt import MQTT_AVAILABLE, MQTTMessage
+    if not MQTT_AVAILABLE:
+        raise click.ClickException("paho-mqtt is not installed")
+    from .process import REGISTRAR_BOOT_SUFFIX
+    from .utils.configuration import (get_namespace,
+                                      get_transport_configuration)
+    config = get_transport_configuration()
+    message = MQTTMessage(host=config.host, port=config.port,
+                          username=config.username,
+                          password=config.password, tls=config.tls)
+    message.connect()
+    if not message.connected():
+        message.disconnect()
+        raise click.ClickException(
+            f"cannot reach broker {config.host}:{config.port}"
+            f"{': ' + str(message.stats['last_error']) if message.stats['last_error'] else ''}")
+    boot_topic = f"{get_namespace()}/{REGISTRAR_BOOT_SUFFIX}"
+    message.publish(boot_topic, "", retain=True, wait=True)
+    message.disconnect()
+    click.echo(f"cleared retained {boot_topic}")
+
+
 if __name__ == "__main__":
     main()

@@ -16,14 +16,19 @@
 
 from __future__ import annotations
 
+import itertools
 from collections import deque
 
+from .service import ServiceFields, ServiceTopicPath
 from .share import ECConsumer, ServicesCache
-from .utils import generate
+from .utils import generate, parse
+from .utils.configuration import get_hostname
+from .utils.sexpr import parse_int
 
 __all__ = ["DashboardState", "run_dashboard", "register_plugin"]
 
 _LOG_LIMIT = 256
+_history_counter = itertools.count(1)   # unique response-topic suffixes
 
 # Plugin pages keyed by protocol name (reference: dashboard.py:719-723 +
 # dashboard_plugins.py): a plugin renders extra lines for a selected
@@ -44,11 +49,15 @@ class DashboardState:
         self.runtime = runtime
         self.cache = ServicesCache(runtime)
         self.selected_index = 0
-        self.page = "services"          # services | variables | log
+        self.page = "services"          # services | variables | log | history
         self.share: dict = {}
         self._consumer = None
         self._log_topic = None
         self.log_lines: deque = deque(maxlen=_LOG_LIMIT)
+        self.history_rows: list = []    # departed ServiceFields
+        self._history_topic = None
+        self._history_expected = None
+        self.status = ""                # one-line feedback (kill, errors)
 
     # -- services table -----------------------------------------------------
     def services(self) -> list:
@@ -110,9 +119,94 @@ class DashboardState:
                                                 self._log_topic)
             self._log_topic = None
 
+    # -- registrar history (reference: dashboard.py:279-509 history table) --
+    def open_history(self, count: int = 64) -> None:
+        """Ask the primary registrar for its ring buffer of departed
+        services (`(history response count)` protocol,
+        reference registrar.py:263-288)."""
+        registrar = self.runtime.registrar
+        if registrar is None:
+            self.status = "no registrar"
+            return
+        self.close_history()
+        self.history_rows = []
+        self._history_topic = (f"{self.runtime.topic_path}/0/history/"
+                               f"{next(_history_counter)}")
+        self._history_expected = None
+        self.runtime.add_message_handler(self._on_history,
+                                         self._history_topic)
+        self.runtime.publish(
+            f"{registrar['topic_path']}/in",
+            generate("history", [self._history_topic, str(count)]))
+        self.page = "history"
+
+    def _on_history(self, _topic, payload) -> None:
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command == "item_count" and params:
+            self._history_expected = parse_int(params[0], 0)
+        elif command == "history" and params:
+            try:
+                self.history_rows.append(ServiceFields.from_record(
+                    params[0]))
+            except Exception:
+                pass
+
+    @property
+    def history_complete(self) -> bool:
+        return (self._history_expected is not None and
+                len(self.history_rows) >= self._history_expected)
+
+    def close_history(self) -> None:
+        if self._history_topic is not None:
+            self.runtime.remove_message_handler(self._on_history,
+                                                self._history_topic)
+            self._history_topic = None
+
+    # -- process kill (reference: dashboard.py:361-370, local kill -9) ------
+    def kill_selected(self) -> None:
+        """Terminate the selected service's process: SIGKILL when it is
+        on this host (the reference's behavior); for remote processes —
+        which the reference cannot kill at all — fall back to a graceful
+        `(control_stop)` to the service."""
+        fields = self.selected()
+        if fields is None:
+            return
+        topic_path = ServiceTopicPath.parse(fields.topic_path)
+        pid = None
+        if topic_path is not None:
+            try:
+                pid = int(topic_path.process_id.split("-")[0])
+            except ValueError:
+                pid = None
+        import os
+        if topic_path is not None and pid is not None and \
+                topic_path.hostname == get_hostname() and \
+                pid != os.getpid():
+            import signal
+            try:
+                os.kill(pid, signal.SIGKILL)
+                self.status = f"killed pid {pid} ({fields.name})"
+            except OSError as exc:
+                self.status = f"kill {pid} failed: {exc}"
+            return
+        self.runtime.publish(f"{fields.topic_path}/in", "(control_stop)")
+        self.status = f"sent control_stop to {fields.name}"
+
+    # -- log level (reference: dashboard.py:663-707 popup) ------------------
+    def set_log_level(self, level: str) -> None:
+        """Publish `(update log_level LEVEL)` to the selected service —
+        every actor's share applies it live."""
+        self.update_variable("log_level", str(level).upper())
+        self.status = f"log_level → {str(level).upper()}"
+
     def back(self) -> None:
         self.close_consumer()
         self.close_log()
+        self.close_history()
+        self.status = ""
         self.page = "services"
 
     def plugin_lines(self) -> list:
@@ -165,7 +259,8 @@ def _render(screen, state: DashboardState) -> None:
             line = (f"{fields.name:32.32s} {protocol:24.24s} "
                     f"{fields.topic_path}")
             screen.addnstr(2 + row, 0, line, width - 1, attribute)
-        footer = "↑/↓ select · ⏎ variables · l log · q quit"
+        footer = ("↑/↓ select · ⏎ variables · l log · h history · "
+                  "x kill · q quit")
     elif state.page == "variables":
         fields = state.selected()
         screen.addnstr(1, 0, f"share: {fields.name if fields else '?'}",
@@ -177,6 +272,15 @@ def _render(screen, state: DashboardState) -> None:
                  for key, value in state.flat_share()]
         for row, line in enumerate(rows[:height - 3]):
             screen.addnstr(2 + row, 0, line, width - 1)
+        footer = "d/i/w/e log-level · b back · q quit"
+    elif state.page == "history":
+        header = f"{'DEPARTED SERVICE':32.32s} {'PROTOCOL':24.24s} TOPIC"
+        screen.addnstr(1, 0, header, width - 1, curses.A_BOLD)
+        for row, fields in enumerate(state.history_rows[:height - 3]):
+            protocol = fields.protocol.rsplit("/", 1)[-1]
+            line = (f"{fields.name:32.32s} {protocol:24.24s} "
+                    f"{fields.topic_path}")
+            screen.addnstr(2 + row, 0, line, width - 1)
         footer = "b back · q quit"
     else:
         screen.addnstr(1, 0, f"log: {state._log_topic}", width - 1,
@@ -185,6 +289,8 @@ def _render(screen, state: DashboardState) -> None:
         for row, line in enumerate(lines):
             screen.addnstr(2 + row, 0, line, width - 1)
         footer = "b back · q quit"
+    if state.status:
+        footer = f"{state.status} · {footer}"
     screen.addnstr(height - 1, 0, footer.ljust(width - 1), width - 1,
                    curses.A_REVERSE)
     screen.refresh()
@@ -215,6 +321,15 @@ def run_dashboard(runtime, tick: float = 0.05) -> None:
                 state.open_variables()
             elif key == ord("l") and state.page == "services":
                 state.open_log()
+            elif key == ord("h") and state.page == "services":
+                state.open_history()
+            elif key == ord("x") and state.page == "services":
+                state.kill_selected()
+            elif state.page == "variables" and key in (
+                    ord("d"), ord("i"), ord("w"), ord("e")):
+                state.set_log_level({"d": "DEBUG", "i": "INFO",
+                                     "w": "WARNING",
+                                     "e": "ERROR"}[chr(key)])
             elif key == ord("b"):
                 state.back()
             _render(screen, state)

@@ -124,8 +124,12 @@ class MQTTMessage(Message):
         for topic in tuple(self.subscriptions):
             client.subscribe(topic)
         self._backoff = self.backoff_min
-        self._connected_event.set()
+        # drain the buffer BEFORE announcing connected: a concurrent
+        # publish() seeing connected()=True must not overtake buffered
+        # messages (retained last-write-wins topics would invert state)
         self._flush_pending()
+        self._connected_event.set()
+        self._flush_pending()       # anything buffered during the drain
 
     def _on_disconnect(self, client, userdata, flags, reason_code=None,
                        properties=None):

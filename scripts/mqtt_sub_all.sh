@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Watch every control-plane message on the broker (debugging).
+# Capability parity: reference scripts/mqtt_sub_all.sh.
+set -euo pipefail
+HOST="${AIKO_TPU_MQTT_HOST:-localhost}"
+PORT="${AIKO_TPU_MQTT_PORT:-1883}"
+exec mosquitto_sub -h "$HOST" -p "$PORT" -t '#' -v

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Clear durable bootstrap state (the retained registrar boot topic).
+# Capability parity: reference scripts/system_reset.sh.
+set -euo pipefail
+exec python -m aiko_services_tpu system reset "$@"

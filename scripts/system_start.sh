@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# One-command control-plane bring-up: mosquitto (when --transport mqtt and
+# available) + registrar + recorder + storage.
+# Capability parity: reference scripts/system_start.sh.
+#
+# Usage: system_start.sh [--transport memory|mqtt] [--services a,b,c]
+set -euo pipefail
+exec python -m aiko_services_tpu system start "$@"

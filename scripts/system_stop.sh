@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Stop everything system_start.sh spawned.
+# Capability parity: reference scripts/system_stop.sh.
+set -euo pipefail
+exec python -m aiko_services_tpu system stop "$@"

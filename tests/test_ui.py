@@ -56,6 +56,64 @@ def test_dashboard_state_tracks_services(make_runtime, engine):
     state.terminate()
 
 
+def test_dashboard_history_page(make_runtime, engine):
+    """Departed services surface on the history page via the registrar's
+    `(history ...)` protocol (reference dashboard.py:279-509)."""
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    app_rt = make_runtime("app_host").initialize()
+    actor = Actor(app_rt, "doomed", share={})
+    settle(engine, 10)
+    actor.stop()                   # graceful leave → registrar history
+    settle(engine, 10)
+
+    dash_rt = make_runtime("dash_host").initialize()
+    state = DashboardState(dash_rt)
+    settle(engine, 10)
+    state.open_history()
+    settle(engine, 10)
+    assert state.page == "history"
+    assert state.history_complete
+    assert "doomed" in [f.name for f in state.history_rows]
+    state.terminate()
+
+
+def test_dashboard_kill_and_log_level(make_runtime, engine):
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    app_rt = make_runtime("app_host").initialize()
+    actor = Actor(app_rt, "victim", share={})
+    dash_rt = make_runtime("dash_host").initialize()
+    state = DashboardState(dash_rt)
+    settle(engine, 15)
+    state.selected_index = [f.name for f in state.services()].index(
+        "victim")
+
+    # log-level popup equivalent: pushes (update log_level ...) live
+    state.open_variables()
+    settle(engine, 10)
+    state.set_log_level("debug")
+    settle(engine, 10)
+    assert actor.ec_producer.get("log_level") == "DEBUG"
+    state.back()
+
+    # kill: same OS process (pid == ours) → graceful control_stop
+    # fallback; the service must leave the table
+    state.selected_index = [f.name for f in state.services()].index(
+        "victim")
+    state.kill_selected()
+    settle(engine, 15)
+    assert "control_stop" in state.status
+    assert "victim" not in [f.name for f in state.services()]
+    state.terminate()
+
+
 def test_cli_pipeline_show(tmp_path):
     definition = {
         "version": 0, "name": "p_cli", "runtime": "python",
@@ -175,6 +233,58 @@ def test_legacy_stream_element(make_runtime):
     assert ok and swag["doubled"] == 42
     pipeline.destroy_stream("s1")
     assert events == [("start", "s1"), ("frame", 0), ("stop", "s1")]
+
+
+def test_system_start_stop_cycle(tmp_path):
+    """`aiko_tpu system start` spawns real processes, records pids,
+    refuses double-start; `stop` tears them down (reference:
+    scripts/system_start.sh / system_stop.sh)."""
+    import json
+    import time
+
+    state_file = str(tmp_path / "system.json")
+    runner = CliRunner()
+    result = runner.invoke(cli_main, [
+        "system", "start", "--transport", "memory",
+        "--services", "registrar", "--state-file", state_file])
+    assert result.exit_code == 0, result.output
+    state = json.loads(open(state_file).read())
+    assert "registrar" in state
+
+    # double-start refused while pids are alive
+    result = runner.invoke(cli_main, [
+        "system", "start", "--transport", "memory",
+        "--services", "registrar", "--state-file", state_file])
+    assert result.exit_code != 0
+
+    result = runner.invoke(cli_main,
+                           ["system", "status", "--state-file", state_file])
+    assert "registrar" in result.output and "alive" in result.output
+
+    result = runner.invoke(cli_main,
+                           ["system", "stop", "--state-file", state_file])
+    assert result.exit_code == 0, result.output
+    assert "stopped" in result.output
+
+    deadline = time.monotonic() + 5
+    pid = state["registrar"]
+    import os
+    while time.monotonic() < deadline:
+        # the child is pytest's: reap so it cannot linger as a zombie
+        # (os.kill(pid, 0) succeeds on zombies)
+        try:
+            reaped, _ = os.waitpid(pid, os.WNOHANG)
+            if reaped == pid:
+                break
+        except (ChildProcessError, OSError):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"registrar pid {pid} survived system stop")
+
+    result = runner.invoke(cli_main,
+                           ["system", "status", "--state-file", state_file])
+    assert "not running" in result.output
 
 
 def test_bootstrap_discovery_loopback():
