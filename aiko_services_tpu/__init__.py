@@ -27,6 +27,9 @@ from .process_manager import ProcessManager                 # noqa: F401
 from .lifecycle import (                                    # noqa: F401
     LifeCycleClient, LifeCycleManager,
 )
+from .placement import (                                    # noqa: F401
+    DevicePool, DeviceSlice, PlacementManager,
+)
 from .recorder import Recorder                              # noqa: F401
 from .compute import ComputeRuntime                         # noqa: F401
 from .storage import (                                      # noqa: F401

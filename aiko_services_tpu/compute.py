@@ -74,6 +74,34 @@ class ComputeRuntime(Actor):
         self.ec_producer.update(
             "mesh", dict(mesh.shape) if mesh is not None else {})
         self.ec_producer.update("platform", self._devices[0].platform)
+        self.ec_producer.update("device_kind", self._devices[0].device_kind)
+        self.refresh_device_health()
+        # keep device health LIVE: dashboards must see HBM pressure
+        # building, not a boot-time snapshot
+        self._timers.append(runtime.event.add_timer_handler(
+            self.refresh_device_health, period=10.0))
+
+    def refresh_device_health(self) -> None:
+        """Publish per-device memory occupancy into the EC share so
+        lifecycle managers / dashboards watch device health live
+        (SURVEY.md §7 two-plane consistency).  TPU backends report
+        bytes_in_use/bytes_limit; backends without memory_stats (CPU)
+        just report presence."""
+        for device in self._devices:
+            stats = {}
+            try:
+                stats = device.memory_stats() or {}
+            except Exception:
+                pass
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if in_use is not None and limit:
+                self.ec_producer.update(
+                    f"device.{device.id}.mem_pct",
+                    round(100.0 * in_use / limit, 1))
+            else:
+                self.ec_producer.update(f"device.{device.id}.mem_pct",
+                                        -1)
 
     @property
     def mesh(self):

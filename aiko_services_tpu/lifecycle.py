@@ -42,6 +42,7 @@ class _ClientRecord:
     lease: Lease | None = None
     consumer: ECConsumer | None = None
     share: dict = field(default_factory=dict)
+    state_topic: str = ""           # client process LWT topic (crash watch)
 
 
 class LifeCycleManager(Actor):
@@ -62,6 +63,9 @@ class LifeCycleManager(Actor):
         self.clients: dict[str, _ClientRecord] = {}
         self._handles: dict[str, object] = {}
         self._counter = 0
+        # crash watch refcounts: several clients may share one process,
+        # so the state-topic handler lives until the LAST of them goes
+        self._state_watch: dict[str, set] = {}    # topic -> client_ids
         runtime.add_message_handler(self._control_handler,
                                     self.topic_control)
         self.ec_producer.update("client_count", 0)
@@ -112,10 +116,41 @@ class LifeCycleManager(Actor):
         # mirror the client's share (lifecycle state etc.)
         record.consumer = ECConsumer(
             self.runtime, record.share, f"{topic_path}/control")
+        # crash detection: the client process's LWT (reference watches
+        # registrar removals, lifecycle.py:190-227; watching the state
+        # topic directly needs no registrar in the loop)
+        parts = topic_path.split("/")
+        if len(parts) >= 3:
+            record.state_topic = "/".join(parts[:3]) + "/0/state"
+            watchers = self._state_watch.setdefault(record.state_topic,
+                                                    set())
+            if not watchers:
+                self.runtime.add_message_handler(
+                    self._client_state_handler, record.state_topic)
+            watchers.add(client_id)
         self.logger.info("client %s ready at %s", client_id, topic_path)
         if self.client_change_handler:
             self.client_change_handler("add", client_id, record)
         self._publish_count()
+
+    def _client_state_handler(self, topic, payload) -> None:
+        if "absent" not in str(payload):
+            return
+        for client_id, record in list(self.clients.items()):
+            if record.state_topic == topic:
+                self.logger.warning("client %s died (LWT on %s)",
+                                    client_id, topic)
+                self.delete_client(client_id)
+
+    def _unwatch_state(self, topic: str, client_id: str) -> None:
+        watchers = self._state_watch.get(topic)
+        if watchers is None:
+            return
+        watchers.discard(client_id)
+        if not watchers:
+            del self._state_watch[topic]
+            self.runtime.remove_message_handler(self._client_state_handler,
+                                                topic)
 
     # -- deletion ----------------------------------------------------------
     def delete_client(self, client_id: str) -> None:
@@ -127,6 +162,8 @@ class LifeCycleManager(Actor):
             record.lease.terminate()
         if record.consumer:
             record.consumer.terminate()
+        if record.state_topic:
+            self._unwatch_state(record.state_topic, str(client_id))
         if record.topic_path:
             # polite ask first; the deletion lease force-kills stragglers
             self.runtime.publish(f"{record.topic_path}/in",
@@ -156,6 +193,10 @@ class LifeCycleManager(Actor):
                 record.lease.terminate()
             if record.consumer:
                 record.consumer.terminate()
+        for topic in list(self._state_watch):
+            self.runtime.remove_message_handler(self._client_state_handler,
+                                                topic)
+        self._state_watch.clear()
         self.runtime.remove_message_handler(self._control_handler,
                                             self.topic_control)
         super().stop()

@@ -1,0 +1,243 @@
+# Device placement: the TPU pod as an allocatable pool behind the
+# lifecycle manager.
+#
+# SURVEY.md §2 "elastic scheduling → device placement": the reference's
+# LifeCycleManager spawns OS processes (reference: aiko_services/
+# lifecycle.py:144-288) with no notion of accelerators.  Here the same
+# spawn/handshake/lease machinery places *device workloads*: a DevicePool
+# partitions the slice's chips, each spawned client receives a
+# DeviceSlice (device ids + mesh geometry) it builds its ComputeRuntime
+# over, and the manager EC-shares pool occupancy and per-client
+# placement so dashboards see device health next to process health
+# (SURVEY.md §7 "two-plane consistency": discovery/liveness must track
+# device health, not just processes).
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .lifecycle import LifeCycleManager
+from .parallel.mesh import MeshSpec, create_mesh
+from .utils import get_logger
+
+__all__ = ["DeviceSlice", "DevicePool", "PlacementManager",
+           "report_compute"]
+
+
+@dataclass
+class DeviceSlice:
+    """A contiguous run of devices plus the mesh geometry to lay over
+    them.  Contiguity is deliberate: neighbouring TPU chips share the
+    fastest ICI links, so model/TP axes stay on-wire-adjacent."""
+    owner: str
+    devices: list
+    mesh_axes: dict                     # resolved axis name -> size
+
+    @property
+    def device_ids(self) -> list:
+        return [d.id for d in self.devices]
+
+    def mesh(self):
+        """Build the jax Mesh for this slice (axes resolved already)."""
+        return create_mesh(self.mesh_axes, self.devices)
+
+    def describe(self) -> str:
+        axes = ",".join(f"{k}={v}" for k, v in self.mesh_axes.items())
+        return f"devices={self.device_ids} mesh=({axes})"
+
+
+class DevicePool:
+    """Allocator over the process-visible device inventory.
+
+    Slices are handed out as contiguous runs (first-fit) and returned by
+    owner; double-allocation of a chip is impossible by construction."""
+
+    def __init__(self, devices=None):
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        self.devices = list(devices)
+        self._owned: dict[str, DeviceSlice] = {}      # owner -> slice
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def total(self) -> int:
+        return len(self.devices)
+
+    @property
+    def allocated(self) -> int:
+        return sum(len(s.devices) for s in self._owned.values())
+
+    @property
+    def free(self) -> int:
+        return self.total - self.allocated
+
+    def slice_of(self, owner: str) -> DeviceSlice | None:
+        return self._owned.get(owner)
+
+    def occupancy(self) -> dict:
+        """owner -> device id list (EC-share friendly)."""
+        return {owner: s.device_ids for owner, s in self._owned.items()}
+
+    # -- allocate / release ------------------------------------------------
+    def allocate(self, mesh_axes: dict | int, owner: str) -> DeviceSlice:
+        """mesh_axes: axis dict ({"data": 2, "model": 2}) or a plain
+        device count (1D data mesh).  Raises when owner already holds a
+        slice or no contiguous run fits."""
+        if owner in self._owned:
+            raise ValueError(f"{owner!r} already holds "
+                             f"{self._owned[owner].describe()}")
+        if isinstance(mesh_axes, int):
+            mesh_axes = {"data": mesh_axes}
+        count = MeshSpec(dict(mesh_axes))
+        # resolve wildcard (-1) against the free count, not the pool size
+        resolved = count.resolve(self.free) if -1 in mesh_axes.values() \
+            else count.resolve(math.prod(mesh_axes.values()))
+        need = math.prod(resolved.values())
+        run = self._find_run(need)
+        if run is None:
+            raise RuntimeError(
+                f"no contiguous run of {need} free devices "
+                f"(free={self.free}/{self.total})")
+        allocated = DeviceSlice(owner, run, resolved)
+        self._owned[owner] = allocated
+        return allocated
+
+    def release(self, owner: str) -> bool:
+        return self._owned.pop(owner, None) is not None
+
+    def _find_run(self, need: int):
+        taken = {id(d) for s in self._owned.values() for d in s.devices}
+        run: list = []
+        for device in self.devices:
+            if id(device) in taken:
+                run = []
+                continue
+            run.append(device)
+            if len(run) == need:
+                return run
+        return None
+
+
+def report_compute(client, compute) -> None:
+    """Copy a ComputeRuntime's device identity into a LifeCycleClient's
+    EC share: the manager mirrors the CLIENT's share, so this is how a
+    worker's device health reaches the manager/dashboard."""
+    for key in ("device_count", "platform", "device_kind", "mesh"):
+        value = compute.ec_producer.get(key)
+        if value is not None:
+            client.ec_producer.update(key, value)
+
+
+class PlacementManager(LifeCycleManager):
+    """LifeCycleManager that owns a DevicePool: every client it spawns
+    gets a DeviceSlice, and the slice returns to the pool when the
+    client dies (handshake miss, registrar removal, or deletion).
+
+    spawner(client_id, manager_topic_path, device_slice) -> handle —
+    the extra argument vs the base class; in-process runtimes in tests,
+    OS processes (with device ids passed through the environment /
+    spawn record) in deployment."""
+
+    def __init__(self, runtime, name: str, spawner, pool: DevicePool,
+                 client_mesh_axes: dict | int = 1, terminator=None,
+                 **kwargs):
+        self.pool = pool
+        self.client_mesh_axes = client_mesh_axes
+        self._placed_spawner = spawner
+        self._user_terminator = terminator
+        # state topic -> client_ids whose slices await vacate confirmation
+        self._pending_release: dict[str, set] = {}
+        super().__init__(runtime, name,
+                         spawner=self._spawn_with_placement,
+                         terminator=self._terminate_and_release, **kwargs)
+        self.logger = get_logger(f"placement_manager.{name}")
+        self._publish_pool()
+
+    def _spawn_with_placement(self, client_id: str, topic_path: str):
+        device_slice = self.pool.allocate(self.client_mesh_axes, client_id)
+        self.ec_producer.update(f"placement.{client_id}",
+                                device_slice.describe())
+        self._publish_pool()
+        try:
+            return self._placed_spawner(client_id, topic_path,
+                                        device_slice)
+        except Exception:
+            # spawn failed: the slice must not leak
+            self.pool.release(client_id)
+            self.ec_producer.remove(f"placement.{client_id}")
+            self._publish_pool()
+            raise
+
+    def delete_client(self, client_id: str) -> None:
+        """The slice is NOT freed here: the chips are only safe to
+        re-hand-out once the old client has provably vacated them (TPU
+        backends take exclusive device ownership).  Release happens on
+        the process's absent/LWT state, or at the latest when the
+        deletion lease force-terminates the client."""
+        client_id = str(client_id)
+        record = self.clients.get(client_id)
+        handshook = bool(record and record.topic_path)
+        state_topic = record.state_topic if record else ""
+        super().delete_client(client_id)
+        if self.pool.slice_of(client_id) is None:
+            return                       # nothing held
+        if not handshook or not state_topic:
+            self._release(client_id)     # never ran: devices untouched
+            return
+        pending = self._pending_release.setdefault(state_topic, set())
+        if not pending:
+            self.runtime.add_message_handler(self._release_on_absent,
+                                             state_topic)
+        pending.add(client_id)
+
+    def _release_on_absent(self, topic, payload) -> None:
+        if "absent" not in str(payload):
+            return
+        for client_id in self._pending_release.pop(topic, set()):
+            self._release(client_id)
+        self.runtime.remove_message_handler(self._release_on_absent,
+                                            topic)
+
+    def _terminate_and_release(self, client_id: str, handle) -> None:
+        """Deletion-lease expiry: force-kill (if the caller supplied a
+        terminator) then reclaim — the bounded fallback when no LWT
+        ever arrives."""
+        if self._user_terminator is not None:
+            self._user_terminator(client_id, handle)
+        client_id = str(client_id)
+        for topic, pending in list(self._pending_release.items()):
+            if client_id in pending:
+                pending.discard(client_id)
+                if not pending:
+                    del self._pending_release[topic]
+                    self.runtime.remove_message_handler(
+                        self._release_on_absent, topic)
+        if self.pool.slice_of(client_id) is not None:
+            self._release(client_id)
+
+    def _release(self, client_id: str) -> None:
+        if self.pool.release(client_id):
+            self.ec_producer.remove(f"placement.{client_id}")
+            self._publish_pool()
+
+    def device_health(self) -> dict:
+        """Aggregate of what ready clients report in their EC shares
+        (ComputeRuntime publishes device_count/platform/mesh)."""
+        health = {}
+        for client_id, record in self.clients.items():
+            health[client_id] = {
+                "state": record.state,
+                "devices": self.pool.slice_of(client_id).device_ids
+                if self.pool.slice_of(client_id) else [],
+                "reported_device_count":
+                    record.share.get("device_count"),
+                "platform": record.share.get("platform"),
+            }
+        return health
+
+    def _publish_pool(self) -> None:
+        self.ec_producer.update("devices_total", self.pool.total)
+        self.ec_producer.update("devices_free", self.pool.free)
+        self.ec_producer.update("devices_allocated", self.pool.allocated)

@@ -1,0 +1,206 @@
+# Device placement tests: the TPU pod as an allocatable pool behind the
+# lifecycle manager (SURVEY.md §2 "elastic scheduling → device
+# placement").  Runs on the virtual 8-device CPU mesh from conftest.
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu import (ComputeRuntime, DevicePool, LifeCycleClient,
+                               PlacementManager)
+from aiko_services_tpu.placement import DeviceSlice, report_compute
+
+
+def settle(engine, steps=10):
+    for _ in range(steps):
+        engine.step()
+
+
+class TestDevicePool:
+    def test_allocate_disjoint_slices(self):
+        pool = DevicePool()
+        assert pool.total == 8
+        a = pool.allocate(4, "a")
+        b = pool.allocate({"data": 2, "model": 2}, "b")
+        assert len(a.devices) == 4 and len(b.devices) == 4
+        assert not set(a.device_ids) & set(b.device_ids)
+        assert pool.free == 0
+
+    def test_overcommit_refused(self):
+        pool = DevicePool()
+        pool.allocate(6, "a")
+        with pytest.raises(RuntimeError):
+            pool.allocate(4, "b")
+        assert pool.free == 2
+
+    def test_double_allocation_refused(self):
+        pool = DevicePool()
+        pool.allocate(2, "a")
+        with pytest.raises(ValueError):
+            pool.allocate(2, "a")
+
+    def test_release_returns_devices(self):
+        pool = DevicePool()
+        first = pool.allocate(8, "a")
+        assert pool.free == 0
+        assert pool.release("a")
+        again = pool.allocate(8, "b")
+        assert again.device_ids == first.device_ids
+
+    def test_wildcard_axis_fills_free_devices(self):
+        pool = DevicePool()
+        pool.allocate(4, "a")
+        rest = pool.allocate({"data": -1, "model": 2}, "b")
+        assert rest.mesh_axes == {"data": 2, "model": 2}
+
+    def test_fragmentation_respects_contiguity(self):
+        pool = DevicePool()
+        pool.allocate(3, "a")
+        pool.allocate(2, "b")
+        pool.allocate(3, "c")
+        pool.release("b")            # free hole of 2 in the middle
+        with pytest.raises(RuntimeError):
+            pool.allocate(3, "d")    # 3 contiguous not available
+        d = pool.allocate(2, "d")    # the hole fits exactly
+        assert len(d.devices) == 2
+
+    def test_slice_builds_working_mesh(self):
+        pool = DevicePool()
+        s = pool.allocate({"data": 2, "model": 2}, "a")
+        mesh = s.mesh()
+        assert dict(mesh.shape) == {"data": 2, "model": 2}
+        # the mesh actually computes
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        x = jax.device_put(jnp.arange(8.0).reshape(4, 2),
+                           NamedSharding(mesh, P("data", "model")))
+        assert float(jnp.sum(x)) == 28.0
+
+
+class TestPlacementManager:
+    def make_fleet(self, make_runtime, engine, client_axes, count,
+                   pool=None, terminator=None):
+        manager_rt = make_runtime("pm_host").initialize()
+        pool = pool or DevicePool()
+        spawned = {}
+
+        def spawner(client_id, manager_topic, device_slice):
+            rt = make_runtime(f"pworker_{client_id}").initialize()
+            compute = ComputeRuntime(rt, f"compute_{client_id}",
+                                     mesh=device_slice.mesh())
+            client = LifeCycleClient(rt, f"pclient_{client_id}",
+                                     manager_topic, client_id)
+            report_compute(client, compute)
+            spawned[client_id] = (rt, compute, client, device_slice)
+            return rt
+
+        manager = PlacementManager(manager_rt, "pm", spawner, pool,
+                                   client_mesh_axes=client_axes,
+                                   terminator=terminator)
+        ids = manager.create_clients(count)
+        settle(engine, 30)      # handshake + EC snapshot per client
+        return manager, pool, spawned, ids
+
+    def test_clients_get_disjoint_meshes_and_compute(
+            self, make_runtime, engine):
+        manager, pool, spawned, ids = self.make_fleet(
+            make_runtime, engine, {"data": 2, "model": 2}, 2)
+        assert manager.ready_count() == 2
+        assert pool.free == 0
+        a, b = (spawned[i][3] for i in ids)
+        assert not set(a.device_ids) & set(b.device_ids)
+
+        # each client's ComputeRuntime executes on ITS slice
+        for client_id in ids:
+            compute = spawned[client_id][1]
+            mesh = compute.mesh
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            compute.register_program(
+                "square", lambda x: jax.lax.with_sharding_constraint(
+                    x * x, NamedSharding(mesh, P("data", None))))
+            out = compute.run(
+                "square", jax.device_put(
+                    jnp.arange(4.0).reshape(4, 1),
+                    NamedSharding(mesh, P("data", None))))
+            np.testing.assert_allclose(np.asarray(out),
+                                       [[0], [1], [4], [9]])
+            placed_on = {d.id for d in out.sharding.device_set}
+            assert placed_on == set(spawned[client_id][3].device_ids)
+
+        # placement is EC-shared for dashboards
+        assert manager.ec_producer.get("devices_total") == 8
+        assert manager.ec_producer.get("devices_free") == 0
+        for client_id in ids:
+            assert "devices=" in manager.ec_producer.get(
+                f"placement.{client_id}")
+
+    def test_deleting_client_returns_devices_after_vacate(
+            self, make_runtime, engine):
+        manager, pool, spawned, ids = self.make_fleet(
+            make_runtime, engine, 4, 2,
+            terminator=lambda cid, rt: rt and rt.terminate())
+        assert pool.free == 0
+        manager.delete_client(ids[0])
+        settle(engine, 8)
+        # chips stay owned until the old client provably vacates them
+        assert pool.free == 0
+        # deletion lease expires → terminator → graceful absent → release
+        engine.clock.advance(31.0)
+        settle(engine, 10)
+        assert pool.free == 4
+        assert manager.ec_producer.get("devices_free") == 4
+        assert manager.ec_producer.get(f"placement.{ids[0]}") is None
+        # elastic: the freed devices host the replacement
+        new_ids = manager.create_clients(1)
+        settle(engine, 30)
+        assert pool.free == 0
+        assert manager.ready_count() == 2
+        assert spawned[new_ids[0]][3].device_ids == \
+            spawned[ids[0]][3].device_ids
+
+    def test_pool_exhaustion_fails_spawn_without_leak(
+            self, make_runtime, engine):
+        manager, pool, spawned, ids = self.make_fleet(
+            make_runtime, engine, 8, 1)
+        assert pool.free == 0
+        with pytest.raises(RuntimeError):
+            manager.create_clients(1)
+        assert pool.free == 0           # no phantom allocation
+        assert len(manager.clients) == 2  # failed record stays spawned…
+        # …until its handshake lease reaps it (no client ever appeared)
+        engine.clock.advance(31.0)
+        settle(engine, 8)
+        assert len(manager.clients) == 1
+
+    def test_crashed_client_returns_devices(self, make_runtime, engine):
+        """Ungraceful worker death (LWT) must free its slice — the
+        elastic-recovery half of device placement."""
+        manager, pool, spawned, ids = self.make_fleet(
+            make_runtime, engine, 4, 2)
+        assert pool.free == 0
+        victim_rt = spawned[ids[0]][0]
+        victim_rt.message.crash()          # fires the process LWT
+        settle(engine, 10)
+        assert pool.free == 4
+        assert manager.ready_count() == 1
+        assert ids[0] not in manager.clients
+
+    def test_device_health_aggregation(self, make_runtime, engine):
+        manager, pool, spawned, ids = self.make_fleet(
+            make_runtime, engine, 4, 2)
+        health = manager.device_health()
+        for client_id in ids:
+            assert health[client_id]["state"] == "ready"
+            assert len(health[client_id]["devices"]) == 4
+            # mirrored from the client's ComputeRuntime EC share
+            assert health[client_id]["reported_device_count"] == 4
+            assert health[client_id]["platform"] == "cpu"
+
+    def test_compute_runtime_publishes_device_health(
+            self, make_runtime, engine):
+        rt = make_runtime("health_host").initialize()
+        compute = ComputeRuntime(rt, "health_compute")
+        settle(engine, 4)
+        mem = compute.ec_producer.get("device.0.mem_pct")
+        assert mem is not None          # present even when backend
+        assert compute.ec_producer.get("device_kind")  # has no stats
