@@ -95,13 +95,13 @@ class ComputeRuntime(Actor):
                 pass
             in_use = stats.get("bytes_in_use")
             limit = stats.get("bytes_limit")
-            if in_use is not None and limit:
-                self.ec_producer.update(
-                    f"device.{device.id}.mem_pct",
-                    round(100.0 * in_use / limit, 1))
-            else:
-                self.ec_producer.update(f"device.{device.id}.mem_pct",
-                                        -1)
+            value = round(100.0 * in_use / limit, 1) \
+                if in_use is not None and limit else -1
+            key = f"device.{device.id}.mem_pct"
+            # dedup: EC updates fan out to every leaseholder — no-op
+            # republishes every 10 s would spam each consumer forever
+            if self.ec_producer.get(key) != value:
+                self.ec_producer.update(key, value)
 
     @property
     def mesh(self):

@@ -21,9 +21,26 @@ from dataclasses import dataclass, field
 
 from .actor import Actor
 from .lease import Lease
-from .service import ServiceProtocol
+from .process import STATE_ABSENT
+from .service import ServiceProtocol, ServiceTopicPath
 from .share import ECConsumer
-from .utils import get_logger
+from .utils import get_logger, parse
+
+
+def state_topic_of(service_topic_path: str) -> str:
+    """The process-liveness topic (service 0's state, where the LWT
+    fires) for any service topic path; "" when unparseable."""
+    parsed = ServiceTopicPath.parse(service_topic_path)
+    return f"{parsed.process_path}/0/state" if parsed else ""
+
+
+def is_absent(payload) -> bool:
+    """True for the process-death payload (STATE_ABSENT contract)."""
+    try:
+        command, _ = parse(str(payload))
+    except Exception:
+        return False
+    return command == STATE_ABSENT.strip("()")
 
 __all__ = ["LifeCycleManager", "LifeCycleClient",
            "PROTOCOL_LIFECYCLE_MANAGER", "PROTOCOL_LIFECYCLE_CLIENT"]
@@ -119,9 +136,8 @@ class LifeCycleManager(Actor):
         # crash detection: the client process's LWT (reference watches
         # registrar removals, lifecycle.py:190-227; watching the state
         # topic directly needs no registrar in the loop)
-        parts = topic_path.split("/")
-        if len(parts) >= 3:
-            record.state_topic = "/".join(parts[:3]) + "/0/state"
+        record.state_topic = state_topic_of(topic_path)
+        if record.state_topic:
             watchers = self._state_watch.setdefault(record.state_topic,
                                                     set())
             if not watchers:
@@ -134,7 +150,7 @@ class LifeCycleManager(Actor):
         self._publish_count()
 
     def _client_state_handler(self, topic, payload) -> None:
-        if "absent" not in str(payload):
+        if not is_absent(payload):
             return
         for client_id, record in list(self.clients.items()):
             if record.state_topic == topic:

@@ -17,7 +17,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
-from .lifecycle import LifeCycleManager
+from .lifecycle import LifeCycleManager, is_absent
 from .parallel.mesh import MeshSpec, create_mesh
 from .utils import get_logger
 
@@ -91,9 +91,17 @@ class DevicePool:
         if isinstance(mesh_axes, int):
             mesh_axes = {"data": mesh_axes}
         count = MeshSpec(dict(mesh_axes))
-        # resolve wildcard (-1) against the free count, not the pool size
-        resolved = count.resolve(self.free) if -1 in mesh_axes.values() \
-            else count.resolve(math.prod(mesh_axes.values()))
+        if -1 in mesh_axes.values():
+            # a wildcard axis can only fill what is contiguously
+            # OBTAINABLE, not the raw free count (fragmentation)
+            longest = self._longest_free_run()
+            if longest == 0:
+                raise RuntimeError(
+                    f"pool exhausted ({self.total} devices allocated)")
+            fixed = math.prod(v for v in mesh_axes.values() if v != -1)
+            resolved = count.resolve(longest - longest % max(fixed, 1))
+        else:
+            resolved = count.resolve(math.prod(mesh_axes.values()))
         need = math.prod(resolved.values())
         run = self._find_run(need)
         if run is None:
@@ -118,6 +126,14 @@ class DevicePool:
             if len(run) == need:
                 return run
         return None
+
+    def _longest_free_run(self) -> int:
+        taken = {id(d) for s in self._owned.values() for d in s.devices}
+        longest = current = 0
+        for device in self.devices:
+            current = 0 if id(device) in taken else current + 1
+            longest = max(longest, current)
+        return longest
 
 
 def report_compute(client, compute) -> None:
@@ -173,28 +189,45 @@ class PlacementManager(LifeCycleManager):
     def delete_client(self, client_id: str) -> None:
         """The slice is NOT freed here: the chips are only safe to
         re-hand-out once the old client has provably vacated them (TPU
-        backends take exclusive device ownership).  Release happens on
-        the process's absent/LWT state, or at the latest when the
-        deletion lease force-terminates the client."""
+        backends take exclusive device ownership) — even a client that
+        missed its handshake may have initialized jax on the slice.
+        Release happens on the process's absent/LWT state, or at the
+        latest when the deletion lease force-terminates the client."""
         client_id = str(client_id)
         record = self.clients.get(client_id)
-        handshook = bool(record and record.topic_path)
-        state_topic = record.state_topic if record else ""
+        if record is None:
+            return              # idempotent: repeat deletes must not
+                                # touch slices already parked pending
+        state_topic = record.state_topic
         super().delete_client(client_id)
         if self.pool.slice_of(client_id) is None:
             return                       # nothing held
-        if not handshook or not state_topic:
-            self._release(client_id)     # never ran: devices untouched
-            return
-        pending = self._pending_release.setdefault(state_topic, set())
-        if not pending:
-            self.runtime.add_message_handler(self._release_on_absent,
-                                             state_topic)
-        pending.add(client_id)
+        if state_topic:
+            # watch for a FUTURE absent (operator-initiated delete); a
+            # crash-driven delete is released by _client_state_handler
+            # below, which owns the in-flight absent event
+            pending = self._pending_release.setdefault(state_topic, set())
+            if not pending:
+                self.runtime.add_message_handler(self._release_on_absent,
+                                                 state_topic)
+            pending.add(client_id)
+        # no state topic (never handshook): the always-armed deletion
+        # lease (_terminate_and_release) reclaims after force-kill
+
+    def _client_state_handler(self, topic, payload) -> None:
+        """Absent arrives → base deletes the clients (parking their
+        slices) → release them here, directly off the event: the death
+        is confirmed, and waiting for a retained-message redelivery
+        would hang when other clients keep the topic subscribed."""
+        super()._client_state_handler(topic, payload)
+        if is_absent(payload):
+            self._release_pending(topic)
 
     def _release_on_absent(self, topic, payload) -> None:
-        if "absent" not in str(payload):
-            return
+        if is_absent(payload):
+            self._release_pending(topic)
+
+    def _release_pending(self, topic: str) -> None:
         for client_id in self._pending_release.pop(topic, set()):
             self._release(client_id)
         self.runtime.remove_message_handler(self._release_on_absent,
@@ -241,3 +274,10 @@ class PlacementManager(LifeCycleManager):
         self.ec_producer.update("devices_total", self.pool.total)
         self.ec_producer.update("devices_free", self.pool.free)
         self.ec_producer.update("devices_allocated", self.pool.allocated)
+
+    def stop(self) -> None:
+        for topic in list(self._pending_release):
+            self.runtime.remove_message_handler(self._release_on_absent,
+                                                topic)
+        self._pending_release.clear()
+        super().stop()

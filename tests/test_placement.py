@@ -54,6 +54,22 @@ class TestDevicePool:
         rest = pool.allocate({"data": -1, "model": 2}, "b")
         assert rest.mesh_axes == {"data": 2, "model": 2}
 
+    def test_wildcard_resolves_to_obtainable_run_under_fragmentation(
+            self):
+        pool = DevicePool()
+        pool.allocate(3, "a")
+        pool.allocate(2, "b")
+        pool.allocate(3, "c")
+        pool.release("a")
+        pool.release("c")            # free = 6, but runs of 3 and 3
+        d = pool.allocate({"data": -1}, "d")
+        assert len(d.devices) == 3   # the longest contiguous run
+        pool.release("d")
+        pool.release("b")
+        pool.allocate(8, "all")
+        with pytest.raises(RuntimeError, match="exhausted"):
+            pool.allocate({"data": -1}, "e")
+
     def test_fragmentation_respects_contiguity(self):
         pool = DevicePool()
         pool.allocate(3, "a")
@@ -171,6 +187,23 @@ class TestPlacementManager:
         engine.clock.advance(31.0)
         settle(engine, 8)
         assert len(manager.clients) == 1
+
+    def test_repeat_delete_does_not_release_parked_slice(
+            self, make_runtime, engine):
+        """A second delete of a client awaiting vacate confirmation must
+        not free its chips early (operator double-send)."""
+        manager, pool, spawned, ids = self.make_fleet(
+            make_runtime, engine, 4, 2)
+        manager.delete_client(ids[0])
+        settle(engine, 5)
+        assert pool.free == 0            # parked, not released
+        manager.delete_client(ids[0])    # retry: idempotent no-op
+        settle(engine, 5)
+        assert pool.free == 0
+        # confirmed death still releases exactly once
+        spawned[ids[0]][0].message.crash()
+        settle(engine, 10)
+        assert pool.free == 4
 
     def test_crashed_client_returns_devices(self, make_runtime, engine):
         """Ungraceful worker death (LWT) must free its slice — the

@@ -1,0 +1,83 @@
+# XGO example tests: robot actor + teleop client across two runtimes
+# (reference: examples/xgo_robot/xgo_robot.py + robot_control.py).
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples", "xgo_robot"))
+
+from robot_control import (MOVE_STEP, RobotControl,      # noqa: E402
+                           frame_to_ascii)
+from xgo_robot import SimulatedXgo, XgoRobot             # noqa: E402
+
+from aiko_services_tpu.registrar import Registrar        # noqa: E402
+
+
+def settle(engine, steps=12):
+    for _ in range(steps):
+        engine.step()
+
+
+def test_teleop_drives_robot_across_runtimes(make_runtime, engine):
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    robot_rt = make_runtime("robot_host").initialize()
+    robot = XgoRobot(robot_rt)
+    control_rt = make_runtime("pilot_host").initialize()
+    control = RobotControl(control_rt)
+    settle(engine, 20)
+    assert control.connected
+
+    # keyboard → RPC → hardware state
+    assert control.handle_key("w")
+    assert control.handle_key("q")
+    assert control.handle_key("g")
+    settle(engine, 10)
+    assert robot.hardware.pose["x"] == MOVE_STEP
+    assert robot.hardware.attitude["yaw"] == 345.0
+    assert robot.hardware.claw_grip == 255
+    assert not control.handle_key("?")     # unmapped key
+
+    # video: robot publishes tensors; teleop tails and rasterizes
+    control.start_video(rate=20.0)
+    for _ in range(8):
+        engine.clock.advance(0.05)
+        settle(engine, 2)
+    assert control.frames_seen >= 3
+    assert control.last_frame.shape == (120, 160, 3)
+    rows = frame_to_ascii(control.last_frame, width=32, height=10)
+    assert len(rows) == 10 and any(c != " " for r in rows for c in r)
+    control.stop_video()
+
+    # telemetry mirrors over EC
+    engine.clock.advance(5.1)
+    settle(engine, 10)
+    assert "battery" in control.telemetry
+    lines = "\n".join(control.status_lines())
+    assert "battery" in lines
+
+    # robot death → teleop detaches (drain the video-phase backlog)
+    robot_rt.message.crash()
+    for _ in range(300):
+        engine.step()
+        if not control.connected:
+            break
+    assert not control.connected
+    assert "searching" in control.status_lines()[0]
+    control.terminate()
+
+
+def test_simulated_hardware_camera_and_battery():
+    sim = SimulatedXgo()
+    first = sim.capture_image()
+    second = sim.capture_image()
+    assert first.shape == (120, 160, 3)
+    assert not np.array_equal(first, second)     # phase advances
+    start = sim.battery
+    assert sim.read_battery() == start - 1
