@@ -169,6 +169,24 @@ class PE_LlamaAgent(PipelineElement):
         self.params = self.compute.place_params(params,
                                                 llama_axes(config))
         tokens = int(max_tokens)
+        self.max_tokens = tokens
+
+        if self.mode == "continuous":
+            # iteration-level scheduling: requests join/leave the running
+            # batch between decode steps (serving.ContinuousDecoder) —
+            # ragged generation lengths no longer idle the MXU
+            from ..serving import ContinuousDecoder
+            steps_per_sync, _ = self.get_parameter("steps_per_sync", 4)
+            eos_token, _ = self.get_parameter("eos_token", -1)
+            self.decoder = ContinuousDecoder(
+                self.params, config, max_slots=int(max_batch),
+                prefill_buckets=(int(self.prompt_length),),
+                steps_per_sync=int(steps_per_sync),
+                eos_token=int(eos_token) if int(eos_token) >= 0 else None,
+                name=self.definition.name)
+            self._setup_done = True
+            return
+
         decode = jax.jit(lambda params, prompt: llama_greedy_decode(
             params, config, prompt, max_tokens=tokens))
 
@@ -190,6 +208,28 @@ class PE_LlamaAgent(PipelineElement):
 
     def start_stream(self, stream) -> None:
         self._setup()
+        if self.mode == "continuous":
+            # pump timer lives while any stream is open (same teardown
+            # discipline as the other timer-owning elements)
+            self._open_streams = getattr(self, "_open_streams", 0) + 1
+            if self._open_streams == 1:
+                self.decoder.attach(self.runtime.event)
+                self.decoder.on_idle = None
+
+    def stop_stream(self, stream) -> None:
+        if self.mode == "continuous":
+            self._open_streams = max(0,
+                                     getattr(self, "_open_streams", 0) - 1)
+            if self._open_streams == 0:
+                # in-flight requests must still complete (their frames
+                # are parked DEFERRED) — detach only once drained
+                if self.decoder.idle:
+                    self.decoder.detach(self.runtime.event)
+                else:
+                    self.decoder.on_idle = lambda: (
+                        self.decoder.detach(self.runtime.event)
+                        if getattr(self, "_open_streams", 0) == 0
+                        else None)
 
     def _pad_prompt(self, text):
         import numpy as np
@@ -205,6 +245,19 @@ class PE_LlamaAgent(PipelineElement):
 
     def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
         self._setup()
+
+        if self.mode == "continuous":
+            tokens = self.tokenizer(str(text)) or [1]
+
+            def on_done(_rid, generated):
+                self.pipeline.post("resume_frame", frame,
+                                   self.definition.name,
+                                   self._to_outputs(generated))
+
+            self.decoder.submit(f"{frame.stream_id}.{frame.frame_id}",
+                                tokens, self.max_tokens, on_done)
+            return FrameOutput(True, DEFERRED)
+
         prompt = self._pad_prompt(text)
         length = int(self.prompt_length)
 

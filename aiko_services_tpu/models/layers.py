@@ -267,11 +267,21 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
 
 
 def apply_rope(x, cos, sin, position_offset=0):
-    """x: [B, H, T, D]; rotates pairs (even, odd) by position angle."""
+    """x: [B, H, T, D]; rotates pairs (even, odd) by position angle.
+
+    position_offset: scalar (shared), or [B] vector — per-example
+    offsets for continuous batching, where each slot sits at its own
+    sequence position."""
     t = x.shape[2]
-    positions = position_offset + jnp.arange(t)
-    cos_t = jnp.take(cos, positions, axis=0)[None, None]   # [1,1,T,D/2]
-    sin_t = jnp.take(sin, positions, axis=0)[None, None]
+    offset = jnp.asarray(position_offset)
+    if offset.ndim == 0:
+        positions = offset + jnp.arange(t)                   # [T]
+        cos_t = jnp.take(cos, positions, axis=0)[None, None]  # [1,1,T,D/2]
+        sin_t = jnp.take(sin, positions, axis=0)[None, None]
+    else:
+        positions = offset[:, None] + jnp.arange(t)[None]    # [B, T]
+        cos_t = jnp.take(cos, positions, axis=0)[:, None]    # [B,1,T,D/2]
+        sin_t = jnp.take(sin, positions, axis=0)[:, None]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     rotated = jnp.stack([x1 * cos_t - x2 * sin_t,
                          x1 * sin_t + x2 * cos_t], axis=-1)

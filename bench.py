@@ -63,7 +63,7 @@ from aiko_services_tpu.models.whisper import WHISPER_PRESETS, greedy_decode
 CHUNK_SECONDS = 5.0           # streaming chunk size (audio_io.py-style)
 FRAMES_PER_SECOND = 100       # whisper log-mel frame rate
 SAMPLE_RATE = 16000
-BATCH_LADDER = (8, 16, 24, 32, 48)
+BATCH_LADDER = (8, 16, 24, 32, 48, 64)
 LATENCY_BUDGET = 0.150        # north-star p50 bound (BASELINE.md)
 MAX_TOKENS = 24               # tokens decoded per 5 s chunk
 REPEATS = 8
@@ -97,23 +97,38 @@ def measure_model(config, params, batch: int) -> float:
 
 
 def model_ladder():
+    """Measure decode p50 across the batch ladder.  Returns
+    ({batch: seconds}, (best_model_streams, latency, batch)) — the
+    'best' pick is the model-only number (largest batch under the
+    150 ms budget); the PIPELINE batch is chosen separately from these
+    times + the measured per-batch overhead (see pick_pipeline_batch)."""
     frames = int(CHUNK_SECONDS * FRAMES_PER_SECOND)
     config = model_config(frames)
     params = whisper_init(jax.random.PRNGKey(0), config)
+    times: dict = {}
     best = None                               # (streams, latency, batch)
     for batch in BATCH_LADDER:
         elapsed = measure_model(config, params, batch)
+        times[batch] = elapsed
         streams = batch * CHUNK_SECONDS / elapsed
         if elapsed <= LATENCY_BUDGET and (best is None or
                                           streams > best[0]):
             best = (streams, elapsed, batch)
-        if elapsed > LATENCY_BUDGET:
-            break                             # latency grows with batch
+        if elapsed > 4 * LATENCY_BUDGET:
+            break                     # far past any useful ladder point
     if best is None:
         batch = BATCH_LADDER[0]
-        elapsed = measure_model(config, params, batch)
-        best = (batch * CHUNK_SECONDS / elapsed, elapsed, batch)
-    return best
+        best = (batch * CHUNK_SECONDS / times[batch], times[batch], batch)
+    return times, best
+
+
+_FRONTENDS = ("audio", "mel")
+# audio: raw f32 audio ships to the device, mel fused into the decode
+#   program (host does nothing per frame) — more wire bytes;
+# mel: host computes the log-mel per frame (4× fewer wire bytes, but a
+#   serial ~tens-of-ms host cost per item that caps throughput).
+# Which wins depends on the machine (tunnel bandwidth vs host CPU), so
+# the bench probes both and keeps the faster.
 
 
 class PE_BenchAudioSource:
@@ -139,26 +154,41 @@ class PE_BenchAudioSource:
         return FrameOutput(True, {"audio": self._chunk})
 
 
-def pipeline_definition(batch: int):
+def pipeline_definition(batch: int, frontend: str = "mel",
+                        max_wait: float = 0.1):
     frames = int(CHUNK_SECONDS * FRAMES_PER_SECOND)
+    parameters = {
+        "PE_WhisperASR.preset": PRESET,
+        "PE_WhisperASR.mode": "batched",
+        "PE_WhisperASR.pipelined": True,
+        "PE_WhisperASR.max_tokens": MAX_TOKENS,
+        "PE_WhisperASR.buckets": [frames],
+        "PE_WhisperASR.max_batch": batch,
+        # pad_batch means the device ALWAYS runs the full batch shape —
+        # firing sparse batches wastes lanes, so the wait is tuned to
+        # roughly one device round (latency here is tunnel-dominated
+        # anyway; see measure/bench_pipeline)
+        "PE_WhisperASR.max_wait": max_wait,
+    }
+    if frontend == "audio":
+        # mel fused into the device program: zero host work per frame
+        parameters["PE_WhisperASR.frontend"] = "audio"
+        return {
+            "version": 0, "name": "p_bench", "runtime": "jax",
+            "graph": ["(PE_BenchAudioSource (PE_WhisperASR))"],
+            "parameters": parameters,
+            "elements": [
+                {"name": "PE_BenchAudioSource", "input": [],
+                 "output": [{"name": "audio"}]},
+                {"name": "PE_WhisperASR", "input": [{"name": "audio"}],
+                 "output": [{"name": "tokens"}, {"name": "text"}]},
+            ],
+        }
+    parameters["PE_LogMel.device"] = "cpu"
     return {
         "version": 0, "name": "p_bench", "runtime": "jax",
         "graph": ["(PE_BenchAudioSource (PE_LogMel (PE_WhisperASR)))"],
-        "parameters": {
-            # frontend on host CPU: this machine reaches the chip over a
-            # thin tunnel, so wire bytes are the scarce resource — bf16
-            # mel is 4x smaller than f32 audio (production would pick
-            # frontend=audio and fuse the mel on-device; both paths are
-            # tested)
-            "PE_LogMel.device": "cpu",
-            "PE_WhisperASR.preset": PRESET,
-            "PE_WhisperASR.mode": "batched",
-            "PE_WhisperASR.pipelined": True,
-            "PE_WhisperASR.max_tokens": MAX_TOKENS,
-            "PE_WhisperASR.buckets": [frames],
-            "PE_WhisperASR.max_batch": batch,
-            "PE_WhisperASR.max_wait": 0.03,
-        },
+        "parameters": parameters,
         "elements": [
             {"name": "PE_BenchAudioSource", "input": [],
              "output": [{"name": "audio"}]},
@@ -179,7 +209,8 @@ class PipelineBench:
     completes inside the window (no backlog growth) with p50 latency
     under budget; latency spans frame post → frame completion."""
 
-    def __init__(self, batch: int):
+    def __init__(self, batch: int, frontend: str = "mel",
+                 max_wait: float = 0.1):
         from aiko_services_tpu.compute import ComputeRuntime
         from aiko_services_tpu.event import EventEngine
         from aiko_services_tpu.pipeline import Pipeline, \
@@ -204,7 +235,8 @@ class PipelineBench:
         self.compute = ComputeRuntime(self.runtime, "compute")
         self.pipeline = Pipeline(
             self.runtime,
-            parse_pipeline_definition(pipeline_definition(batch)),
+            parse_pipeline_definition(
+                pipeline_definition(batch, frontend, max_wait)),
             stream_lease_time=0,
             element_classes={
                 "PE_BenchAudioSource": PE_BenchAudioSource})
@@ -243,7 +275,23 @@ class PipelineBench:
         self.engine.run_until(lambda: self._completed >= batch,
                               timeout=600.0)
 
-    def measure(self, n_streams: int, window: float):
+    def measure_round(self, batch: int, repeats: int = 3) -> float:
+        """Median wall time for one full batch through the pipeline
+        (frame walk + mel + marshalling + device + sync) — the per-batch
+        cost including the fixed tunnel/dispatch overhead."""
+        times = []
+        for _ in range(repeats):
+            before = self._completed
+            start = time.perf_counter()
+            for i in range(batch):
+                self._post(f"s{i}")
+            self.engine.run_until(
+                lambda: self._completed >= before + batch, timeout=600.0)
+            times.append(time.perf_counter() - start)
+        return statistics.median(times)
+
+    def measure(self, n_streams: int, window: float,
+                drain_budget: float = 2.0):
         """Run N real-time streams for `window` seconds.  Returns
         (completed_ok, p50, frames, mean_batch_size)."""
         import heapq as _heapq
@@ -294,32 +342,31 @@ class PipelineBench:
               f"batches={program.scheduler.stats['batches']}",
               file=sys.stderr)
         # sustained = kept up with real-time arrivals: everything drained
-        # promptly (small residual at deadline is the last batch in
+        # promptly (small residual at deadline is the last batches in
         # flight, not a growing backlog)
-        keeping_up = drained and drain_time <= 2.0
+        keeping_up = drained and drain_time <= drain_budget
         return keeping_up, p50, frames, \
             program.scheduler.mean_batch_size()
 
 
-def bench_pipeline(batch: int, capacity: float):
+def bench_pipeline(bench, capacity: float, drain_budget: float = 2.0):
     """Find the largest stream count the pipeline sustains (keeps up with
     real-time arrivals, no backlog growth).  Returns
-    (streams_sustained, p50, frames, mean_batch).
+    (streams_sustained, p50, frames, mean_batch, verified).
 
     The p50 budget is reported, not gated here: this bench machine
     reaches the chip over a tunnel with a ~0.3-0.8 s fixed
     transfer+dispatch cost per batch, a latency floor that production
     host-attached TPUs do not have; sustained throughput is
     tunnel-honest, absolute p50 is not."""
-    bench = PipelineBench(batch)
-    bench.warmup(batch)
     last = None
-    for fraction in (0.95, 0.8, 0.65, 0.5, 0.35, 0.2):
+    for fraction in (1.5, 1.25, 1.05, 0.9, 0.75, 0.6, 0.45):
         n = max(1, int(capacity * fraction))
-        ok, p50, frames, mean_batch = bench.measure(n, PIPELINE_SECONDS)
-        last = (n, p50, frames, mean_batch)
+        ok, p50, frames, mean_batch = bench.measure(
+            n, PIPELINE_SECONDS, drain_budget=drain_budget)
+        last = (n, p50, frames, mean_batch, False)
         if ok:
-            return n, p50, frames, mean_batch
+            return n, p50, frames, mean_batch, True
     return last
 
 
@@ -329,9 +376,36 @@ def main() -> None:
         from aiko_services_tpu.ops import attention as attn_mod
         attn_mod.dispatch_stats.update(flash=0, xla=0)
 
-    model_streams, model_latency, batch = model_ladder()
-    sustained, p50, frames, mean_batch = bench_pipeline(batch,
-                                                        model_streams)
+    model_times, (model_streams, model_latency, _) = model_ladder()
+
+    # pipeline batch = the largest measured geometry (pad_batch means
+    # the device always runs the full batch shape, so bigger amortizes
+    # every per-batch cost); frontend picked empirically (see _FRONTENDS)
+    batch = max(model_times)
+    rounds = {}
+    for frontend in _FRONTENDS:
+        probe = PipelineBench(batch, frontend)
+        probe.warmup(batch)
+        rounds[frontend] = probe.measure_round(batch)
+        del probe            # frees the probe's device params/runtime
+        print(f"frontend={frontend}: {rounds[frontend]:.2f}s per "
+              f"{batch}-batch round", file=sys.stderr)
+    frontend = min(rounds, key=rounds.get)
+    t_round = rounds[frontend]
+    # serial capacity floor; the pipelined path can beat it (uploads
+    # overlap compute), so the ladder searches above it too
+    capacity = batch / t_round * CHUNK_SECONDS
+    print(f"frontend={frontend} capacity≈{capacity:.0f} streams "
+          f"(serial floor)", file=sys.stderr)
+    # final bench: wait ≈ one device round so batches FILL under load
+    # instead of firing sparse (pad_batch burns full-batch device time
+    # either way)
+    wait = min(2.0, max(0.1, 0.75 * t_round))
+    drain_budget = max(2.0, 2.5 * t_round + wait)
+    bench = PipelineBench(batch, frontend, max_wait=wait)
+    bench.warmup(batch)
+    sustained, p50, frames, mean_batch, verified = \
+        bench_pipeline(bench, capacity, drain_budget)
 
     if debug:
         from aiko_services_tpu.ops import attention as attn_mod
@@ -349,10 +423,13 @@ def main() -> None:
         "value": round(sustained, 2),
         "unit": "streams",
         "vs_baseline": round(sustained / 1.0, 2),
+        "sustained_verified": bool(verified),
         "pipeline_p50_ms": round(p50 * 1000.0, 1),
         "latency_budget_met": bool(p50 <= LATENCY_BUDGET),
         "pipeline_frames": frames,
         "mean_device_batch": round(mean_batch, 1),
+        "frontend": frontend,
+        "batch_round_ms": round(t_round * 1000.0, 1),
         "model_streams": round(model_streams, 2),
         "model_p50_ms": round(model_latency * 1000.0, 1),
         "device_batch": batch,
