@@ -240,10 +240,13 @@ class PE_WhisperASR(PipelineElement):
                     for i in range(count)]
 
         pipelined, _ = self.get_parameter("pipelined", False)
+        # sync mode blocks on drain(force=True), which never completes
+        # pipelined items — refuse the combination
+        pipelined = bool(pipelined) and self.mode != "sync"
         self.compute.register_batched(
             self._program, run_bucket, buckets, collate, split,
             max_batch=int(max_batch), max_wait=float(max_wait),
-            pipelined=bool(pipelined))
+            pipelined=pipelined)
         self._setup_done = True
 
     def start_stream(self, stream) -> None:

@@ -85,10 +85,13 @@ class PE_NeuralTTS(PipelineElement):
             return [audio[i] for i in range(count)]
 
         pipelined, _ = self.get_parameter("pipelined", False)
+        # sync mode blocks on drain(force=True), which never completes
+        # pipelined items — refuse the combination
+        pipelined = bool(pipelined) and self.mode != "sync"
         self.compute.register_batched(
             self._program, run_bucket, [self.max_tokens],
             collate, split, max_batch=int(max_batch),
-            max_wait=float(max_wait), pipelined=bool(pipelined))
+            max_wait=float(max_wait), pipelined=pipelined)
         self._setup_done = True
 
     def start_stream(self, stream) -> None:

@@ -16,8 +16,8 @@ import jax.numpy as jnp
 from . import layers as L
 
 __all__ = ["LlamaConfig", "llama_init", "llama_axes", "llama_forward",
-           "llama_decode_step", "llama_greedy_decode", "init_llama_caches",
-           "LLAMA_PRESETS"]
+           "llama_forward_sp", "llama_decode_step", "llama_greedy_decode",
+           "init_llama_caches", "LLAMA_PRESETS"]
 
 
 @dataclass(frozen=True)
@@ -159,6 +159,59 @@ def llama_forward(params, config: LlamaConfig, tokens):
     caches = init_llama_caches(config, tokens.shape[0], tokens.shape[1])
     logits, _ = llama_decode_step(params, config, tokens, caches)
     return logits
+
+
+def llama_forward_sp(params, config: LlamaConfig, tokens, mesh,
+                     axis_name: str = "seq", batch_axis: str = "data"):
+    """Sequence-parallel long-context forward (prefill): activations
+    sharded over the sequence axis; exact causal attention via ring
+    attention (K/V blocks rotate over ICI, online softmax — SURVEY §5.7).
+
+    tokens: [B, S] with S divisible by the `axis_name` mesh size.
+    Returns logits [B, S, vocab] sharded the same way.  This is how a
+    prompt too long for one chip's memory prefills: each device holds
+    S/n of the sequence and never materializes the S×S score matrix."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring_attention import ring_attention_sharded
+
+    def body(params, tokens_local):
+        cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
+                                      config.rope_theta)
+        s_local = tokens_local.shape[1]
+        offset = lax.axis_index(axis_name) * s_local
+        x = L.embedding(params["embed"],
+                        tokens_local).astype(config.dtype)
+        for layer in params["layers"]:
+            normed = L.rms_norm(layer["ln_attn"], x)
+            q = L._split_heads(L.linear(layer["attn"]["q"], normed),
+                               config.num_heads)
+            k = L._split_heads(L.linear(layer["attn"]["k"], normed),
+                               config.num_kv_heads)
+            v = L._split_heads(L.linear(layer["attn"]["v"], normed),
+                               config.num_kv_heads)
+            q = L.apply_rope(q, cos, sin, offset)
+            k = L.apply_rope(k, cos, sin, offset)
+            if config.num_kv_heads != config.num_heads:
+                group = config.num_heads // config.num_kv_heads
+                k = jnp.repeat(k, group, axis=1)
+                v = jnp.repeat(v, group, axis=1)
+            attn = ring_attention_sharded(q, k, v, axis_name=axis_name,
+                                          causal=True)
+            x = x + L.linear(layer["attn"]["o"], L._merge_heads(attn))
+            normed = L.rms_norm(layer["ln_mlp"], x)
+            x = x + _swiglu(layer, normed)
+        x = L.rms_norm(params["ln_out"], x)
+        return L.linear(params["lm_head"], x.astype(jnp.float32))
+
+    batch = batch_axis if batch_axis in mesh.axis_names else None
+    token_spec = P(batch, axis_name)
+    param_specs = jax.tree.map(lambda _: P(), params)   # replicated
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(param_specs, token_spec),
+        out_specs=P(batch, axis_name, None))(params, tokens)
 
 
 def llama_greedy_decode(params, config: LlamaConfig, prompt,

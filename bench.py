@@ -370,6 +370,121 @@ def bench_pipeline(bench, capacity: float, drain_budget: float = 2.0):
     return last
 
 
+class PE_BenchImageSource:
+    """Source element: a fixed synthetic camera frame per pipeline frame
+    (BASELINE config 4's gstreamer ingest stand-in: ingest cost on this
+    machine is negligible next to the device hop)."""
+
+    def __init__(self, runtime, name, definition, pipeline=None):
+        self.name = name
+        self.definition = definition
+        rng = np.random.default_rng(7)
+        self._image = rng.integers(0, 255, (DETECT_IMAGE, DETECT_IMAGE, 3),
+                                   dtype=np.uint8)
+
+    def start_stream(self, stream) -> None:
+        pass
+
+    def stop_stream(self, stream) -> None:
+        pass
+
+    def process_frame(self, frame, **_):
+        from aiko_services_tpu.pipeline import FrameOutput
+        return FrameOutput(True, {"image": self._image})
+
+
+DETECT_IMAGE = 256
+DETECT_PRESET = os.environ.get("AIKO_BENCH_DETECT_PRESET", "detector_r18")
+DETECT_BATCH = 32
+DETECT_FRAMES = int(os.environ.get("AIKO_BENCH_DETECT_FRAMES", "512"))
+
+
+def bench_detect():
+    """BASELINE's second headline: video → PE_Detect → PE_Tracker
+    frames/sec/chip.  Saturation throughput: DETECT_FRAMES frames pushed
+    through the batched detector as fast as they complete."""
+    from aiko_services_tpu.compute import ComputeRuntime
+    from aiko_services_tpu.event import EventEngine
+    from aiko_services_tpu.pipeline import Pipeline, \
+        parse_pipeline_definition
+    from aiko_services_tpu.process import ProcessRuntime
+    from aiko_services_tpu.transport.memory import (MemoryBroker,
+                                                    MemoryMessage)
+
+    engine = EventEngine()
+    broker = MemoryBroker()
+
+    def transport_factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+        return MemoryMessage(on_message=on_message, broker=broker,
+                             lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                             lwt_retain=lwt_retain)
+
+    runtime = ProcessRuntime(name="bench_detect", engine=engine,
+                             transport_factory=transport_factory)
+    runtime.initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_detect", "runtime": "jax",
+        "graph": ["(PE_BenchImageSource (PE_Detect (PE_Tracker)))"],
+        "parameters": {
+            "PE_Detect.preset": DETECT_PRESET,
+            "PE_Detect.image_size": DETECT_IMAGE,
+            "PE_Detect.max_batch": DETECT_BATCH,
+            "PE_Detect.pipelined": True,
+            "PE_Detect.max_wait": 0.05,
+        },
+        "elements": [
+            {"name": "PE_BenchImageSource", "input": [],
+             "output": [{"name": "image"}]},
+            {"name": "PE_Detect", "input": [{"name": "image"}],
+             "output": [{"name": "boxes"}, {"name": "scores"},
+                        {"name": "classes"}]},
+            {"name": "PE_Tracker", "input": [{"name": "boxes"}],
+             "output": [{"name": "tracks"}]},
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0,
+                        element_classes={
+                            "PE_BenchImageSource": PE_BenchImageSource})
+    completed = [0]
+    pipeline.add_frame_handler(lambda frame: completed.__setitem__(
+        0, completed[0] + 1))
+    streams = DETECT_BATCH
+    for i in range(streams):
+        pipeline.create_stream(f"v{i}", lease_time=0)
+
+    def post_round():
+        for i in range(streams):
+            pipeline.post("process_frame", f"v{i}", {})
+
+    post_round()                                  # warmup batch: compile
+    engine.run_until(lambda: completed[0] >= streams, timeout=600.0)
+
+    completed[0] = 0
+    target = DETECT_FRAMES
+
+    # closed loop at 2 rounds in flight: upload overlaps compute
+    posted = [0]
+
+    def pump() -> None:
+        while posted[0] < target and \
+                posted[0] - completed[0] < 2 * streams:
+            post_round()
+            posted[0] += streams
+
+    timer = engine.add_timer_handler(pump, 0.002)
+    start = time.perf_counter()
+    finished = engine.run_until(lambda: completed[0] >= target,
+                                timeout=600.0)
+    elapsed = time.perf_counter() - start
+    engine.remove_timer_handler(timer)
+    if not finished:
+        raise RuntimeError(
+            f"detect bench stalled: {completed[0]}/{target} frames in "
+            f"{elapsed:.0f}s — refusing to report a bogus fps")
+    return completed[0] / elapsed
+
+
 def main() -> None:
     debug = "--debug" in sys.argv
     if debug:
@@ -407,6 +522,10 @@ def main() -> None:
     sustained, p50, frames, mean_batch, verified = \
         bench_pipeline(bench, capacity, drain_budget)
 
+    detect_fps = bench_detect()
+    print(f"detect: {detect_fps:.1f} frames/sec/chip "
+          f"({DETECT_PRESET}@{DETECT_IMAGE})", file=sys.stderr)
+
     if debug:
         from aiko_services_tpu.ops import attention as attn_mod
         stats = attn_mod.dispatch_stats
@@ -433,6 +552,9 @@ def main() -> None:
         "model_streams": round(model_streams, 2),
         "model_p50_ms": round(model_latency * 1000.0, 1),
         "device_batch": batch,
+        "detect_fps_per_chip": round(detect_fps, 1),
+        "detect_config": f"{DETECT_PRESET}@{DETECT_IMAGE}px"
+                         f"→tracker, batch {DETECT_BATCH}",
     }))
 
 
