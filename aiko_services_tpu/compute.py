@@ -25,7 +25,15 @@ from .service import ServiceProtocol
 from .actor import Actor
 from .utils import get_logger
 
-__all__ = ["ComputeRuntime", "CompiledProgram", "PROTOCOL_COMPUTE"]
+__all__ = ["ComputeRuntime", "CompiledProgram", "PROTOCOL_COMPUTE",
+           "resolve_pipelined"]
+
+
+def resolve_pipelined(pipelined, mode: str) -> bool:
+    """Pipelined results complete on a LATER event-loop turn; a sync
+    caller blocking on scheduler.drain(force=True) would hang forever.
+    Every element that exposes both knobs must route them through here."""
+    return bool(pipelined) and mode != "sync"
 
 PROTOCOL_COMPUTE = ServiceProtocol("compute")
 

@@ -29,6 +29,7 @@ class PE_Detect(PipelineElement):
         import jax.numpy as jnp
         import numpy as np
 
+        from ..compute import resolve_pipelined
         from ..models.detector import (
             DETECTOR_PRESETS, detect, detector_axes, detector_init)
 
@@ -69,8 +70,20 @@ class PE_Detect(PipelineElement):
                 return p
             return np.clip(p, 0, 255).astype(np.uint8)
 
+        # pad partial batches to max_batch: ONE compile per bucket
+        # (same recompilation-storm guard as PE_WhisperASR); split()
+        # only reads the real rows back
+        pad_batch, _ = self.get_parameter("pad_batch",
+                                          self.mode == "batched")
+        size = self.image_size
+        full = int(max_batch)
+
         def collate(_bucket, payloads):
-            return jnp.asarray(np.stack([to_uint8(p) for p in payloads]))
+            rows = full if pad_batch else len(payloads)
+            batch = np.zeros((rows, size, size, 3), np.uint8)
+            for i, p in enumerate(payloads):
+                batch[i] = to_uint8(p)
+            return jnp.asarray(batch)
 
         def split(results, count):
             boxes, scores, classes = (np.asarray(r) for r in results)
@@ -86,10 +99,7 @@ class PE_Detect(PipelineElement):
         self.compute.register_batched(
             self._program, run_bucket, [self.image_size], collate, split,
             max_batch=int(max_batch), max_wait=float(max_wait),
-            # sync mode blocks on drain(force=True), which never
-            # completes pipelined items (they finish on a later event
-            # turn) — the combination would hang, so it is refused
-            pipelined=bool(pipelined) and self.mode != "sync")
+            pipelined=resolve_pipelined(pipelined, self.mode))
         self._setup_done = True
 
     def start_stream(self, stream) -> None:

@@ -191,7 +191,11 @@ class PE_WhisperASR(PipelineElement):
             if audio_frontend:
                 from ..ops.audio import log_mel_spectrogram
 
-                def fused(params, audio):
+                def fused(params, pcm):
+                    # audio arrives as int16 PCM (half the wire bytes of
+                    # f32; it is the native capture format) and converts
+                    # on device
+                    audio = pcm.astype(jnp.float32) / 32768.0
                     mel = log_mel_spectrogram(
                         audio, num_mels=config.n_mels)
                     return greedy_decode(params, config,
@@ -220,10 +224,16 @@ class PE_WhisperASR(PipelineElement):
             if audio_frontend:
                 from ..ops.audio import WHISPER_HOP
                 batch = np.zeros((rows(len(payloads)),
-                                  bucket * WHISPER_HOP), dtype="float32")
+                                  bucket * WHISPER_HOP), dtype="int16")
                 for i, audio in enumerate(payloads):
+                    audio = np.asarray(audio)
                     t = min(audio.shape[0], batch.shape[1])
-                    batch[i, :t] = np.asarray(audio)[:t]
+                    if audio.dtype == np.int16:
+                        batch[i, :t] = audio[:t]
+                    else:      # float [-1, 1] → 16-bit PCM quantization
+                        batch[i, :t] = np.clip(
+                            audio[:t] * 32767.0, -32768, 32767
+                        ).astype(np.int16)
                 return jnp.asarray(batch)
             batch = np.zeros((rows(len(payloads)), bucket,
                               self.config.n_mels), dtype="float32")
@@ -239,10 +249,9 @@ class PE_WhisperASR(PipelineElement):
             return [(tokens[i, :lengths[i]], int(lengths[i]))
                     for i in range(count)]
 
+        from ..compute import resolve_pipelined
         pipelined, _ = self.get_parameter("pipelined", False)
-        # sync mode blocks on drain(force=True), which never completes
-        # pipelined items — refuse the combination
-        pipelined = bool(pipelined) and self.mode != "sync"
+        pipelined = resolve_pipelined(pipelined, self.mode)
         self.compute.register_batched(
             self._program, run_bucket, buckets, collate, split,
             max_batch=int(max_batch), max_wait=float(max_wait),

@@ -84,10 +84,9 @@ class PE_NeuralTTS(PipelineElement):
             audio = np.asarray(results, dtype=np.float32)
             return [audio[i] for i in range(count)]
 
+        from ..compute import resolve_pipelined
         pipelined, _ = self.get_parameter("pipelined", False)
-        # sync mode blocks on drain(force=True), which never completes
-        # pipelined items — refuse the combination
-        pipelined = bool(pipelined) and self.mode != "sync"
+        pipelined = resolve_pipelined(pipelined, self.mode)
         self.compute.register_batched(
             self._program, run_bucket, [self.max_tokens],
             collate, split, max_batch=int(max_batch),

@@ -194,10 +194,8 @@ def llama_forward_sp(params, config: LlamaConfig, tokens, mesh,
                                config.num_kv_heads)
             q = L.apply_rope(q, cos, sin, offset)
             k = L.apply_rope(k, cos, sin, offset)
-            if config.num_kv_heads != config.num_heads:
-                group = config.num_heads // config.num_kv_heads
-                k = jnp.repeat(k, group, axis=1)
-                v = jnp.repeat(v, group, axis=1)
+            # K/V stay at num_kv_heads: the ring rotates the small
+            # blocks and expands per-block (GQA-aware ring attention)
             attn = ring_attention_sharded(q, k, v, axis_name=axis_name,
                                           causal=True)
             x = x + L.linear(layer["attn"]["o"], L._merge_heads(attn))

@@ -52,12 +52,18 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
 def ring_attention_sharded(q, k, v, axis_name: str = AXIS_SEQUENCE,
                            causal: bool = False, scale: float | None = None):
     """The per-device body — call inside shard_map with the sequence axis
-    sharded over `axis_name`.  q,k,v: [B, H, S_local, D]."""
+    sharded over `axis_name`.  q,k,v: [B, H, S_local, D].
+
+    GQA-aware: k/v may carry fewer heads than q (H_q % H_kv == 0).  The
+    ring rotates the SMALL K/V blocks — expansion to H_q happens
+    transiently per block, so ICI bytes and resident K/V stay at
+    H_kv size."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    group = q.shape[1] // k.shape[1]
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     s_local = q.shape[2]
@@ -75,7 +81,12 @@ def ring_attention_sharded(q, k, v, axis_name: str = AXIS_SEQUENCE,
     def step(i, carry):
         o, m, l, k_blk, v_blk = carry
         kv_idx = (my_idx - i) % n         # whose block we hold at step i
-        o, m, l = _block_update(q, k_blk, v_blk, o, m, l,
+        if group > 1:                     # GQA: expand per block only
+            k_full = jnp.repeat(k_blk, group, axis=1)
+            v_full = jnp.repeat(v_blk, group, axis=1)
+        else:
+            k_full, v_full = k_blk, v_blk
+        o, m, l = _block_update(q, k_full, v_full, o, m, l,
                                 q_offset, kv_idx * s_local, causal, scale)
         # rotate K/V one hop; XLA overlaps this with the next iteration's
         # compute on TPU (skipped after the last block)

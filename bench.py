@@ -522,9 +522,15 @@ def main() -> None:
     sustained, p50, frames, mean_batch, verified = \
         bench_pipeline(bench, capacity, drain_budget)
 
-    detect_fps = bench_detect()
-    print(f"detect: {detect_fps:.1f} frames/sec/chip "
-          f"({DETECT_PRESET}@{DETECT_IMAGE})", file=sys.stderr)
+    # a stalled detect bench must not discard the already-measured ASR
+    # headline — report without the detect fields instead
+    try:
+        detect_fps = bench_detect()
+        print(f"detect: {detect_fps:.1f} frames/sec/chip "
+              f"({DETECT_PRESET}@{DETECT_IMAGE})", file=sys.stderr)
+    except Exception as exc:
+        detect_fps = None
+        print(f"detect bench failed: {exc!r}", file=sys.stderr)
 
     if debug:
         from aiko_services_tpu.ops import attention as attn_mod
@@ -552,10 +558,11 @@ def main() -> None:
         "model_streams": round(model_streams, 2),
         "model_p50_ms": round(model_latency * 1000.0, 1),
         "device_batch": batch,
+    } | ({} if detect_fps is None else {
         "detect_fps_per_chip": round(detect_fps, 1),
         "detect_config": f"{DETECT_PRESET}@{DETECT_IMAGE}px"
                          f"→tracker, batch {DETECT_BATCH}",
-    }))
+    })))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,79 @@
+# Flagship integration: the full assistant pipeline — audio → ASR →
+# LLM agent → neural TTS → audio — three batched device programs behind
+# one ComputeRuntime, frames deferring and resuming at every model hop
+# (the reference's speech example chains WhisperX → LLM-over-HTTP →
+# Coqui inline on the event loop: examples/speech/speech_elements.py).
+
+import numpy as np
+
+from aiko_services_tpu.compute import ComputeRuntime
+from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+
+SAMPLE_RATE = 16000
+
+
+def element(name, inputs=(), outputs=()):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs]}
+
+
+def test_assistant_three_model_chain(make_runtime, engine):
+    runtime = make_runtime("assistant_host").initialize()
+    compute = ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_assistant", "runtime": "jax",
+        # edge mapping (response: text): the TTS speaks the agent's reply
+        "graph": ["(PE_LogMel (PE_WhisperASR (PE_LlamaAgent "
+                  "(PE_NeuralTTS (response: text)))))"],
+        "parameters": {
+            "PE_WhisperASR.preset": "test",
+            "PE_WhisperASR.max_tokens": 4,
+            "PE_WhisperASR.buckets": [100],
+            "PE_WhisperASR.max_wait": 0.01,
+            "PE_LlamaAgent.preset": "tiny",
+            "PE_LlamaAgent.max_tokens": 4,
+            "PE_LlamaAgent.prompt_length": 16,
+            "PE_LlamaAgent.max_wait": 0.01,
+            "PE_NeuralTTS.preset": "test",
+            "PE_NeuralTTS.max_tokens": 8,
+            "PE_NeuralTTS.gl_iters": 4,
+            "PE_NeuralTTS.max_wait": 0.01,
+        },
+        "elements": [
+            element("PE_LogMel", ["audio"], ["mel"]),
+            element("PE_WhisperASR", ["mel"], ["tokens", "text"]),
+            element("PE_LlamaAgent", ["text"],
+                    ["response", "response_tokens"]),
+            element("PE_NeuralTTS", ["text"],
+                    ["audio", "sample_rate"]),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        pipeline.create_stream(f"s{i}", lease_time=0)
+        audio = (0.1 * rng.standard_normal(SAMPLE_RATE)).astype(
+            np.float32)
+        pipeline.post("process_frame", f"s{i}", {"audio": audio})
+
+    for _ in range(4000):
+        if len(done) == 2:
+            break
+        engine.clock.advance(0.005)
+        engine.step()
+    assert len(done) == 2
+    for frame in done:
+        swag = frame.swag
+        assert isinstance(swag["text"], str)            # ASR hop ran
+        assert isinstance(swag["response"], str)        # agent hop ran
+        audio_out = np.asarray(swag["audio"])           # TTS hop ran
+        assert audio_out.ndim == 1 and audio_out.size > 1000
+        assert np.isfinite(audio_out).all()
+        assert swag["sample_rate"] == SAMPLE_RATE
+    # three distinct device programs served one pipeline
+    assert {"whisper_asr.PE_WhisperASR", "agent.PE_LlamaAgent",
+            "neural_tts.PE_NeuralTTS"} <= set(compute.programs)

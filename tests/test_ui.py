@@ -287,6 +287,31 @@ def test_system_start_stop_cycle(tmp_path):
     assert "not running" in result.output
 
 
+def test_all_example_definitions_parse_and_validate():
+    """Every shipped pipeline JSON must parse, validate its graph, and
+    name only resolvable element classes."""
+    import glob
+    import os
+
+    from aiko_services_tpu import elements as builtin
+    from aiko_services_tpu.pipeline import parse_pipeline_definition
+
+    paths = glob.glob(os.path.join(os.path.dirname(__file__), "..",
+                                   "examples", "*", "*.json"))
+    assert len(paths) >= 3
+    for path in paths:
+        with open(path) as handle:
+            definition = parse_pipeline_definition(
+                json.load(handle), source=path)
+        for element_def in definition.elements:
+            local = element_def.deploy.get("local", {})
+            if "module" in local or "remote" in element_def.deploy:
+                continue
+            class_name = local.get("class_name", element_def.name)
+            assert hasattr(builtin, class_name), \
+                f"{os.path.basename(path)}: unknown element {class_name}"
+
+
 def test_bootstrap_discovery_loopback():
     from aiko_services_tpu.utils.configuration import (
         BootstrapResponder, discover_bootstrap)
