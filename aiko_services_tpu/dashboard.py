@@ -21,7 +21,7 @@ from collections import deque
 
 from .service import ServiceFields, ServiceTopicPath
 from .share import ECConsumer, ServicesCache
-from .utils import generate, parse
+from .utils import generate, generate_sexpr, parse
 from .utils.configuration import get_hostname
 from .utils.sexpr import parse_int
 
@@ -91,8 +91,12 @@ class DashboardState:
     def update_variable(self, name: str, value) -> None:
         fields = self.selected()
         if fields is not None:
-            self.runtime.publish(f"{fields.topic_path}/control",
-                                 generate("update", [name, value]))
+            # double-encode like ECProducer._notify does: the receiving
+            # side parse_sexpr-inverts every wire value, so a
+            # single-encoded structured string would get over-parsed
+            self.runtime.publish(
+                f"{fields.topic_path}/control",
+                generate("update", [name, generate_sexpr(value)]))
 
     def close_consumer(self) -> None:
         if self._consumer is not None:
@@ -300,6 +304,9 @@ def run_dashboard(runtime, tick: float = 0.05) -> None:
     """Blocking curses loop; drives the runtime's EventEngine inline
     (reference refresh: 20 FPS, dashboard.py:217-219)."""
     import curses
+
+    from .dashboard_plugins import register_builtins
+    register_builtins()
 
     state = DashboardState(runtime)
 

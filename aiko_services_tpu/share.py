@@ -185,7 +185,21 @@ class ECProducer:
 
 
 def _decode_value(value):
-    """Wire values arrive as strings or parsed lists; fold scalars back."""
+    """Invert the producer's generate_sexpr encoding, then fold scalar
+    strings back to bool/int/float (the wire is typeless).
+
+    Without the parse_sexpr step, any string containing spaces/parens
+    came back wearing its canonical length prefix ("34:devices=..."),
+    and lists/dicts came back as their unparsed source text."""
+    if isinstance(value, str):
+        try:
+            value = parse_sexpr(value)
+        except Exception:
+            pass
+    return _fold_scalars(value)
+
+
+def _fold_scalars(value):
     if isinstance(value, str):
         if value == "true":
             return True
@@ -196,6 +210,11 @@ def _decode_value(value):
                 return cast(value)
             except ValueError:
                 continue
+        return value
+    if isinstance(value, list):
+        return [_fold_scalars(item) for item in value]
+    if isinstance(value, dict):
+        return {key: _fold_scalars(item) for key, item in value.items()}
     return value
 
 

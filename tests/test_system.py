@@ -166,6 +166,30 @@ class TestECShare:
         settle(engine, 0.2)
         assert "custom" not in cache
 
+    def test_rich_values_round_trip_faithfully(self, engine,
+                                               make_runtime):
+        """Strings with spaces/parens, lists, and s-expr-looking strings
+        cross the EC wire unmangled (no leaked canonical length
+        prefixes, no unparsed list source text)."""
+        p = make_runtime("producer").initialize()
+        actor = AlohaHonua(p)
+        c = make_runtime("consumer").initialize()
+        cache = {}
+        ECConsumer(c, cache, actor.topic_control)
+        settle(engine, 3.0)
+        values = {
+            "placement": "devices=[0, 1, 2, 3] mesh=(data=4)",
+            "tags": ["a", "b c", 3],
+            "sexprish": "(absent)",
+            "flag": True,
+            "ratio": 0.5,
+        }
+        for key, value in values.items():
+            actor.ec_producer.update(key, value)
+        settle(engine, 0.5)
+        for key, value in values.items():
+            assert cache[key] == value, (key, cache[key])
+
     def test_nested_share_paths(self, engine, make_runtime):
         p = make_runtime("producer").initialize()
         actor = AlohaHonua(p)

@@ -47,6 +47,12 @@ def test_dashboard_state_tracks_services(make_runtime, engine):
     assert actor.ec_producer.get("temperature") == 30
     assert dict(state.flat_share()).get("temperature") == 30
 
+    # structured strings survive the mutation path unmangled (the wire
+    # decode inverts one encoding layer — the dashboard must add it)
+    state.update_variable("note", "(absent) means gone")
+    settle(engine, 10)
+    assert actor.ec_producer.get("note") == "(absent) means gone"
+
     # log page tails the service's log topic
     state.back()
     state.open_log()
@@ -167,6 +173,55 @@ def test_dashboard_plugin_renders(make_runtime, engine):
     finally:
         _PLUGINS.clear()
         state.terminate()
+
+
+def test_builtin_compute_and_placement_plugins(make_runtime, engine):
+    """The shipped plugin pages render device health for a
+    ComputeRuntime and pool occupancy for a PlacementManager."""
+    from aiko_services_tpu import (ComputeRuntime, DevicePool,
+                                   LifeCycleClient, PlacementManager)
+    from aiko_services_tpu.dashboard import _PLUGINS
+    from aiko_services_tpu.dashboard_plugins import register_builtins
+
+    register_builtins()
+    try:
+        reg_rt = make_runtime("plug_reg").initialize()
+        Registrar(reg_rt)
+        engine.clock.advance(2.1)
+        settle(engine)
+
+        app_rt = make_runtime("plug_app").initialize()
+        ComputeRuntime(app_rt, "plug_compute")
+        manager = PlacementManager(
+            app_rt, "plug_pm",
+            spawner=lambda cid, topic, ds: (
+                LifeCycleClient(make_runtime(f"plug_w{cid}").initialize(),
+                                f"plug_cl{cid}", topic, cid)),
+            pool=DevicePool(), client_mesh_axes=4)
+        manager.create_clients(1)
+        state = DashboardState(make_runtime("plug_dash").initialize())
+        settle(engine, 30)
+
+        names = [f.name for f in state.services()]
+        state.selected_index = names.index("plug_compute")
+        state.open_variables()
+        settle(engine, 20)
+        lines = "\n".join(state.plugin_lines())
+        assert "devices: 1" in lines      # default mesh = one device
+        assert "device 0: mem" in lines
+        state.back()
+
+        state.selected_index = [f.name for f in state.services()].index(
+            "plug_pm")
+        state.open_variables()
+        settle(engine, 20)
+        lines = "\n".join(state.plugin_lines())
+        assert "device pool: 4 allocated / 4 free of 8" in lines
+        assert "client 0: devices=" in lines
+        state.terminate()
+    finally:
+        _PLUGINS.clear()
+        register_builtins()          # leave the process as found
 
 
 def test_trace_collector_spans(make_runtime):

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Convert a HuggingFace Llama checkpoint directory to this framework's
+flat-npz weight scheme.
+
+Usage:
+    python tools/convert_llama.py /path/to/llama-hf out_dir/
+
+Input directory layout (what `huggingface-cli download meta-llama/...`
+produces): model.safetensors / model-0000N-of-*.safetensors /
+pytorch_model.bin, plus tokenizer files.  Output: out_dir/weights.npz
+with '/'-joined tree paths into models/llama.py's param tree
+(loadable via elements.speech.load_flat_npz), and tokenizer files
+copied through for models/tokenizer.load_tokenizer.
+
+Two real transformations beyond renaming:
+  * torch Linear stores [out, in]; this framework stores [in, out] → T;
+  * HF attention was trained with the rotate_half RoPE convention
+    (pairs (i, i + D/2)); models/layers.apply_rope rotates interleaved
+    pairs (2i, 2i+1).  Q/K projection OUTPUT rows are permuted per head
+    so the checkpoint works under the interleaved convention:
+    new[2i] = old[i], new[2i+1] = old[i + D/2].
+
+Runs fully offline; torch-cpu suffices.  Reference parity: the
+reference's LLM hop is an HTTP request to an external server
+(examples/speech/speech_elements.py:155-172) — it never loads weights;
+here real Llama checkpoints serve through PE_LlamaAgent/serving.py.
+"""
+
+import argparse
+import glob
+import os
+import shutil
+import sys
+
+import numpy as np
+
+
+def load_state_dict(model_dir: str) -> dict:
+    shards = sorted(glob.glob(os.path.join(model_dir,
+                                           "model*.safetensors")))
+    if shards:
+        from safetensors import safe_open
+        state = {}
+        for shard in shards:
+            with safe_open(shard, framework="np") as handle:
+                for key in handle.keys():
+                    state[key] = handle.get_tensor(key)
+        return state
+    torch_path = os.path.join(model_dir, "pytorch_model.bin")
+    if os.path.exists(torch_path):
+        import torch
+        state = torch.load(torch_path, map_location="cpu",
+                           weights_only=True)
+        return {k: v.float().numpy() for k, v in state.items()}
+    raise FileNotFoundError(
+        f"no model*.safetensors or pytorch_model.bin in {model_dir}")
+
+
+def permute_rope_rows(weight: np.ndarray, num_heads: int) -> np.ndarray:
+    """Reorder a [H*D, in] projection's output rows from rotate_half to
+    interleaved RoPE pairing, per head."""
+    out_dim, in_dim = weight.shape
+    head_dim = out_dim // num_heads
+    half = head_dim // 2
+    per_head = weight.reshape(num_heads, head_dim, in_dim)
+    interleaved = np.empty_like(per_head)
+    interleaved[:, 0::2] = per_head[:, :half]
+    interleaved[:, 1::2] = per_head[:, half:]
+    return interleaved.reshape(out_dim, in_dim)
+
+
+def convert(state: dict, num_heads: int, num_kv_heads: int) -> dict:
+    out = {}
+    out["embed/table"] = state["model.embed_tokens.weight"]
+    layer_indices = sorted({
+        int(key.split(".")[2]) for key in state
+        if key.startswith("model.layers.")})
+    for i in layer_indices:
+        hf = f"model.layers.{i}"
+        mine = f"layers/{i}"
+        out[f"{mine}/ln_attn/scale"] = \
+            state[f"{hf}.input_layernorm.weight"]
+        out[f"{mine}/ln_mlp/scale"] = \
+            state[f"{hf}.post_attention_layernorm.weight"]
+        out[f"{mine}/attn/q/w"] = permute_rope_rows(
+            state[f"{hf}.self_attn.q_proj.weight"], num_heads).T
+        out[f"{mine}/attn/k/w"] = permute_rope_rows(
+            state[f"{hf}.self_attn.k_proj.weight"], num_kv_heads).T
+        out[f"{mine}/attn/v/w"] = state[f"{hf}.self_attn.v_proj.weight"].T
+        out[f"{mine}/attn/o/w"] = state[f"{hf}.self_attn.o_proj.weight"].T
+        out[f"{mine}/gate/w"] = state[f"{hf}.mlp.gate_proj.weight"].T
+        out[f"{mine}/up/w"] = state[f"{hf}.mlp.up_proj.weight"].T
+        out[f"{mine}/down/w"] = state[f"{hf}.mlp.down_proj.weight"].T
+    out["ln_out/scale"] = state["model.norm.weight"]
+    if "lm_head.weight" in state:
+        out["lm_head/w"] = state["lm_head.weight"].T
+    else:   # tied embeddings (llama-3.2 style)
+        out["lm_head/w"] = state["model.embed_tokens.weight"].T
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_dir")
+    parser.add_argument("out_dir")
+    parser.add_argument("--num-heads", type=int, required=True,
+                        help="attention heads (32 for llama-3-8b)")
+    parser.add_argument("--num-kv-heads", type=int, required=True,
+                        help="KV heads (8 for llama-3-8b)")
+    args = parser.parse_args()
+
+    state = load_state_dict(args.model_dir)
+    flat = convert(state, args.num_heads, args.num_kv_heads)
+    os.makedirs(args.out_dir, exist_ok=True)
+    np.savez(os.path.join(args.out_dir, "weights.npz"),
+             **{k: np.asarray(v, np.float32) for k, v in flat.items()})
+    for name in ("tokenizer.json", "tokenizer_config.json", "vocab.json",
+                 "merges.txt"):
+        src = os.path.join(args.model_dir, name)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(args.out_dir, name))
+    print(f"wrote {len(flat)} tensors to "
+          f"{os.path.join(args.out_dir, 'weights.npz')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
