@@ -32,7 +32,8 @@ def render_compute(state, fields) -> list:
             lines.append(f"  device {device_id}: mem {mem}")
     for key in sorted(share):
         if key.startswith("batch.") and key.endswith(".mean_size"):
-            program = key.split(".")[1]
+            # program names themselves contain dots (agent.PE_X)
+            program = key[len("batch."):-len(".mean_size")]
             wait = share.get(f"batch.{program}.mean_wait_ms", "?")
             count = share.get(f"batch.{program}.batches", "?")
             lines.append(f"  {program}: {count} batches, "

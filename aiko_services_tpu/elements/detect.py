@@ -151,9 +151,25 @@ class PE_LlamaAgent(PipelineElement):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._setup_done = False
+        self._stats_timer = None
         self.tokenizer = lambda text: [b % 250 for b in
                                        text.encode("utf-8")][:120]
         self.detokenizer = lambda tokens: " ".join(str(t) for t in tokens)
+
+    def _publish_serving_stats(self) -> None:
+        """Decoder occupancy/throughput into the pipeline's EC share —
+        the observability the batch path gets from _publish_stats."""
+        producer = getattr(self.pipeline, "ec_producer", None)
+        if producer is None:
+            return
+        name = self.definition.name
+        stats = self.decoder.stats
+        producer.update(f"serving.{name}.active",
+                        self.decoder.active_count)
+        producer.update(f"serving.{name}.completed", stats["completed"])
+        producer.update(f"serving.{name}.steps", stats["steps"])
+        producer.update(f"serving.{name}.occupancy",
+                        round(self.decoder.mean_occupancy(), 3))
 
     def _setup(self) -> None:
         if self._setup_done:
@@ -241,6 +257,10 @@ class PE_LlamaAgent(PipelineElement):
             if self._open_streams == 1:
                 self.decoder.attach(self.runtime.event)
                 self.decoder.on_idle = None
+                if self._stats_timer is None:
+                    self._stats_timer = self.runtime.event.\
+                        add_timer_handler(self._publish_serving_stats,
+                                          1.0)
 
     def stop_stream(self, stream) -> None:
         if self.mode == "continuous":
@@ -248,14 +268,23 @@ class PE_LlamaAgent(PipelineElement):
                                      getattr(self, "_open_streams", 0) - 1)
             if self._open_streams == 0:
                 # in-flight requests must still complete (their frames
-                # are parked DEFERRED) — detach only once drained
+                # are parked DEFERRED) — detach only once drained; the
+                # stats timer lives until then so drain completions
+                # still publish
                 if self.decoder.idle:
-                    self.decoder.detach(self.runtime.event)
+                    self._teardown_continuous()
                 else:
                     self.decoder.on_idle = lambda: (
-                        self.decoder.detach(self.runtime.event)
+                        self._teardown_continuous()
                         if getattr(self, "_open_streams", 0) == 0
                         else None)
+
+    def _teardown_continuous(self) -> None:
+        self._publish_serving_stats()       # final truth, not stale
+        if self._stats_timer is not None:
+            self.runtime.event.remove_timer_handler(self._stats_timer)
+            self._stats_timer = None
+        self.decoder.detach(self.runtime.event)
 
     def _pad_prompt(self, text):
         import numpy as np

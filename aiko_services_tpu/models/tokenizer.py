@@ -153,13 +153,16 @@ def load_tokenizer(path: str):
     """Load a tokenizer from a path.
 
     - "builtin:byte" → ByteTokenizer (test preset).
-    - directory with vocab.json + merges.txt (the converter's output or a
-      checkpoint directory) → BPETokenizer with whisper special ids
-      skipped on decode."""
+    - directory with vocab.json + merges.txt (GPT-2/whisper layout) or
+      a HF tokenizer.json (llama-3 layout: model.vocab/model.merges) →
+      BPETokenizer with whisper special ids skipped on decode."""
     if path == "builtin:byte":
         return ByteTokenizer()
     vocab_file = os.path.join(path, "vocab.json")
     merges_file = os.path.join(path, "merges.txt")
+    tokenizer_json = os.path.join(path, "tokenizer.json")
+    if not os.path.exists(vocab_file) and os.path.exists(tokenizer_json):
+        return _load_hf_tokenizer_json(tokenizer_json)
     with open(vocab_file, encoding="utf-8") as handle:
         vocab = json.load(handle)
     merges = []
@@ -174,4 +177,26 @@ def load_tokenizer(path: str):
     special = set()
     if len(vocab) >= 50257 or any(t.startswith("<|") for t in vocab):
         special = WhisperTokens(max(len(vocab), 51865)).special_ids()
+    return BPETokenizer(vocab, merges, special)
+
+
+def _load_hf_tokenizer_json(pathname: str):
+    """HF `tokenizers`-format file (llama-3 checkpoints ship only this):
+    the BPE vocab/merges live under model.vocab / model.merges.
+    (llama-2's sentencepiece tokenizer.model is NOT supported — convert
+    with HF's transformers first.)"""
+    with open(pathname, encoding="utf-8") as handle:
+        spec = json.load(handle)
+    model = spec.get("model", {})
+    if model.get("type") != "BPE" or "vocab" not in model:
+        raise ValueError(
+            f"{pathname}: unsupported tokenizer (model.type="
+            f"{model.get('type')!r}); only HF BPE tokenizer.json works")
+    vocab = model["vocab"]
+    merges = []
+    for merge in model.get("merges", []):
+        pair = merge.split(" ") if isinstance(merge, str) else merge
+        if len(pair) == 2:
+            merges.append((pair[0], pair[1]))
+    special = {entry["id"] for entry in spec.get("added_tokens", [])}
     return BPETokenizer(vocab, merges, special)

@@ -173,6 +173,14 @@ def test_llama_agent_continuous_mode(make_runtime, engine):
     assert len(done) == 3
     by_stream = {f.stream_id: f.swag for f in done}
 
+    # serving stats surface in the pipeline's EC share
+    engine.clock.advance(1.1)
+    engine.step()
+    assert pipeline.ec_producer.get(
+        "serving.PE_LlamaAgent.completed") == 3
+    assert pipeline.ec_producer.get(
+        "serving.PE_LlamaAgent.occupancy") > 0
+
     # note: the sync path pads prompts to prompt_length with LEADING
     # zeros while continuous prefills the raw prompt, so compare against
     # the serving oracle directly

@@ -151,6 +151,34 @@ def test_occupancy_and_stats(params):
     assert 0.0 < decoder.mean_occupancy() <= 1.0
 
 
+def test_tp_sharded_decoder_matches_oracle(params):
+    """Continuous decoding with TENSOR-PARALLEL params: weights sharded
+    over the model axis (heads/ffn/vocab), XLA inserting the
+    collectives — the 'agent sharded over a slice' serving shape
+    (BASELINE config 5).  Tokens must match the unsharded oracle."""
+    from aiko_services_tpu.models.llama import llama_axes
+    from aiko_services_tpu.parallel import create_mesh, shard_pytree
+
+    mesh = create_mesh({"data": 2, "model": 4})
+    placed = shard_pytree(params, llama_axes(CONFIG), mesh)
+    assert "model" in str(
+        placed["layers"][0]["gate"]["w"].sharding.spec)
+
+    decoder = ContinuousDecoder(placed, CONFIG, max_slots=2,
+                                prefill_buckets=(16,), steps_per_sync=4)
+    done = {}
+    prompts = {"r0": [5, 9, 23, 7], "r1": [40, 2]}
+    for rid, prompt in prompts.items():
+        decoder.submit(rid, prompt, 10,
+                       lambda rid, t: done.update({rid: t}))
+    for _ in range(80):
+        decoder.pump()
+        if len(done) == 2:
+            break
+    for rid, prompt in prompts.items():
+        assert done[rid] == oracle(params, prompt, 10), rid
+
+
 def test_long_context_sp_prefill_matches_forward(params):
     """Sequence-parallel prefill (ring attention over the seq axis) is
     numerically the plain forward — the long-context path a single

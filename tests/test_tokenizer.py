@@ -82,3 +82,28 @@ def test_load_tokenizer_from_files(tmp_path):
     assert tok.encode("th") == [256]
     assert tok.decode([256, ord("e")]) == "the"
     assert load_tokenizer("builtin:byte").decode([104, 105]) == "hi"
+
+
+def test_load_hf_tokenizer_json(tmp_path):
+    """llama-3-style checkpoints ship ONLY tokenizer.json (HF
+    `tokenizers` format): vocab/merges under model.*, specials under
+    added_tokens."""
+    mapping = byte_to_unicode()
+    vocab = {mapping[b]: b for b in range(256)}
+    vocab["th"] = 256
+    vocab["<|eot|>"] = 257
+    spec = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": ["t h"]},
+        "added_tokens": [{"id": 257, "content": "<|eot|>"}],
+    }
+    (tmp_path / "tokenizer.json").write_text(json.dumps(spec))
+    tok = load_tokenizer(str(tmp_path))
+    assert tok.encode("th") == [256]
+    assert tok.decode([256, ord("e"), 257]) == "the"   # special skipped
+
+    # unsupported formats fail loudly, not with garbage
+    (tmp_path / "tokenizer.json").write_text(json.dumps(
+        {"model": {"type": "Unigram"}}))
+    import pytest
+    with pytest.raises(ValueError, match="unsupported tokenizer"):
+        load_tokenizer(str(tmp_path))

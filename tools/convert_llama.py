@@ -99,18 +99,39 @@ def convert(state: dict, num_heads: int, num_kv_heads: int) -> dict:
     return out
 
 
+def read_head_config(model_dir: str):
+    """Head counts from the checkpoint's own config.json — wrong manual
+    flags would produce a shape-valid but silently garbage RoPE
+    permutation."""
+    config_path = os.path.join(model_dir, "config.json")
+    if not os.path.exists(config_path):
+        return None, None
+    import json
+    with open(config_path, encoding="utf-8") as handle:
+        config = json.load(handle)
+    heads = config.get("num_attention_heads")
+    return heads, config.get("num_key_value_heads", heads)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("model_dir")
     parser.add_argument("out_dir")
-    parser.add_argument("--num-heads", type=int, required=True,
-                        help="attention heads (32 for llama-3-8b)")
-    parser.add_argument("--num-kv-heads", type=int, required=True,
-                        help="KV heads (8 for llama-3-8b)")
+    parser.add_argument("--num-heads", type=int, default=None,
+                        help="attention heads (default: read from the "
+                             "checkpoint's config.json)")
+    parser.add_argument("--num-kv-heads", type=int, default=None,
+                        help="KV heads (default: read from config.json)")
     args = parser.parse_args()
 
+    config_heads, config_kv = read_head_config(args.model_dir)
+    num_heads = args.num_heads or config_heads
+    num_kv_heads = args.num_kv_heads or config_kv
+    if not num_heads or not num_kv_heads:
+        parser.error("no config.json in the checkpoint directory: pass "
+                     "--num-heads/--num-kv-heads explicitly")
     state = load_state_dict(args.model_dir)
-    flat = convert(state, args.num_heads, args.num_kv_heads)
+    flat = convert(state, num_heads, num_kv_heads)
     os.makedirs(args.out_dir, exist_ok=True)
     np.savez(os.path.join(args.out_dir, "weights.npz"),
              **{k: np.asarray(v, np.float32) for k, v in flat.items()})
