@@ -47,6 +47,18 @@ class PE_Detect(PipelineElement):
             raise RuntimeError(f"detect element {self.name}: no "
                                f"ComputeRuntime named {compute_name!r}")
         config = DETECTOR_PRESETS[str(preset)]
+        # dtype is opt-in bf16: measured on the bench chip, bf16 convs
+        # run 2.4x SLOWER than f32 for this backbone (67.8 vs 27.8
+        # fps/chip at batch 32/256px) — the conv path, unlike matmuls,
+        # does not win from bf16 here.  detect()'s score/box
+        # post-processing is f32 regardless.
+        dtype_name, _ = self.get_parameter("dtype", "float32")
+        if str(dtype_name) == "bfloat16":
+            import dataclasses
+            config = dataclasses.replace(
+                config, dtype=jnp.bfloat16,
+                backbone=dataclasses.replace(config.backbone,
+                                             dtype=jnp.bfloat16))
         params = detector_init(jax.random.PRNGKey(0), config)
         self.params = self.compute.place_params(params,
                                                 detector_axes(params))
@@ -158,18 +170,22 @@ class PE_LlamaAgent(PipelineElement):
 
     def _publish_serving_stats(self) -> None:
         """Decoder occupancy/throughput into the pipeline's EC share —
-        the observability the batch path gets from _publish_stats."""
+        the observability the batch path gets from _publish_stats.
+        Dedup'd: EC updates fan out to every leaseholder, so an idle
+        decoder must not stream identical values every second."""
         producer = getattr(self.pipeline, "ec_producer", None)
         if producer is None:
             return
         name = self.definition.name
         stats = self.decoder.stats
-        producer.update(f"serving.{name}.active",
-                        self.decoder.active_count)
-        producer.update(f"serving.{name}.completed", stats["completed"])
-        producer.update(f"serving.{name}.steps", stats["steps"])
-        producer.update(f"serving.{name}.occupancy",
-                        round(self.decoder.mean_occupancy(), 3))
+        for key, value in (
+                (f"serving.{name}.active", self.decoder.active_count),
+                (f"serving.{name}.completed", stats["completed"]),
+                (f"serving.{name}.steps", stats["steps"]),
+                (f"serving.{name}.occupancy",
+                 round(self.decoder.mean_occupancy(), 3))):
+            if producer.get(key) != value:
+                producer.update(key, value)
 
     def _setup(self) -> None:
         if self._setup_done:

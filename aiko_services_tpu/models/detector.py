@@ -116,7 +116,10 @@ def detect(params, config: DetectorConfig, images,
     xs = (cell % w).astype(jnp.float32)
 
     def gather_hw(grid):
-        flat_grid = grid.reshape(b, h * w, grid.shape[-1])
+        # f32 regression regardless of backbone dtype: bf16 box coords
+        # at 256-pixel scale quantize to whole pixels
+        flat_grid = grid.astype(jnp.float32).reshape(b, h * w,
+                                                     grid.shape[-1])
         return jnp.take_along_axis(flat_grid, cell[..., None], axis=1)
 
     size = gather_hw(sizes)                          # [B, K, 2] in cells

@@ -51,6 +51,20 @@ _PRETOKENIZE = re.compile(
     r"'s|'t|'re|'ve|'m|'ll|'d"
     r"| ?[^\W\d_]+| ?\d+| ?[^\s\w]+|_+|\s+(?!\S)|\s+")
 
+# llama-3's tiktoken-style split, approximated with re's unicode
+# classes: case-insensitive contractions, at most one leading
+# non-letter before a letter run, digit runs broken into GROUPS OF ≤3,
+# punctuation runs swallowing trailing newlines.  Ids diverge from the
+# checkpoint's training tokenization if the GPT-2 split is used
+# instead (digit runs and "DON'T" style contractions differ).
+_PRETOKENIZE_LLAMA3 = re.compile(
+    r"(?:'|’)(?i:s|t|re|ve|m|ll|d)"
+    r"|(?:(?![\r\n])[\W_])?[^\W\d_]+"
+    r"|\d{1,3}"
+    r"| ?(?:[^\s\w]|_)+[\r\n]*"
+    r"|\s*[\r\n]+"
+    r"|\s+(?!\S)|\s+")
+
 
 class BPETokenizer:
     """Byte-level BPE over a vocab dict + ranked merge list.
@@ -59,12 +73,14 @@ class BPETokenizer:
     greedy merges per pre-token → ids.
     decode: ids → tokens → bytes → utf-8 text (special ids skipped)."""
 
-    def __init__(self, vocab: dict, merges: list, special_ids=()):
+    def __init__(self, vocab: dict, merges: list, special_ids=(),
+                 pretokenize=None):
         self.vocab = dict(vocab)                      # token str → id
         self.inverse = {i: t for t, i in self.vocab.items()}
         self.ranks = {tuple(pair): rank
                       for rank, pair in enumerate(merges)}
         self.special_ids = set(int(i) for i in special_ids)
+        self.pretokenize = pretokenize or _PRETOKENIZE
         self._b2u = byte_to_unicode()
         self._u2b = {u: b for b, u in self._b2u.items()}
 
@@ -85,7 +101,7 @@ class BPETokenizer:
 
     def encode(self, text: str) -> list:
         ids = []
-        for word in _PRETOKENIZE.findall(text):
+        for word in self.pretokenize.findall(text):
             symbols = [self._b2u[b] for b in word.encode("utf-8")]
             for symbol in self._merge_word(symbols):
                 if symbol in self.vocab:
@@ -199,4 +215,10 @@ def _load_hf_tokenizer_json(pathname: str):
         if len(pair) == 2:
             merges.append((pair[0], pair[1]))
     special = {entry["id"] for entry in spec.get("added_tokens", [])}
-    return BPETokenizer(vocab, merges, special)
+    # llama-3-family tokenizers split with the tiktoken pattern (digit
+    # groups of ≤3 etc.) — detect it from the pre_tokenizer spec so ids
+    # match what the checkpoint was trained on
+    pretokenize = None
+    if "{1,3}" in json.dumps(spec.get("pre_tokenizer", {})):
+        pretokenize = _PRETOKENIZE_LLAMA3
+    return BPETokenizer(vocab, merges, special, pretokenize=pretokenize)

@@ -190,8 +190,16 @@ class ContinuousDecoder:
                                            int(max_new_tokens), callback))
 
     def attach(self, engine, period: float = 0.002) -> int:
-        self._timer = engine.add_timer_handler(self.pump, period)
+        # idempotent: re-attaching while already pumping (e.g. a stream
+        # reopens during a deferred teardown) must not orphan the
+        # first timer
+        if self._timer is None:
+            self._timer = engine.add_timer_handler(self.pump, period)
         return self._timer
+
+    @property
+    def attached(self) -> bool:
+        return self._timer is not None
 
     def detach(self, engine) -> None:
         if self._timer is not None:

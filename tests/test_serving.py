@@ -206,7 +206,10 @@ def test_attach_runs_off_event_engine(params, engine):
                                 prefill_buckets=(16,), steps_per_sync=4)
     done = {}
     decoder.submit("r0", [7, 7, 7], 6, lambda rid, t: done.update({rid: t}))
-    decoder.attach(engine, period=0.001)
+    first = decoder.attach(engine, period=0.001)
+    # idempotent re-attach: same timer, no orphaned duplicate pump
+    assert decoder.attach(engine, period=0.001) == first
+    assert decoder.attached
     for _ in range(200):
         engine.clock.advance(0.001)
         engine.step()

@@ -107,3 +107,29 @@ def test_load_hf_tokenizer_json(tmp_path):
     import pytest
     with pytest.raises(ValueError, match="unsupported tokenizer"):
         load_tokenizer(str(tmp_path))
+
+
+def test_llama3_pretokenizer_split(tmp_path):
+    """A tokenizer.json whose pre_tokenizer carries the tiktoken digit
+    pattern gets the llama-3 split: digit runs break into ≤3-groups,
+    contractions match case-insensitively (both diverge from GPT-2)."""
+    from aiko_services_tpu.models.tokenizer import (_PRETOKENIZE,
+                                                    _PRETOKENIZE_LLAMA3)
+    assert _PRETOKENIZE_LLAMA3.findall("1234567") == ["123", "456", "7"]
+    assert _PRETOKENIZE.findall("1234567") == ["1234567"]
+    assert "'T" in _PRETOKENIZE_LLAMA3.findall("DON'T")
+    assert "'T" not in _PRETOKENIZE.findall("DON'T")
+
+    mapping = byte_to_unicode()
+    vocab = {mapping[b]: b for b in range(256)}
+    spec = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": []},
+        "pre_tokenizer": {"type": "Sequence", "pretokenizers": [
+            {"type": "Split",
+             "pattern": {"Regex": "(?i:'s|'t|'re|'ve|'m|'ll|'d)"
+                                  "|[^\\r\\n\\p{L}\\p{N}]?\\p{L}+"
+                                  "|\\p{N}{1,3}"}}]},
+    }
+    (tmp_path / "tokenizer.json").write_text(json.dumps(spec))
+    tok = load_tokenizer(str(tmp_path))
+    assert tok.pretokenize is _PRETOKENIZE_LLAMA3

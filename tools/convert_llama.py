@@ -128,8 +128,12 @@ def main() -> int:
     num_heads = args.num_heads or config_heads
     num_kv_heads = args.num_kv_heads or config_kv
     if not num_heads or not num_kv_heads:
-        parser.error("no config.json in the checkpoint directory: pass "
-                     "--num-heads/--num-kv-heads explicitly")
+        config_path = os.path.join(args.model_dir, "config.json")
+        reason = ("has no num_attention_heads/num_key_value_heads "
+                  "entries" if os.path.exists(config_path)
+                  else "does not exist")
+        parser.error(f"{config_path} {reason}: pass "
+                     f"--num-heads/--num-kv-heads explicitly")
     state = load_state_dict(args.model_dir)
     flat = convert(state, num_heads, num_kv_heads)
     os.makedirs(args.out_dir, exist_ok=True)
