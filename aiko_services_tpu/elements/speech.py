@@ -148,6 +148,22 @@ class PE_WhisperASR(PipelineElement):
         preset, _ = self.get_parameter("preset", "tiny")
         max_tokens, _ = self.get_parameter("max_tokens", 24)
         buckets, _ = self.get_parameter("buckets", [100, 500, 1000, 3000])
+        weights, _ = self.get_parameter("weights", "")
+        # long-audio buckets round up to flash-kernel geometry: the
+        # pallas path needs ctx % 128 == 0 and only wins at ctx >= 1024
+        # (ops/attention.py crossover measurements) — e.g. 3000 mel
+        # frames (ctx 1500, unfused) pad ~2% to 3072 (ctx 1536, flash).
+        # Defaults OFF when a pretrained checkpoint is loaded: its
+        # trained audio ctx (whisper: exactly 1500) must not be
+        # stretched to positions it never saw.  Parameter
+        # `flash_buckets` overrides either way.
+        from ..ops.attention import FLASH_MIN_SEQ
+        flash_buckets, _ = self.get_parameter("flash_buckets",
+                                              not weights)
+        if flash_buckets:
+            buckets = sorted({
+                b if b // 2 < FLASH_MIN_SEQ else -(-b // 256) * 256
+                for b in buckets})
         max_batch, _ = self.get_parameter("max_batch", 32)
         max_wait, _ = self.get_parameter("max_wait", 0.05)
         self.mode, _ = self.get_parameter("mode", "batched")
@@ -173,7 +189,6 @@ class PE_WhisperASR(PipelineElement):
         if tokenizer_path:
             from ..models.tokenizer import load_tokenizer
             self.detokenizer = load_tokenizer(str(tokenizer_path)).decode
-        weights, _ = self.get_parameter("weights", "")
         params = whisper_init(jax.random.PRNGKey(0), self.config)
         if weights:
             params = load_flat_npz(params, str(weights))

@@ -58,7 +58,7 @@ _PRETOKENIZE = re.compile(
 # checkpoint's training tokenization if the GPT-2 split is used
 # instead (digit runs and "DON'T" style contractions differ).
 _PRETOKENIZE_LLAMA3 = re.compile(
-    r"(?:'|’)(?i:s|t|re|ve|m|ll|d)"
+    r"'(?i:s|t|re|ve|m|ll|d)"
     r"|(?:(?![\r\n])[\W_])?[^\W\d_]+"
     r"|\d{1,3}"
     r"| ?(?:[^\s\w]|_)+[\r\n]*"

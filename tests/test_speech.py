@@ -166,3 +166,38 @@ def test_speech_pipeline_pipelined_results(make_runtime, engine, tmp_path,
     assert done, "pipelined speech frame never completed"
     assert isinstance(done[0].swag["text"], str)
     assert "time_PE_WhisperASR" in done[0].metrics
+
+
+def test_long_audio_buckets_round_to_flash_geometry(make_runtime, engine):
+    """Buckets whose audio ctx reaches FLASH_MIN_SEQ round up to a
+    multiple of 256 mel frames so the pallas flash kernel's tiling
+    constraint (ctx % 128 == 0) holds — e.g. 3000 → 3072 (ctx 1536).
+    Short buckets stay exact (padding them buys nothing).  Verified
+    live on TPU: the 30 s path dispatches flash in every layer."""
+    from aiko_services_tpu.compute import ComputeRuntime
+    from aiko_services_tpu.pipeline import (Pipeline,
+                                            parse_pipeline_definition)
+
+    runtime = make_runtime("flashb_host").initialize()
+    compute = ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_flashb", "runtime": "jax",
+        "graph": ["(PE_WhisperASR)"],
+        "parameters": {
+            "PE_WhisperASR.preset": "test",
+            "PE_WhisperASR.mode": "sync",
+            "PE_WhisperASR.max_tokens": 4,
+            "PE_WhisperASR.buckets": [100, 500, 3000],
+        },
+        "elements": [
+            {"name": "PE_WhisperASR", "input": [{"name": "mel"}],
+             "output": [{"name": "tokens"}, {"name": "text"}]},
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    pipeline.create_stream("s1", lease_time=0)
+    element = next(node.element for node in pipeline.graph.nodes()
+                   if node.name == "PE_WhisperASR")
+    element._setup()
+    program = compute.programs["whisper_asr.PE_WhisperASR"]
+    assert program.buckets.buckets == [100, 500, 3072]
