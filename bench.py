@@ -330,6 +330,7 @@ class PipelineBench:
             self.engine.remove_timer_handler(timer)
 
         drain_time = time.perf_counter() - drain_started
+        self.last_drained = drained      # retry policy: transient-or-not
         frames = self._completed - completed_before
         posted = self._posted - posted_before
         program = self.compute.programs["whisper_asr.PE_WhisperASR"]
@@ -364,9 +365,22 @@ def bench_pipeline(bench, capacity: float, drain_budget: float = 2.0):
         n = max(1, int(capacity * fraction))
         ok, p50, frames, mean_batch = bench.measure(
             n, PIPELINE_SECONDS, drain_budget=drain_budget)
-        last = (n, p50, frames, mean_batch, False)
+        if not ok and fraction <= 1.05 and bench.last_drained:
+            # transient-looking failure (backlog DID drain, just late)
+            # at a plausibly-sustainable rung: 12 s windows are short
+            # enough that one tunnel stall fails a rung the chip
+            # sustains.  A pass after a failure must be shown TWICE —
+            # a single lucky window must not set the headline.
+            print(f"rung n={n}: transient-looking failure, re-testing",
+                  file=sys.stderr)
+            ok, *_ = bench.measure(n, PIPELINE_SECONDS,
+                                   drain_budget=drain_budget)
+            if ok:
+                ok, p50, frames, mean_batch = bench.measure(
+                    n, PIPELINE_SECONDS, drain_budget=drain_budget)
         if ok:
             return n, p50, frames, mean_batch, True
+        last = (n, p50, frames, mean_batch, False)
     return last
 
 
