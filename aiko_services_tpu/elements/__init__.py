@@ -20,7 +20,8 @@ from .speech import (                                       # noqa: F401
     PE_Synthesize, PE_WhisperASR,
 )
 from .audio import (                                        # noqa: F401
-    PE_AudioFilter, PE_AudioResampler, PE_FFT, PE_Microphone,
+    PE_AudioFilter, PE_AudioResampler, PE_FFT, PE_GraphXY,
+    PE_Microphone,
     PE_MicrophoneSim, PE_RemoteReceive, PE_RemoteSend, PE_Speaker,
 )
 from .image import (                                        # noqa: F401
@@ -44,7 +45,8 @@ __all__ = [
     "PE_DataEncode", "PE_DataDecode",
     "PE_AudioFraming", "PE_AudioReadFile", "PE_AudioWriteFile",
     "PE_LogMel", "PE_Synthesize", "PE_WhisperASR",
-    "PE_AudioFilter", "PE_AudioResampler", "PE_FFT", "PE_Microphone",
+    "PE_AudioFilter", "PE_AudioResampler", "PE_FFT", "PE_GraphXY",
+    "PE_Microphone",
     "PE_MicrophoneSim", "PE_RemoteReceive", "PE_RemoteSend", "PE_Speaker",
     "PE_ImageAnnotate", "PE_ImageClassify", "PE_ImageOverlay",
     "PE_ImageReadFile", "PE_ImageResize", "PE_ImageWriteFile",

@@ -20,8 +20,9 @@ from ..utils import get_logger
 
 __all__ = [
     "PE_MicrophoneSim", "PE_Microphone", "PE_Speaker", "PE_FFT",
-    "PE_AudioFilter", "PE_AudioResampler", "PE_RemoteSend",
-    "PE_RemoteReceive", "encode_tensor", "decode_tensor",
+    "PE_GraphXY", "PE_AudioFilter", "PE_AudioResampler",
+    "PE_RemoteSend", "PE_RemoteReceive", "encode_tensor",
+    "decode_tensor",
 ]
 
 SAMPLE_RATE = 16000
@@ -188,6 +189,61 @@ class PE_FFT(PipelineElement):
         frequencies = np.fft.rfftfreq(audio.size, 1.0 / int(rate))
         return FrameOutput(True, {"frequencies": frequencies,
                                   "magnitudes": magnitudes})
+
+
+class PE_GraphXY(PipelineElement):
+    """Spectrum plot: (frequencies, magnitudes) → image [H, W, 3] uint8
+    (reference: audio_io.py PE_GraphXY renders a pygal chart into an
+    OpenCV window).  Headless-first: the raster lands in the swag so it
+    composes with PE_VideoShow / PE_VideoStreamServe / recorders;
+    parameter `display=true` additionally opens an OpenCV window when
+    cv2 is importable."""
+
+    def process_frame(self, frame: Frame, frequencies=None,
+                      magnitudes=None, **_) -> FrameOutput:
+        import numpy as np
+
+        width, _ = self.get_parameter("width", 320, frame.stream)
+        height, _ = self.get_parameter("height", 160, frame.stream)
+        display, _ = self.get_parameter("display", False, frame.stream)
+        width, height = int(width), int(height)
+        magnitudes = np.asarray(magnitudes, dtype="float32")
+        frequencies = np.asarray(frequencies, dtype="float32") \
+            if frequencies is not None else \
+            np.arange(magnitudes.size, dtype="float32")
+
+        image = np.zeros((height, width, 3), np.uint8)
+        if magnitudes.size:
+            # bin magnitudes into `width` columns by FREQUENCY (the
+            # x-axis stays honest for any upstream sample rate),
+            # log-compress, normalize to the frame max
+            top = float(frequencies[-1]) or 1.0
+            cut_hz = np.linspace(0.0, top, width + 1)[1:-1]
+            cuts = np.searchsorted(frequencies, cut_hz)
+            starts = np.concatenate(([0], cuts))
+            stops = np.concatenate((cuts, [magnitudes.size]))
+            sums = np.add.reduceat(magnitudes, starts)
+            counts = stops - starts
+            columns = np.where(counts > 0,
+                               sums / np.maximum(counts, 1), 0.0)
+            columns = np.log1p(columns)
+            peak = columns.max()
+            if peak > 0:
+                bars = (columns / peak * (height - 1)).astype(int)
+                for x, bar in enumerate(bars):
+                    if bar > 0:
+                        image[height - bar:, x] = (64, 200, 64)
+        if display:                              # pragma: no cover - UI
+            try:
+                # broad except: headless cv2 builds raise cv2.error from
+                # imshow — degrade to the swag raster, never fail the
+                # frame (same policy as PE_VideoShow)
+                import cv2
+                cv2.imshow(self.name, image[..., ::-1])
+                cv2.waitKey(1)
+            except Exception:
+                pass
+        return FrameOutput(True, {"image": image})
 
 
 class PE_AudioFilter(PipelineElement):

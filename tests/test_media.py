@@ -54,6 +54,43 @@ def test_mic_sim_fft_filter_resample(make_runtime, engine):
     assert np.argmax(bands) == 0          # 440 Hz is in the lowest band
 
 
+def test_graph_xy_renders_spectrum(make_runtime, engine):
+    """Mic → FFT → PE_GraphXY: the 440 Hz tone raster has lit bars on
+    the left (low-frequency) side and none on the right (reference:
+    audio_io.py PE_GraphXY pygal window, here a headless image)."""
+    runtime = make_runtime("plot_host").initialize()
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_plot", "runtime": "jax",
+        "graph": ["(PE_MicrophoneSim (PE_FFT (PE_GraphXY)))"],
+        "elements": [
+            element("PE_MicrophoneSim", [], ["audio"],
+                    {"chunk_seconds": 0.25, "limit": 1,
+                     "frequency": 440.0}),
+            element("PE_FFT", ["audio"], ["frequencies", "magnitudes"]),
+            element("PE_GraphXY", ["frequencies", "magnitudes"],
+                    ["image"], {"width": 64, "height": 32}),
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream("s1", lease_time=0)
+    for _ in range(40):
+        if done:
+            break
+        engine.clock.advance(0.25)
+        engine.step()
+    assert done
+    image = np.asarray(done[0].swag["image"])
+    assert image.shape == (32, 64, 3) and image.dtype == np.uint8
+    heights = (image.sum(axis=2) > 0).sum(axis=0)     # bar px per column
+    # 440 Hz of an 8 kHz band across 64 columns ≈ column 3: the tone bar
+    # towers over the sim's noise floor
+    assert heights.argmax() == 3
+    assert heights[3] >= 31                            # ~full-height peak
+    assert heights[32:].max() < heights[3] // 2        # noise stays low
+
+
 def test_remote_tensor_roundtrip(make_runtime, engine):
     """PE_RemoteSend → binary topic → PE_RemoteReceive across two logical
     processes on the shared broker (zlib+npy tensor path)."""
