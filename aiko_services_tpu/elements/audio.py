@@ -219,7 +219,10 @@ class PE_GraphXY(PipelineElement):
             # log-compress, normalize to the frame max
             top = float(frequencies[-1]) or 1.0
             cut_hz = np.linspace(0.0, top, width + 1)[1:-1]
-            cuts = np.searchsorted(frequencies, cut_hz)
+            # reduceat demands starts < size (degenerate 1-bin input
+            # would otherwise hand it index 1 of a length-1 array)
+            cuts = np.minimum(np.searchsorted(frequencies, cut_hz),
+                              magnitudes.size - 1)
             starts = np.concatenate(([0], cuts))
             stops = np.concatenate((cuts, [magnitudes.size]))
             sums = np.add.reduceat(magnitudes, starts)
@@ -233,7 +236,8 @@ class PE_GraphXY(PipelineElement):
                 for x, bar in enumerate(bars):
                     if bar > 0:
                         image[height - bar:, x] = (64, 200, 64)
-        if display:                              # pragma: no cover - UI
+        # wire parameters arrive as strings: "false" must stay false
+        if str(display).lower() == "true":       # pragma: no cover - UI
             try:
                 # broad except: headless cv2 builds raise cv2.error from
                 # imshow — degrade to the swag raster, never fail the

@@ -90,6 +90,15 @@ def test_graph_xy_renders_spectrum(make_runtime, engine):
     assert heights[3] >= 31                            # ~full-height peak
     assert heights[32:].max() < heights[3] // 2        # noise stays low
 
+    # degenerate single-bin spectrum renders (blank), not crashes
+    from aiko_services_tpu.pipeline import Frame
+    graph_xy = next(node.element for node in pipeline.graph.nodes()
+                    if node.name == "PE_GraphXY")
+    out = graph_xy.process_frame(
+        done[0], frequencies=np.array([0.0]),
+        magnitudes=np.array([5.0]))
+    assert out.ok and np.asarray(out.outputs["image"]).shape[2] == 3
+
 
 def test_remote_tensor_roundtrip(make_runtime, engine):
     """PE_RemoteSend → binary topic → PE_RemoteReceive across two logical

@@ -151,6 +151,34 @@ def test_occupancy_and_stats(params):
     assert 0.0 < decoder.mean_occupancy() <= 1.0
 
 
+def test_soak_ragged_lengths_all_match_oracle(params):
+    """20 requests, random prompts and max_new_tokens (1..9), 3 slots,
+    steps_per_sync=3: retirements land at every offset inside the scan
+    window and every slot is reused repeatedly — each result must still
+    be bit-identical to its own oracle."""
+    rng = np.random.default_rng(42)
+    decoder = ContinuousDecoder(params, CONFIG, max_slots=3,
+                                prefill_buckets=(16,), steps_per_sync=3)
+    done = {}
+    want = {}
+    for i in range(20):
+        rid = f"r{i}"
+        prompt = [int(t) for t in
+                  rng.integers(1, CONFIG.vocab, rng.integers(1, 9))]
+        max_new = int(rng.integers(1, 10))
+        want[rid] = (prompt, max_new)
+        decoder.submit(rid, prompt, max_new,
+                       lambda rid, t: done.update({rid: t}))
+    for _ in range(600):
+        decoder.pump()
+        if len(done) == 20:
+            break
+    assert len(done) == 20
+    for rid, (prompt, max_new) in want.items():
+        assert done[rid] == oracle(params, prompt, max_new), rid
+    assert decoder.idle and decoder.stats["completed"] == 20
+
+
 def test_tp_sharded_decoder_matches_oracle(params):
     """Continuous decoding with TENSOR-PARALLEL params: weights sharded
     over the model axis (heads/ffn/vocab), XLA inserting the
