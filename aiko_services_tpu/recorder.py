@@ -51,6 +51,28 @@ class Recorder(Actor):
     def topics(self) -> list[str]:
         return list(self.buffers.keys())
 
+    def persist(self, storage_topic_in: str) -> None:
+        """Write every ring durably to a Storage service (sqlite) as
+        `log/<topic>` → record list, over the standard `(put ...)` RPC —
+        the persistence the reference recorder aspired to but never
+        built (reference recorder.py ring buffers are memory-only).
+        Callable remotely: publish `(persist <storage_topic_in>)` to
+        this recorder's in topic.
+
+        Binary records (bytes from binary log topics) are persisted as
+        latin-1 text — lossless byte mapping, not a Python repr."""
+        from .actor import get_remote_proxy
+        from .storage import Storage
+
+        storage = get_remote_proxy(self.runtime, str(storage_topic_in),
+                                   Storage)
+        for topic in self.buffers.keys():
+            records = [record.decode("latin-1")
+                       if isinstance(record, bytes) else str(record)
+                       for record in self.buffers.get(topic)]
+            storage.put(f"log/{topic}", records)
+        self.ec_producer.update("persisted_topics", len(self.buffers))
+
     def stop(self) -> None:
         self.runtime.remove_message_handler(self._log_handler,
                                             self._log_filter)

@@ -114,6 +114,42 @@ def test_recorder_aggregates_log_topics(make_runtime, engine):
     assert recorder.ec_producer.get("record_count") == 5
 
 
+def test_recorder_persists_rings_to_storage(make_runtime, engine,
+                                            tmp_path):
+    """Recorder → Storage durability: rings written as log/<topic> via
+    the (put ...) RPC survive in sqlite and read back through the
+    request/response protocol."""
+    from aiko_services_tpu.storage import Storage
+
+    rec_rt = make_runtime("recp_host").initialize()
+    recorder = Recorder(rec_rt)
+    store_rt = make_runtime("storep_host").initialize()
+    storage = Storage(store_rt, database_path=str(tmp_path / "logs.db"))
+    settle(engine, 4)
+
+    log_topic = f"{rec_rt.namespace}/host/9-0/1/log"
+    for i in range(3):
+        rec_rt.publish(log_topic, f"entry {i} (weird chars)")
+    settle(engine, 8)
+
+    # remote persist: the RPC surface, not a local method call
+    rec_rt.publish(f"{recorder.topic_in}",
+                   f"(persist {storage.topic_in})")
+    settle(engine, 10)
+    assert recorder.ec_producer.get("persisted_topics") == 1
+
+    from aiko_services_tpu.storage import ResponseCollector
+    from aiko_services_tpu.utils import generate
+    got = []
+    collector = ResponseCollector(store_rt, lambda items: got.extend(items))
+    store_rt.publish(storage.topic_in,
+                     generate("get", [f"log/{log_topic}",
+                                      collector.topic]))
+    settle(engine, 10)
+    assert got and got[0] == [f"entry {i} (weird chars)"
+                              for i in range(3)]
+
+
 def test_recorder_ring_limit(make_runtime, engine):
     rt = make_runtime("rec2_host").initialize()
     recorder = Recorder(rt, ring_limit=4)
