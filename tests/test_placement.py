@@ -229,6 +229,71 @@ class TestPlacementManager:
             assert health[client_id]["reported_device_count"] == 4
             assert health[client_id]["platform"] == "cpu"
 
+    def test_pipeline_stages_on_distinct_slices(self, make_runtime,
+                                                engine):
+        """True cross-slice stage placement (SURVEY §2 PP obligation:
+        'pipeline stages on distinct TPU devices'): the ASR stage's
+        compute owns devices 0-3, the agent stage's compute owns 4-7,
+        one pipeline spans both via the per-element `compute`
+        parameter."""
+        from aiko_services_tpu.pipeline import (Pipeline,
+                                                parse_pipeline_definition)
+
+        runtime = make_runtime("stages_host").initialize()
+        pool = DevicePool()
+        slice_a = pool.allocate(4, "asr")
+        slice_b = pool.allocate(4, "agent")
+        compute_a = ComputeRuntime(runtime, "compute_asr",
+                                   mesh=slice_a.mesh())
+        compute_b = ComputeRuntime(runtime, "compute_agent",
+                                   mesh=slice_b.mesh())
+
+        definition = parse_pipeline_definition({
+            "version": 0, "name": "p_stages", "runtime": "jax",
+            "graph": ["(PE_LogMel (PE_WhisperASR (PE_LlamaAgent)))"],
+            "parameters": {
+                "PE_WhisperASR.preset": "test",
+                "PE_WhisperASR.mode": "sync",
+                "PE_WhisperASR.max_tokens": 4,
+                "PE_WhisperASR.buckets": [100],
+                "PE_WhisperASR.compute": "compute_asr",
+                "PE_LlamaAgent.preset": "tiny",
+                "PE_LlamaAgent.mode": "sync",
+                "PE_LlamaAgent.max_tokens": 4,
+                "PE_LlamaAgent.prompt_length": 16,
+                "PE_LlamaAgent.compute": "compute_agent",
+            },
+            "elements": [
+                {"name": "PE_LogMel", "input": [{"name": "audio"}],
+                 "output": [{"name": "mel"}]},
+                {"name": "PE_WhisperASR", "input": [{"name": "mel"}],
+                 "output": [{"name": "tokens"}, {"name": "text"}]},
+                {"name": "PE_LlamaAgent", "input": [{"name": "text"}],
+                 "output": [{"name": "response"},
+                            {"name": "response_tokens"}]},
+            ],
+        })
+        pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+        pipeline.create_stream("s1", lease_time=0)
+        audio = np.zeros(16000, np.float32)
+        ok, swag = pipeline.process_frame("s1", {"audio": audio})
+        assert ok
+        assert len(swag["response_tokens"]) == 4
+
+        # each stage's params live on ITS slice, not the other's
+        asr = next(n.element for n in pipeline.graph.nodes()
+                   if n.name == "PE_WhisperASR")
+        agent = next(n.element for n in pipeline.graph.nodes()
+                     if n.name == "PE_LlamaAgent")
+        asr_devices = {d.id for leaf in jax.tree.leaves(asr.params)
+                       for d in leaf.sharding.device_set}
+        agent_devices = {d.id for leaf in jax.tree.leaves(agent.params)
+                         for d in leaf.sharding.device_set}
+        assert asr_devices <= set(slice_a.device_ids)
+        assert agent_devices <= set(slice_b.device_ids)
+        assert not asr_devices & agent_devices
+        assert compute_a.programs and compute_b.programs
+
     def test_compute_runtime_publishes_device_health(
             self, make_runtime, engine):
         rt = make_runtime("health_host").initialize()

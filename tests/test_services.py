@@ -133,12 +133,11 @@ def test_recorder_persists_rings_to_storage(make_runtime, engine,
     settle(engine, 8)
 
     # remote persist: the RPC surface, not a local method call
-    rec_rt.publish(f"{recorder.topic_in}",
+    rec_rt.publish(recorder.topic_in,
                    f"(persist {storage.topic_in})")
     settle(engine, 10)
     assert recorder.ec_producer.get("persisted_topics") == 1
 
-    from aiko_services_tpu.storage import ResponseCollector
     from aiko_services_tpu.utils import generate
     got = []
     collector = ResponseCollector(store_rt, lambda items: got.extend(items))
