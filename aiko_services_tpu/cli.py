@@ -256,8 +256,17 @@ def system_stop(state_file) -> None:
     if not state:
         click.echo("nothing recorded as running")
         return
+    from .utils.configuration import pid_verified
     for name, pid in state.items():
         if _pid_alive(pid):
+            # a stale pid file can point at a recycled pid belonging to
+            # an unrelated process — only signal pids whose cmdline
+            # still matches what we spawned (the recorded name covers
+            # non-aiko children like mosquitto)
+            if not (pid_verified(pid, name) or pid_verified(pid)):
+                click.echo(f"{name}: pid {pid} alive but cmdline no "
+                           f"longer matches — likely recycled, skipped")
+                continue
             try:
                 os.kill(pid, signal.SIGTERM)
                 click.echo(f"{name}: stopped pid {pid}")

@@ -22,7 +22,7 @@ from collections import deque
 from .service import ServiceFields, ServiceTopicPath
 from .share import ECConsumer, ServicesCache
 from .utils import generate, generate_sexpr, parse
-from .utils.configuration import get_hostname
+from .utils.configuration import get_hostname, pid_verified
 from .utils.sexpr import parse_int
 
 __all__ = ["DashboardState", "run_dashboard", "register_plugin"]
@@ -189,6 +189,15 @@ class DashboardState:
         if topic_path is not None and pid is not None and \
                 topic_path.hostname == get_hostname() and \
                 pid != os.getpid():
+            # a stale table row whose pid was recycled by an unrelated
+            # process must not be SIGKILLed — only signal pids whose
+            # cmdline still looks like one of ours
+            if not pid_verified(pid):
+                self.runtime.publish(f"{fields.topic_path}/in",
+                                     "(control_stop)")
+                self.status = (f"pid {pid} not verified as aiko — "
+                               f"sent control_stop to {fields.name}")
+                return
             import signal
             try:
                 os.kill(pid, signal.SIGKILL)

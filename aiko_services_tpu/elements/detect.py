@@ -85,8 +85,10 @@ class PE_Detect(PipelineElement):
         # pad partial batches to max_batch: ONE compile per bucket
         # (same recompilation-storm guard as PE_WhisperASR); split()
         # only reads the real rows back
+        from ..utils import parse_bool
         pad_batch, _ = self.get_parameter("pad_batch",
                                           self.mode == "batched")
+        pad_batch = parse_bool(pad_batch, self.mode == "batched")
         size = self.image_size
         full = int(max_batch)
 

@@ -158,9 +158,10 @@ class PE_WhisperASR(PipelineElement):
         # stretched to positions it never saw.  Parameter
         # `flash_buckets` overrides either way.
         from ..ops.attention import FLASH_MIN_SEQ
+        from ..utils import parse_bool
         flash_buckets, _ = self.get_parameter("flash_buckets",
                                               not weights)
-        if flash_buckets:
+        if parse_bool(flash_buckets, not weights):
             buckets = sorted({
                 b if b // 2 < FLASH_MIN_SEQ else -(-b // 256) * 256
                 for b in buckets})
@@ -231,6 +232,7 @@ class PE_WhisperASR(PipelineElement):
         # serving); split() slices the real rows back out.
         pad_batch, _ = self.get_parameter("pad_batch",
                                           self.mode == "batched")
+        pad_batch = parse_bool(pad_batch, self.mode == "batched")
 
         def rows(count):
             return int(max_batch) if pad_batch else count

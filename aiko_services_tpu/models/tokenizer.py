@@ -216,9 +216,60 @@ def _load_hf_tokenizer_json(pathname: str):
             merges.append((pair[0], pair[1]))
     special = {entry["id"] for entry in spec.get("added_tokens", [])}
     # llama-3-family tokenizers split with the tiktoken pattern (digit
-    # groups of ≤3 etc.) — detect it from the pre_tokenizer spec so ids
-    # match what the checkpoint was trained on
-    pretokenize = None
-    if "{1,3}" in json.dumps(spec.get("pre_tokenizer", {})):
-        pretokenize = _PRETOKENIZE_LLAMA3
+    # groups of ≤3 etc.) — detect it STRUCTURALLY from the Split
+    # pre-tokenizer's own Regex strings (not a substring of the dumped
+    # spec) so ids match what the checkpoint was trained on
+    from ..utils import get_logger
+    logger = get_logger("models.tokenizer")
+    patterns = _split_regex_patterns(spec.get("pre_tokenizer", {}))
+    pretokenize, chosen = _choose_pretokenizer(patterns)
+    logger.info("%s: pre-tokenizer = %s", pathname, chosen)
     return BPETokenizer(vocab, merges, special, pretokenize=pretokenize)
+
+
+def _choose_pretokenizer(patterns):
+    """Best available split for the checkpoint's Split patterns:
+
+    1. the checkpoint's OWN Isolated word-split Regex compiled with
+       the `regex` module (\\p classes match tiktoken exactly) — no
+       hard-coded pattern to drift from the checkpoint;
+    2. the re approximation of the llama-3 tiktoken split when the
+       spec looks tiktoken-ish but `regex` is unavailable;
+    3. None → the GPT-2 default split.
+
+    Returns (compiled-or-None, label)."""
+    candidates = [p for p, behavior in patterns
+                  if behavior in (None, "Isolated")
+                  and r"\p{L}" in p
+                  and not re.search(r"\((?![?])", p)]  # findall needs
+    #                                  no capturing groups ^
+    if candidates:
+        try:
+            import regex
+            return (regex.compile(candidates[0]),
+                    "checkpoint-split-regex")
+        except Exception:                      # pragma: no cover
+            pass
+    if any(r"\p{N}{1," in p for p, _ in patterns):
+        return _PRETOKENIZE_LLAMA3, "llama3-tiktoken(re-approx)"
+    return None, "gpt2-default"
+
+
+def _split_regex_patterns(node) -> list:
+    """(pattern, behavior) for every Split pre-tokenizer under a HF
+    pre_tokenizer spec (handles Sequence nesting:
+    {"pretokenizers": [...]} and the flat Split form
+    {"pattern": {"Regex": "..."}, "behavior": "Isolated"})."""
+    patterns = []
+    if isinstance(node, dict):
+        pattern = node.get("pattern")
+        if isinstance(pattern, dict) and isinstance(
+                pattern.get("Regex"), str):
+            patterns.append((pattern["Regex"], node.get("behavior")))
+        for value in node.values():
+            if isinstance(value, (dict, list)):
+                patterns.extend(_split_regex_patterns(value))
+    elif isinstance(node, list):
+        for value in node:
+            patterns.extend(_split_regex_patterns(value))
+    return patterns

@@ -98,8 +98,15 @@ class DevicePool:
             if longest == 0:
                 raise RuntimeError(
                     f"pool exhausted ({self.total} devices allocated)")
-            fixed = math.prod(v for v in mesh_axes.values() if v != -1)
-            resolved = count.resolve(longest - longest % max(fixed, 1))
+            fixed = max(math.prod(
+                v for v in mesh_axes.values() if v != -1), 1)
+            if longest < fixed:
+                raise RuntimeError(
+                    f"fragmented pool: longest contiguous free run is "
+                    f"{longest} devices but the fixed axes need "
+                    f"multiples of {fixed} "
+                    f"(free={self.free}/{self.total})")
+            resolved = count.resolve(longest - longest % fixed)
         else:
             resolved = count.resolve(math.prod(mesh_axes.values()))
         need = math.prod(resolved.values())

@@ -289,6 +289,11 @@ class ContinuousDecoder:
                 self._admit(self._pending.pop(0), slot)
         active = np.array([r is not None for r in self._slots])
         if not active.any():
+            # admits can retire instantly (EOS as first token, 1-token
+            # budget, prompt at the seq cap) — the idle hook must still
+            # fire on this exit path or teardown callbacks never run
+            if self.idle and self.on_idle is not None:
+                self.on_idle()
             return
         self.stats["rounds"] += 1
         self.stats["occupancy_sum"] += float(active.mean())

@@ -1,10 +1,11 @@
 from .sexpr import (                                        # noqa: F401
     ParseError, parse, parse_sexpr, generate, generate_sexpr,
-    parse_int, parse_float, parse_number, list_to_dict, dict_to_list,
+    parse_int, parse_float, parse_number, parse_bool,
+    list_to_dict, dict_to_list,
 )
 from .graph import Graph, Node, GraphError                  # noqa: F401
 from .configuration import (                                # noqa: F401
-    get_namespace, get_hostname, get_pid, get_username,
+    get_namespace, get_hostname, get_pid, get_username, pid_verified,
     TransportConfig, get_transport_configuration,
 )
 from .logger import (                                       # noqa: F401

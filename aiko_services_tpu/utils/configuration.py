@@ -41,6 +41,28 @@ def get_pid() -> str:
     return str(os.getpid())
 
 
+def pid_verified(pid: int, marker: str = "aiko") -> bool:
+    """True when `pid` is alive AND its command line still contains
+    `marker` — guards SIGKILL paths against pid reuse by an unrelated
+    process (a stale dashboard row or pid file can outlive its
+    process).  Off-Linux (no /proc) falls back to `ps -o command=`;
+    when neither source can answer, the result is False (callers
+    degrade to a graceful stop)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\0", b" ").decode(
+                "utf-8", "replace")
+    except OSError:
+        import subprocess
+        try:
+            cmdline = subprocess.run(
+                ["ps", "-p", str(pid), "-o", "command="],
+                capture_output=True, text=True, timeout=2).stdout
+        except (OSError, subprocess.SubprocessError):
+            return False
+    return marker in cmdline
+
+
 def get_username() -> str:
     try:
         return getpass.getuser()

@@ -206,6 +206,29 @@ def parse_float(value, default=0.0) -> float:
         return default
 
 
+def parse_bool(value, default=False) -> bool:
+    """Coerce a wire-delivered parameter to bool.
+
+    S-expression parameters arrive as strings, so bare truthiness is a
+    trap: "false"/"0" are truthy Python strings.  Reference parameters
+    have the same string-over-MQTT shape
+    (reference share.py ECProducer payloads)."""
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, (int, float)):
+        return bool(value)
+    if isinstance(value, str):
+        lowered = value.strip().lower()
+        if lowered in ("true", "t", "yes", "on", "1"):
+            return True
+        if lowered in ("false", "f", "no", "off", "0", ""):
+            return False
+        return default
+    if value is None:
+        return default
+    return bool(value)
+
+
 def parse_number(value, default=0):
     """int if possible, else float, else default."""
     try:

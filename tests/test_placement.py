@@ -70,6 +70,17 @@ class TestDevicePool:
         with pytest.raises(RuntimeError, match="exhausted"):
             pool.allocate({"data": -1}, "e")
 
+    def test_wildcard_fragmented_below_fixed_axes_is_clear_error(self):
+        # longest free run (2) < fixed axes product (4): must raise a
+        # capacity error, not "no contiguous run of 0 free devices"
+        pool = DevicePool()
+        pool.allocate(3, "a")
+        pool.allocate(2, "b")
+        pool.allocate(3, "c")
+        pool.release("b")            # free hole of 2 in the middle
+        with pytest.raises(RuntimeError, match="fragmented"):
+            pool.allocate({"data": -1, "model": 4}, "d")
+
     def test_fragmentation_respects_contiguity(self):
         pool = DevicePool()
         pool.allocate(3, "a")

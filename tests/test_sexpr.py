@@ -108,6 +108,20 @@ class TestNumericHelpers:
         assert parse_number("1.5") == 1.5
         assert parse_number("nope", 0) == 0
 
+    def test_parse_bool_wire_strings(self):
+        # wire parameters arrive as strings: "false" must stay false
+        from aiko_services_tpu.utils import parse_bool
+        assert parse_bool("false") is False
+        assert parse_bool("False") is False
+        assert parse_bool("0") is False
+        assert parse_bool("") is False
+        assert parse_bool("true") is True
+        assert parse_bool("ON") is True
+        assert parse_bool(True) is True
+        assert parse_bool(0) is False
+        assert parse_bool(None, default=True) is True
+        assert parse_bool("garbage", default=True) is True
+
 
 class TestDictHelpers:
     def test_list_to_dict(self):

@@ -132,4 +132,27 @@ def test_llama3_pretokenizer_split(tmp_path):
     }
     (tmp_path / "tokenizer.json").write_text(json.dumps(spec))
     tok = load_tokenizer(str(tmp_path))
-    assert tok.pretokenize is _PRETOKENIZE_LLAMA3
+    # llama-3 split selected (exact tiktoken pattern via the `regex`
+    # module when available, else the re approximation) — either way
+    # digit runs break into ≤3-groups and contractions are
+    # case-insensitive, which the GPT-2 split gets wrong
+    assert tok.pretokenize is not _PRETOKENIZE
+    assert tok.pretokenize.findall("1234567") == ["123", "456", "7"]
+    assert "'T" in tok.pretokenize.findall("DON'T")
+
+
+def test_checkpoint_split_regex_used_verbatim(tmp_path):
+    """The checkpoint's own Split pattern is compiled directly — a
+    {1,2} digit grouping must NOT be coerced to llama-3's {1,3}."""
+    mapping = byte_to_unicode()
+    vocab = {mapping[b]: b for b in range(256)}
+    spec = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": []},
+        "pre_tokenizer": {"type": "Split", "behavior": "Isolated",
+                          "pattern": {"Regex": "\\p{L}+"
+                                               "|\\p{N}{1,2}"
+                                               "|\\s+"}},
+    }
+    (tmp_path / "tokenizer.json").write_text(json.dumps(spec))
+    tok = load_tokenizer(str(tmp_path))
+    assert tok.pretokenize.findall("1234567") == ["12", "34", "56", "7"]
