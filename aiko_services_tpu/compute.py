@@ -47,6 +47,7 @@ class CompiledProgram:
     first_call_times: dict       # bucket -> first-call wall seconds
                                  # (compile + first execution, honestly
                                  # named: the two are not separable here)
+    in_flight: dict | None = None   # pipelined: {"now": N, "peak": N}
 
 
 class ComputeRuntime(Actor):
@@ -139,7 +140,8 @@ class ComputeRuntime(Actor):
     def register_batched(self, name: str, fn, buckets,
                          collate, split, max_batch: int = 32,
                          max_wait: float = 0.05,
-                         pipelined: bool = False) -> BatchingScheduler:
+                         pipelined: bool = False,
+                         max_in_flight: int = 4) -> BatchingScheduler:
         """Register a batched program.
 
         fn(bucket, batch_arrays) -> batch_results (jit-compiled per
@@ -152,8 +154,13 @@ class ComputeRuntime(Actor):
         event queue: batch N+1's collate/upload overlaps batch N's device
         compute.  Callbacks then fire on a later event-loop turn, so
         callers must drive the engine (drain(force=True) alone does not
-        complete items).  Returns the scheduler."""
+        complete items).  max_in_flight bounds how many dispatched
+        batches may be awaiting their device sync at once (≥2 for any
+        overlap; deeper keeps uploads of rounds k+1..k+d covering round
+        k's compute+sync on thin links at the cost of per-batch latency
+        and device queue memory).  Returns the scheduler."""
         program_holder = {}
+        in_flight = {"now": 0, "peak": 0}
 
         def process_batch(bucket, items):
             payloads = [item.payload for item in items]
@@ -161,6 +168,9 @@ class ComputeRuntime(Actor):
             start = time.perf_counter()
             results = fn(bucket, batch)       # async dispatch under jit
             if pipelined:
+                in_flight["now"] += 1
+                in_flight["peak"] = max(in_flight["peak"],
+                                        in_flight["now"])
                 self._worker_submit(program_holder["program"], bucket,
                                     items, results, split, start)
                 return None                   # ownership transferred
@@ -176,11 +186,15 @@ class ComputeRuntime(Actor):
 
         if not isinstance(buckets, ShapeBuckets):
             buckets = ShapeBuckets(buckets)
+        gate = (lambda: in_flight["now"] < int(max_in_flight)) \
+            if pipelined else None
         scheduler = BatchingScheduler(process_batch, buckets,
                                       max_batch=max_batch,
                                       max_wait=max_wait,
-                                      clock=self.runtime.event.clock.now)
+                                      clock=self.runtime.event.clock.now,
+                                      dispatch_gate=gate)
         program = CompiledProgram(name, fn, buckets, scheduler, {})
+        program.in_flight = in_flight
         program_holder["program"] = program
         self.programs[name] = program
         self._timers.append(scheduler.attach(self.runtime.event,
@@ -230,6 +244,9 @@ class ComputeRuntime(Actor):
 
     def _deliver_results(self, _queue_name, job, _put_time) -> None:
         program, bucket, items, per_item, elapsed = job
+        if program.in_flight is not None:
+            program.in_flight["now"] = max(
+                0, program.in_flight["now"] - 1)
         if bucket not in program.first_call_times:
             program.first_call_times[bucket] = elapsed
             self.ec_producer.update(f"first_call.{program.name}.{bucket}",

@@ -110,10 +110,12 @@ class PE_Detect(PipelineElement):
             return out
 
         pipelined, _ = self.get_parameter("pipelined", False)
+        max_in_flight, _ = self.get_parameter("max_in_flight", 4)
         self.compute.register_batched(
             self._program, run_bucket, [self.image_size], collate, split,
             max_batch=int(max_batch), max_wait=float(max_wait),
-            pipelined=resolve_pipelined(pipelined, self.mode))
+            pipelined=resolve_pipelined(pipelined, self.mode),
+            max_in_flight=int(max_in_flight))
         self._setup_done = True
 
     def start_stream(self, stream) -> None:

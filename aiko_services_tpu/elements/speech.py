@@ -199,19 +199,26 @@ class PE_WhisperASR(PipelineElement):
         per_bucket_config = {}
 
         audio_frontend = self.frontend == "audio"
+        # audio wire format: "mulaw" ships uint8 μ-law codes (half of
+        # int16 — the host→device wire is the pipeline's bottleneck on
+        # thin links) and expands them on device; "int16" ships PCM.
+        wire, _ = self.get_parameter("wire", "mulaw")
+        wire = str(wire)
 
         def make_fn(bucket):
             import dataclasses
             config = dataclasses.replace(
                 self.config, n_audio_ctx=bucket // 2)
             if audio_frontend:
-                from ..ops.audio import log_mel_spectrogram
+                from ..ops.audio import log_mel_spectrogram, mulaw_decode
 
                 def fused(params, pcm):
-                    # audio arrives as int16 PCM (half the wire bytes of
-                    # f32; it is the native capture format) and converts
-                    # on device
-                    audio = pcm.astype(jnp.float32) / 32768.0
+                    # wire codes expand to float on device: the host
+                    # does no per-frame feature work at all
+                    if wire == "mulaw":
+                        audio = mulaw_decode(pcm)
+                    else:
+                        audio = pcm.astype(jnp.float32) / 32768.0
                     mel = log_mel_spectrogram(
                         audio, num_mels=config.n_mels)
                     return greedy_decode(params, config,
@@ -239,7 +246,17 @@ class PE_WhisperASR(PipelineElement):
 
         def collate(bucket, payloads):
             if audio_frontend:
-                from ..ops.audio import WHISPER_HOP
+                from ..ops.audio import WHISPER_HOP, mulaw_encode
+                if wire == "mulaw":
+                    # silence encodes to code 128 (μ-law zero), not 0
+                    batch = np.full((rows(len(payloads)),
+                                     bucket * WHISPER_HOP), 128,
+                                    dtype="uint8")
+                    for i, audio in enumerate(payloads):
+                        audio = np.asarray(audio)
+                        t = min(audio.shape[0], batch.shape[1])
+                        batch[i, :t] = mulaw_encode(audio[:t])
+                    return jnp.asarray(batch)
                 batch = np.zeros((rows(len(payloads)),
                                   bucket * WHISPER_HOP), dtype="int16")
                 for i, audio in enumerate(payloads):
@@ -269,10 +286,11 @@ class PE_WhisperASR(PipelineElement):
         from ..compute import resolve_pipelined
         pipelined, _ = self.get_parameter("pipelined", False)
         pipelined = resolve_pipelined(pipelined, self.mode)
+        max_in_flight, _ = self.get_parameter("max_in_flight", 4)
         self.compute.register_batched(
             self._program, run_bucket, buckets, collate, split,
             max_batch=int(max_batch), max_wait=float(max_wait),
-            pipelined=pipelined)
+            pipelined=pipelined, max_in_flight=int(max_in_flight))
         self._setup_done = True
 
     def start_stream(self, stream) -> None:

@@ -64,16 +64,22 @@ class BatchingScheduler:
 
     def __init__(self, process_batch, buckets: ShapeBuckets,
                  max_batch: int = 32, max_wait: float = 0.05,
-                 clock=time.monotonic):
+                 clock=time.monotonic, dispatch_gate=None):
         self.process_batch = process_batch
         self.buckets = buckets
         self.max_batch = max_batch
         self.max_wait = max_wait
         self.clock = clock
+        # dispatch_gate() -> bool: when False, drain stops dispatching
+        # (the pipelined path bounds how many batches are in flight on
+        # the device at once — overlap depth is explicit and tunable,
+        # not an accident of arrival timing)
+        self.dispatch_gate = dispatch_gate
         self._lock = threading.Lock()
         self._queues: dict[int, _Bucket] = {}
         self.stats = {"batches": 0, "items": 0, "batch_size_sum": 0,
-                      "full_batches": 0, "wait_sum": 0.0}
+                      "full_batches": 0, "wait_sum": 0.0,
+                      "gated": 0}
 
     def submit(self, stream_id: str, payload, length: int,
                callback) -> None:
@@ -135,6 +141,12 @@ class BatchingScheduler:
                                 if b.items]
                     bucket_key = nonempty[0] if nonempty else None
                 if bucket_key is None:
+                    return processed
+                # force (teardown) bypasses the gate: every queued item
+                # must reach its callback even over-depth
+                if not force and self.dispatch_gate is not None and \
+                        not self.dispatch_gate():
+                    self.stats["gated"] += 1
                     return processed
                 queue = self._queues[bucket_key].items
                 batch = [queue.popleft()

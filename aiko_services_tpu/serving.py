@@ -26,6 +26,7 @@
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable
 
 import jax
@@ -174,7 +175,8 @@ class ContinuousDecoder:
         self._pending: list[DecodeRequest] = []
         self._timer = None
         self.stats = {"steps": 0, "rounds": 0, "completed": 0,
-                      "prefills": 0, "occupancy_sum": 0.0}
+                      "prefills": 0, "occupancy_sum": 0.0,
+                      "prefill_s": 0.0, "decode_s": 0.0}
 
     # -- public API --------------------------------------------------------
     def submit(self, request_id: str, prompt, max_new_tokens: int,
@@ -221,45 +223,111 @@ class ContinuousDecoder:
                 return bucket
         return self.prefill_buckets[-1]
 
-    def _prefill_fn(self, bucket: int):
-        """Compiled once per bucket: padded prompt → (first token,
-        per-layer K/V rows [1, H, bucket, D])."""
-        if bucket in self._prefill_fns:
-            return self._prefill_fns[bucket]
+    def _admit_fn(self, bucket: int, width: int):
+        """Compiled once per (bucket, admit-width): ONE program runs the
+        stacked prefill for up to `width` prompts AND scatters their
+        K/V prefixes, first tokens, and lengths into the slot buffers
+        on device.  The host syncs a single [width] token array per
+        group — not one round-trip per request (the per-request admit
+        was a throughput cliff under bursty arrivals on thin links)."""
+        key = (bucket, width)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
         from .models.llama import init_llama_caches, llama_decode_step
 
-        def prefill(params, prompt, true_len):
-            caches = init_llama_caches(self.config, 1, bucket)
+        def admit(params, k_caches, v_caches, tokens, lengths,
+                  prompts, true_lens, slots, valid):
+            # prompts: [A, bucket]; slots: [A] DISTINCT slot ids (pad
+            # rows point at other distinct slots and write back their
+            # own current content — a no-op); valid: [A] bool.
+            caches = init_llama_caches(self.config, width, bucket)
             logits, caches = llama_decode_step(params, self.config,
-                                               prompt, caches)
-            first = jnp.argmax(logits[0, true_len - 1], axis=-1)
-            return (first.astype(jnp.int32),
-                    [c["k"] for c in caches], [c["v"] for c in caches])
+                                               prompts, caches)
+            idx = jnp.maximum(true_lens - 1, 0)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]
+            firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            mask = valid[:, None, None, None]
+            for i, cache in enumerate(caches):
+                cur_k = k_caches[i][slots][:, :, :bucket]
+                cur_v = v_caches[i][slots][:, :, :bucket]
+                k_caches[i] = k_caches[i].at[slots, :, :bucket].set(
+                    jnp.where(mask, cache["k"], cur_k))
+                v_caches[i] = v_caches[i].at[slots, :, :bucket].set(
+                    jnp.where(mask, cache["v"], cur_v))
+            tokens = tokens.at[slots].set(
+                jnp.where(valid, firsts, tokens[slots]))
+            lengths = lengths.at[slots].set(
+                jnp.where(valid, true_lens, lengths[slots]))
+            return firsts, k_caches, v_caches, tokens, lengths
 
-        compiled = jax.jit(prefill)
-        self._prefill_fns[bucket] = compiled
+        compiled = jax.jit(
+            admit, donate_argnames=("k_caches", "v_caches", "tokens",
+                                    "lengths"))
+        self._prefill_fns[key] = compiled
         return compiled
 
-    def _admit(self, request: DecodeRequest, slot: int) -> None:
-        bucket = self._bucket_for(len(request.prompt))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(request.prompt)] = request.prompt
-        first, k_rows, v_rows = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(padded), len(request.prompt))
-        # scatter the prefix into the slot's cache rows (beyond
-        # true_len the rows are garbage — masked by the slot length)
-        for i in range(self.config.num_layers):
-            self._k[i] = self._k[i].at[slot, :, :bucket].set(k_rows[i][0])
-            self._v[i] = self._v[i].at[slot, :, :bucket].set(v_rows[i][0])
-        first_token = int(first)
-        self._tokens = self._tokens.at[slot].set(first_token)
-        self._lengths = self._lengths.at[slot].set(len(request.prompt))
-        request.slot = slot
-        request.generated = [first_token]
-        self._slots[slot] = request
-        self.stats["prefills"] += 1
-        if self._finished(request, first_token):
-            self._retire(slot)
+    @staticmethod
+    def _next_pow2(n: int) -> int:
+        return 1 << max(0, (n - 1).bit_length())
+
+    def _admit_pending(self) -> None:
+        """Admit as many pending requests as there are free slots, in
+        bucket groups: one stacked prefill + device-side scatter + one
+        host sync per group."""
+        free = [s for s in range(self.max_slots)
+                if self._slots[s] is None]
+        if not free or not self._pending:
+            return
+        take = self._pending[:len(free)]
+        del self._pending[:len(take)]
+        groups: dict[int, list[DecodeRequest]] = {}
+        for request in take:
+            groups.setdefault(self._bucket_for(len(request.prompt)),
+                              []).append(request)
+        start = time.perf_counter()
+        for bucket, requests in groups.items():
+            while requests:
+                width = min(self.max_slots,
+                            self._next_pow2(len(requests)))
+                chunk, requests = requests[:width], requests[width:]
+                self._admit_group(bucket, width, chunk, free)
+        self.stats["prefill_s"] += time.perf_counter() - start
+
+    def _admit_group(self, bucket: int, width: int,
+                     chunk: list, free: list) -> None:
+        n = len(chunk)
+        slots = [free.pop(0) for _ in range(n)]
+        # pad rows need DISTINCT slot ids (scatter order is unspecified
+        # on collision): remaining free slots first, then occupied ones
+        # — either way the pad row rewrites that slot's own content
+        used = set(slots)
+        spare = [s for s in range(self.max_slots) if s not in used]
+        pad_slots = spare[:width - n]
+        prompts = np.zeros((width, bucket), np.int32)
+        true_lens = np.zeros((width,), np.int32)
+        valid = np.zeros((width,), bool)
+        for j, request in enumerate(chunk):
+            prompts[j, :len(request.prompt)] = request.prompt
+            true_lens[j] = len(request.prompt)
+            valid[j] = True
+        firsts, self._k, self._v, self._tokens, self._lengths = \
+            self._admit_fn(bucket, width)(
+                self.params, self._k, self._v, self._tokens,
+                self._lengths, jnp.asarray(prompts),
+                jnp.asarray(true_lens),
+                jnp.asarray(slots + pad_slots, jnp.int32),
+                jnp.asarray(valid))
+        firsts = np.asarray(firsts)           # ONE sync per group
+        for j, request in enumerate(chunk):
+            slot = slots[j]
+            first_token = int(firsts[j])
+            request.slot = slot
+            request.generated = [first_token]
+            self._slots[slot] = request
+            self.stats["prefills"] += 1
+            if self._finished(request, first_token):
+                self._retire(slot)
 
     def _finished(self, request: DecodeRequest, token: int) -> bool:
         return (self.eos_token is not None and token == self.eos_token) \
@@ -283,10 +351,7 @@ class ContinuousDecoder:
 
     def pump(self) -> None:
         """One scheduling round: admit, decode K steps, retire."""
-        # admit pending into free slots
-        for slot in range(self.max_slots):
-            if self._slots[slot] is None and self._pending:
-                self._admit(self._pending.pop(0), slot)
+        self._admit_pending()
         active = np.array([r is not None for r in self._slots])
         if not active.any():
             # admits can retire instantly (EOS as first token, 1-token
@@ -297,11 +362,13 @@ class ContinuousDecoder:
             return
         self.stats["rounds"] += 1
         self.stats["occupancy_sum"] += float(active.mean())
+        decode_start = time.perf_counter()
         emitted, self._tokens, self._lengths, self._k, self._v = \
             self._step(self._tokens, self._lengths, jnp.asarray(active),
                        self._k, self._v, num_steps=self.steps_per_sync)
         self.stats["steps"] += self.steps_per_sync
         emitted = np.asarray(emitted)            # [K, S] host sync
+        self.stats["decode_s"] += time.perf_counter() - decode_start
         for k in range(emitted.shape[0]):
             for slot in range(self.max_slots):
                 request = self._slots[slot]

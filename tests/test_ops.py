@@ -49,6 +49,31 @@ def test_log_mel_jits():
 
 # -- batching ----------------------------------------------------------------
 
+def test_mulaw_roundtrip_snr():
+    """8-bit μ-law wire: encode (host) → decode (device) must keep
+    speech-band SNR ≥ 30 dB, and int16 input must agree with float."""
+    from aiko_services_tpu.ops.audio import mulaw_decode, mulaw_encode
+
+    rng = np.random.default_rng(3)
+    t = np.arange(16000) / 16000.0
+    speech = (0.3 * np.sin(2 * np.pi * 220 * t) +
+              0.1 * np.sin(2 * np.pi * 660 * t) +
+              0.02 * rng.standard_normal(16000)).astype(np.float32)
+    codes = mulaw_encode(speech)
+    assert codes.dtype == np.uint8
+    decoded = np.asarray(mulaw_decode(jnp.asarray(codes)))
+    noise = decoded - np.clip(speech, -1, 1)
+    snr_db = 10 * np.log10(np.mean(speech ** 2) / np.mean(noise ** 2))
+    assert snr_db >= 30.0, f"μ-law SNR {snr_db:.1f} dB"
+    # int16 PCM input takes the same path as float
+    pcm = np.clip(speech * 32767.0, -32768, 32767).astype(np.int16)
+    assert np.array_equal(mulaw_encode(pcm), codes) or \
+        np.max(np.abs(mulaw_encode(pcm).astype(int) -
+                      codes.astype(int))) <= 1
+    # silence is the mid code (the collate pad value)
+    assert mulaw_encode(np.zeros(4, np.float32)).tolist() == [128] * 4
+
+
 def test_shape_buckets():
     buckets = ShapeBuckets([100, 500, 1500])
     assert buckets.bucket_for(1) == 100
@@ -64,6 +89,33 @@ class FakeClock:
 
     def __call__(self):
         return self.now
+
+
+def test_dispatch_gate_bounds_in_flight():
+    """A closed gate stops dispatch (bounded overlap depth); force
+    drain bypasses it so teardown always flushes."""
+    clock = FakeClock()
+    open_gate = [True]
+    calls = []
+
+    def process(bucket, items):
+        calls.append(len(items))
+        return [i.payload for i in items]
+
+    sched = BatchingScheduler(process, ShapeBuckets([100]), max_batch=2,
+                              max_wait=0.0, clock=clock,
+                              dispatch_gate=lambda: open_gate[0])
+    for i in range(6):
+        sched.submit(f"s{i}", i, 50, lambda sid, r: None)
+    open_gate[0] = False
+    assert sched.drain() == 0                  # gated: nothing moves
+    assert sched.stats["gated"] == 1
+    assert sched.pending() == 6
+    open_gate[0] = True
+    assert sched.drain() == 6                  # gate open: all flow
+    open_gate[0] = False
+    sched.submit("s9", 9, 50, lambda sid, r: None)
+    assert sched.drain(force=True) == 1        # teardown bypasses gate
 
 
 def test_batch_dispatches_when_full():
