@@ -34,7 +34,7 @@ from .video import (                                        # noqa: F401
 )
 from .video_stream import (                                 # noqa: F401
     MJPEGStreamServer, PE_VideoStreamRead, PE_VideoStreamServe,
-    PE_VideoUDPReceive, PE_VideoUDPSend,
+    PE_VideoStreamWrite, PE_VideoUDPReceive, PE_VideoUDPSend,
 )
 from .detect import PE_Detect, PE_LlamaAgent                # noqa: F401
 from .tts import PE_NeuralTTS                               # noqa: F401
@@ -51,7 +51,7 @@ __all__ = [
     "PE_ImageAnnotate", "PE_ImageClassify", "PE_ImageOverlay",
     "PE_ImageReadFile", "PE_ImageResize", "PE_ImageWriteFile",
     "MJPEGStreamServer", "PE_VideoStreamRead", "PE_VideoStreamServe",
-    "PE_VideoUDPReceive", "PE_VideoUDPSend",
+    "PE_VideoStreamWrite", "PE_VideoUDPReceive", "PE_VideoUDPSend",
     "PE_Tracker", "PE_VideoCameraRead", "PE_VideoReadFile", "PE_VideoShow",
     "PE_VideoWriteFile",
     "PE_Detect", "PE_LlamaAgent", "PE_NeuralTTS",

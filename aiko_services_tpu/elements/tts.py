@@ -37,6 +37,7 @@ class PE_NeuralTTS(PipelineElement):
 
         from ..models.tokenizer import ByteTokenizer, load_tokenizer
         from ..models.tts import TTS_PRESETS, tts_axes, tts_init, synthesize
+        from ..ops.audio import WHISPER_HOP
 
         preset, _ = self.get_parameter("preset", "test")
         weights, _ = self.get_parameter("weights", "")
@@ -81,8 +82,13 @@ class PE_NeuralTTS(PipelineElement):
             return jnp.asarray(batch)
 
         def split(results, count):
-            audio = np.asarray(results, dtype=np.float32)
-            return [audio[i] for i in range(count)]
+            # trim each row to its predicted duration: the static tail
+            # past the regulator's total synthesizes silence-garbage
+            audio_batch, samples = results
+            audio_batch = np.asarray(audio_batch, dtype=np.float32)
+            samples = np.asarray(samples)
+            return [audio_batch[i, :max(int(samples[i]), WHISPER_HOP)]
+                    for i in range(count)]
 
         from ..compute import resolve_pipelined
         pipelined, _ = self.get_parameter("pipelined", False)
@@ -95,13 +101,6 @@ class PE_NeuralTTS(PipelineElement):
 
     def start_stream(self, stream) -> None:
         self._setup()
-
-    def _trim(self, audio, n_tokens: int):
-        """Drop synthesis of the pad tail: the model was never trained on
-        pad-token frames (they synthesize artifacts)."""
-        from ..ops.audio import WHISPER_HOP
-        samples = n_tokens * self.config.frames_per_token * WHISPER_HOP
-        return audio[:samples]
 
     def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
         self._setup()
@@ -121,12 +120,12 @@ class PE_NeuralTTS(PipelineElement):
             if isinstance(result, Exception):
                 return FrameOutput(False, diagnostic=repr(result))
             return FrameOutput(True, {
-                "audio": self._trim(result, len(ids)),
+                "audio": result,
                 "sample_rate": WHISPER_SAMPLE_RATE})
 
         def callback(_sid, result):
             outputs = result if isinstance(result, Exception) else \
-                {"audio": self._trim(result, len(ids)),
+                {"audio": result,
                  "sample_rate": WHISPER_SAMPLE_RATE}
             self.pipeline.post("resume_frame", frame,
                                self.definition.name, outputs)

@@ -32,6 +32,7 @@ from ..pipeline import Frame, FrameOutput, PipelineElement
 from ..utils import get_logger
 
 __all__ = ["PE_VideoStreamRead", "PE_VideoStreamServe", "MJPEGStreamServer",
+           "PE_VideoStreamWrite",
            "PE_VideoUDPSend", "PE_VideoUDPReceive", "encode_jpeg",
            "decode_jpeg"]
 
@@ -78,7 +79,8 @@ class PE_VideoStreamRead(PipelineElement):
         backoff_limit, _ = self.get_parameter("backoff_limit", 8.0, stream)
         logger = get_logger(f"videostream.{self.name}")
         state = {"latest": None, "stop": False, "connected": False,
-                 "reconnects": -1}       # first connect isn't a reconnect
+                 "reconnects": -1,       # first connect isn't a reconnect
+                 "lock": threading.Lock()}
         stream.variables[f"{self.definition.name}.state"] = state
 
         def capture_loop():
@@ -102,7 +104,8 @@ class PE_VideoStreamRead(PipelineElement):
                     ok, bgr = capture.read()
                     if not ok:
                         break                    # EOF / connection lost
-                    state["latest"] = bgr[:, :, ::-1]
+                    with state["lock"]:
+                        state["latest"] = bgr[:, :, ::-1]
                 capture.release()
                 state["connected"] = False
 
@@ -111,9 +114,12 @@ class PE_VideoStreamRead(PipelineElement):
         state["thread"].start()
 
         def tick():
-            latest = state["latest"]
-            if latest is not None:
+            # locked read-and-clear: a frame stored between an unlocked
+            # read and the clear would be silently dropped
+            with state["lock"]:
+                latest = state["latest"]
                 state["latest"] = None           # emit each frame once
+            if latest is not None:
                 self.create_frame(stream, {"image": latest})
 
         state["timer"] = self.runtime.event.add_timer_handler(
@@ -224,6 +230,124 @@ class PE_VideoStreamServe(PipelineElement):
         quality = frame.stream.variables[f"{self.definition.name}.quality"]
         server.publish(encode_jpeg(image, quality))
         return FrameOutput(True, {})
+
+
+class PE_VideoStreamWrite(PipelineElement):
+    """H.264 egress sink (reference parity:
+    gstreamer/video_stream_writer.py:27-80, the x264 RTP/RTMP leg, with
+    the reference's zerolatency tuning from gstreamer/utilities.py:34-36).
+
+    Parameter `url` decides the transport:
+      * file targets (*.mp4, *.mkv, *.avi) → cv2.VideoWriter via the
+        FFMPEG backend, fourcc parameter (default "avc1" = H.264,
+        falling back per `fourcc_fallback`, default "mp4v");
+      * rtsp:// rtmp:// udp:// → an ffmpeg subprocess fed raw RGB on
+        stdin encoding libx264 `-preset ultrafast -tune zerolatency`
+        (OpenCV's writer cannot push network streams).
+    The first frame fixes the stream geometry; fps via parameter `fps`.
+    The EC share reports `write_url` and `write_backend`."""
+
+    def start_stream(self, stream) -> None:
+        stream.variables[f"{self.definition.name}.state"] = {
+            "writer": None, "proc": None, "size": None,
+            "frames_written": 0}
+
+    def _open(self, stream, width: int, height: int) -> dict:
+        state = stream.variables[f"{self.definition.name}.state"]
+        url, found = self.get_parameter("url", stream=stream)
+        if not found:
+            raise ValueError(f"{self.name}: no url parameter")
+        url = str(url)
+        fps, _ = self.get_parameter("fps", 20.0, stream)
+        fps = float(fps)
+        logger = get_logger(f"videowrite.{self.name}")
+        if url.split("://", 1)[0] in ("rtsp", "rtmp", "udp", "tcp"):
+            import subprocess
+            sink = {"rtsp": ["-f", "rtsp", "-rtsp_transport", "tcp"],
+                    "rtmp": ["-f", "flv"],
+                    "udp": ["-f", "mpegts"],
+                    "tcp": ["-f", "mpegts"]}[url.split("://", 1)[0]]
+            command = [
+                "ffmpeg", "-loglevel", "error", "-f", "rawvideo",
+                "-pix_fmt", "rgb24", "-s", f"{width}x{height}",
+                "-r", f"{fps}", "-i", "-",
+                "-c:v", "libx264", "-preset", "ultrafast",
+                "-tune", "zerolatency", "-pix_fmt", "yuv420p",
+                *sink, url]
+            state["proc"] = subprocess.Popen(
+                command, stdin=subprocess.PIPE,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            backend = "ffmpeg-libx264"
+        else:
+            import cv2
+            fourcc, _ = self.get_parameter("fourcc", "avc1", stream)
+            fallback, _ = self.get_parameter("fourcc_fallback", "mp4v",
+                                             stream)
+            writer = cv2.VideoWriter(
+                url, cv2.VideoWriter_fourcc(*str(fourcc)), fps,
+                (width, height))
+            backend = f"cv2-{fourcc}"
+            if not writer.isOpened():
+                writer.release()
+                writer = cv2.VideoWriter(
+                    url, cv2.VideoWriter_fourcc(*str(fallback)), fps,
+                    (width, height))
+                backend = f"cv2-{fallback}"
+                logger.warning("%s: fourcc %s unavailable, using %s",
+                               self.name, fourcc, fallback)
+            if not writer.isOpened():
+                raise RuntimeError(f"{self.name}: cannot open {url}")
+            state["writer"] = writer
+        # size set LAST: a failed open must leave the state un-poisoned
+        # so the next frame reports the real error (and can retry)
+        state["size"] = (width, height)
+        self.ec_producer.update("write_url", url)
+        self.ec_producer.update("write_backend", backend)
+        return state
+
+    def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
+        import numpy as np
+
+        rgb = np.ascontiguousarray(np.asarray(image).astype("uint8"))
+        state = frame.stream.variables[f"{self.definition.name}.state"]
+        if state["size"] is None:
+            try:
+                state = self._open(frame.stream, rgb.shape[1],
+                                   rgb.shape[0])
+            except Exception as exc:
+                return FrameOutput(False,
+                                   diagnostic=f"egress open: {exc!r}")
+        if (rgb.shape[1], rgb.shape[0]) != state["size"]:
+            return FrameOutput(False, diagnostic=(
+                f"frame {rgb.shape[1]}x{rgb.shape[0]} != stream "
+                f"{state['size'][0]}x{state['size'][1]}"))
+        if state["proc"] is not None:
+            if state["proc"].poll() is not None:
+                return FrameOutput(False,
+                                   diagnostic="ffmpeg egress died")
+            try:
+                state["proc"].stdin.write(rgb.tobytes())
+            except BrokenPipeError:
+                return FrameOutput(False,
+                                   diagnostic="ffmpeg egress pipe broke")
+        else:
+            state["writer"].write(rgb[:, :, ::-1])       # RGB → BGR
+        state["frames_written"] += 1
+        return FrameOutput(True, {})
+
+    def stop_stream(self, stream) -> None:
+        state = stream.variables.get(f"{self.definition.name}.state")
+        if not state:
+            return
+        if state.get("writer") is not None:
+            state["writer"].release()
+        proc = state.get("proc")
+        if proc is not None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=10.0)
+            except Exception:
+                proc.kill()
 
 
 # -- JPEG over UDP -----------------------------------------------------------

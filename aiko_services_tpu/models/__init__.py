@@ -25,5 +25,6 @@ from .tokenizer import (                                    # noqa: F401
 )
 from .tts import (                                          # noqa: F401
     TTSConfig, TTS_PRESETS, tts_init, tts_axes, tts_forward, synthesize,
+    predict_durations, regulate,
 )
 from . import layers                                        # noqa: F401

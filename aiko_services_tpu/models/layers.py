@@ -117,9 +117,18 @@ def conv1d_init(key, in_ch: int, out_ch: int, kernel: int,
             "b": jnp.zeros((out_ch,), dtype)}
 
 
-def conv1d(params, x, stride: int = 1, padding: str = "SAME"):
+def conv1d(params, x, stride: int = 1, padding=None):
     """x: [B, T, C_in] → [B, T', C_out] (maps onto the MXU as a matmul
-    over the unrolled kernel window)."""
+    over the unrolled kernel window).
+
+    Default padding is SYMMETRIC (k-1)//2 both sides — torch Conv1d's
+    `padding=k//2` convention, which whisper checkpoints are trained
+    under.  XLA's "SAME" pads asymmetrically under stride>1 (left 0 /
+    right 1 for k=3, s=2), silently shifting every strided frame by one
+    sample relative to the checkpoint."""
+    if padding is None:
+        half = (params["w"].shape[0] - 1) // 2
+        padding = [(half, half)]
     y = jax.lax.conv_general_dilated(
         x, params["w"], window_strides=(stride,), padding=padding,
         dimension_numbers=("NWC", "WIO", "NWC"),
@@ -289,4 +298,7 @@ def apply_rope(x, cos, sin, position_offset=0):
 
 
 def gelu(x):
-    return jax.nn.gelu(x, approximate=True)
+    # exact (erf) gelu: what whisper/HF "gelu" checkpoints are trained
+    # under — the tanh approximation drifts logits by ~5e-3, enough to
+    # flip near-tie argmax decodes on real weights
+    return jax.nn.gelu(x, approximate=False)

@@ -126,10 +126,13 @@ def _swiglu(layer, x):
                     L.linear(layer["up"], x))
 
 
-def llama_decode_step(params, config: LlamaConfig, tokens, caches,
-                      position_offset=0):
-    """tokens: [B, T] → (logits [B, T, vocab], new_caches).  T=1 for
-    incremental decode; T>1 prefills with an in-step causal mask."""
+def llama_hidden(params, config: LlamaConfig, tokens, caches,
+                 position_offset=0):
+    """tokens: [B, T] → (final hidden states [B, T, dim], new_caches).
+    T=1 for incremental decode; T>1 prefills with an in-step causal
+    mask.  Split from the lm_head so prefill callers can select the
+    position(s) they need BEFORE the vocab projection — full-sequence
+    prefill logits are [B, T, vocab] (gigabytes at serving widths)."""
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
     x = L.embedding(params["embed"], tokens).astype(config.dtype)
@@ -149,7 +152,14 @@ def llama_decode_step(params, config: LlamaConfig, tokens, caches,
         x = x + attn_out
         x = x + _swiglu(layer, L.rms_norm(layer["ln_mlp"], x))
         new_caches.append(cache)
-    x = L.rms_norm(params["ln_out"], x)
+    return L.rms_norm(params["ln_out"], x), new_caches
+
+
+def llama_decode_step(params, config: LlamaConfig, tokens, caches,
+                      position_offset=0):
+    """tokens: [B, T] → (logits [B, T, vocab], new_caches)."""
+    x, new_caches = llama_hidden(params, config, tokens, caches,
+                                 position_offset)
     logits = L.linear(params["lm_head"], x.astype(jnp.float32))
     return logits, new_caches
 

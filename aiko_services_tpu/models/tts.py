@@ -4,11 +4,18 @@
 # Capability parity target: the reference's TTS element wraps Coqui VITS
 # on the host (reference: examples/speech/speech_elements.py:96-131).
 # Here the acoustic model is a jax conv-transformer: byte/BPE tokens →
-# hidden states → fixed-factor upsample → log-mel frames, all static
-# shapes so batched synthesis jits onto the MXU alongside the ASR
-# programs; mel → waveform is mel_to_linear + griffin_lim (deterministic,
-# weight-free).  Weights load via the same flat-npz scheme as whisper
-# (elements/speech.py load_flat_npz), so a trained checkpoint drops in.
+# hidden states → LEARNED duration predictor → static-shape length
+# regulation → log-mel frames, all static shapes so batched synthesis
+# jits onto the MXU alongside the ASR programs; mel → waveform is
+# mel_to_linear + griffin_lim (deterministic, weight-free).  Weights
+# load via the same flat-npz scheme as whisper (elements/speech.py
+# load_flat_npz), so a trained checkpoint drops in.
+#
+# TPU-first length regulation: predicted per-token durations expand to
+# frames through a [T_max, S] alignment built from cumsum boundaries —
+# pure vectorized comparisons, one compile per geometry, no
+# data-dependent shapes (FastSpeech trains the duration head
+# supervised, so the hard alignment needs no gradient through d).
 
 from __future__ import annotations
 
@@ -20,7 +27,7 @@ import jax.numpy as jnp
 from . import layers as L
 
 __all__ = ["TTSConfig", "TTS_PRESETS", "tts_init", "tts_axes",
-           "tts_forward", "synthesize"]
+           "tts_forward", "predict_durations", "regulate", "synthesize"]
 
 
 @dataclass(frozen=True)
@@ -30,14 +37,15 @@ class TTSConfig:
     num_layers: int = 4
     num_heads: int = 4
     n_mels: int = 80
-    frames_per_token: int = 8     # fixed-length regulator (~12 chars/s)
+    frames_per_token: int = 8     # duration PRIOR (mean log-d bias)
     max_tokens: int = 128
+    max_frames: int = 1024        # static regulator output length
     dtype: object = jnp.float32
 
 
 TTS_PRESETS = {
     "test": TTSConfig(dim=64, num_layers=2, num_heads=4,
-                      frames_per_token=6, max_tokens=32),
+                      frames_per_token=6, max_tokens=32, max_frames=96),
     "base": TTSConfig(),
 }
 
@@ -65,14 +73,18 @@ def _block_axes():
 
 
 def tts_init(key, config: TTSConfig):
-    keys = jax.random.split(key, config.num_layers + 3)
+    keys = jax.random.split(key, config.num_layers + 4)
     return {
         "embed": L.embedding_init(keys[0], config.vocab, config.dim,
                                   config.dtype),
         "blocks": [_block_init(keys[i + 1], config)
                    for i in range(config.num_layers)],
         "ln_out": L.layer_norm_init(config.dim, config.dtype),
-        "mel_head": L.linear_init(keys[-1], config.dim, config.n_mels,
+        "mel_head": L.linear_init(keys[-2], config.dim, config.n_mels,
+                                  dtype=config.dtype),
+        # predicts log-duration per token (FastSpeech-style, trained
+        # supervised against ground-truth alignments)
+        "dur_head": L.linear_init(keys[-1], config.dim, 1,
                                   dtype=config.dtype),
     }
 
@@ -83,12 +95,11 @@ def tts_axes(config: TTSConfig):
         "blocks": [_block_axes()] * config.num_layers,
         "ln_out": L.layer_norm_axes(),
         "mel_head": L.linear_axes("embed", None),
+        "dur_head": L.linear_axes("embed", None),
     }
 
 
-def tts_forward(params, config: TTSConfig, tokens):
-    """tokens: [B, S] int32 (pad with 0) →
-    log-mel [B, S * frames_per_token, n_mels] (whisper-normalized)."""
+def _encode(params, config: TTSConfig, tokens):
     x = L.embedding(params["embed"], tokens).astype(config.dtype)
     positions = L.sinusoid_position_encoding(tokens.shape[1], config.dim)
     x = x + positions[None].astype(x.dtype)
@@ -100,19 +111,66 @@ def tts_forward(params, config: TTSConfig, tokens):
         x = x + L.linear(block["mlp_out"], L.gelu(
             L.linear(block["mlp_in"],
                      L.layer_norm(block["ln_mlp"], x))))
-    x = L.layer_norm(params["ln_out"], x)
-    # length regulator: every token expands to frames_per_token frames
-    # (static-shape stand-in for a duration predictor — XLA-friendly)
-    x = jnp.repeat(x, config.frames_per_token, axis=1)
-    return L.linear(params["mel_head"], x)
+    return L.layer_norm(params["ln_out"], x)
+
+
+def _durations_from_hidden(params, config: TTSConfig, tokens, hidden):
+    """(log-durations [B, S], durations [B, S] with pad tokens at 0).
+    The frames_per_token prior is the head's log bias, so an untrained
+    head regulates near the old fixed factor."""
+    log_d = L.linear(params["dur_head"], hidden)[..., 0] + \
+        jnp.log(float(config.frames_per_token))
+    return log_d, jnp.where(tokens > 0, jnp.exp(log_d), 0.0)
+
+
+def predict_durations(params, config: TTSConfig, tokens):
+    """tokens [B, S] → (log-durations, durations) — see
+    _durations_from_hidden."""
+    hidden = _encode(params, config, tokens)
+    return _durations_from_hidden(params, config, tokens, hidden)
+
+
+def regulate(hidden, durations, max_frames: int):
+    """Static-shape length regulation: token i owns frames
+    [cumsum_{<i}, cumsum_{<=i}); frame t gathers its owner via a
+    [T, S] boundary comparison — no dynamic shapes, one compile per
+    geometry."""
+    ends = jnp.cumsum(durations, axis=1)                  # [B, S]
+    starts = ends - durations
+    t = jnp.arange(max_frames, dtype=durations.dtype)[None, :, None]
+    owner = ((t >= starts[:, None, :]) &
+             (t < ends[:, None, :])).astype(hidden.dtype)  # [B, T, S]
+    return owner @ hidden, ends[:, -1]
+
+
+def tts_forward(params, config: TTSConfig, tokens, durations=None):
+    """tokens: [B, S] int32 (pad with 0) →
+    (log-mel [B, max_frames, n_mels], total frames [B]).
+
+    durations=None predicts them (inference); training passes
+    ground-truth durations (teacher forcing) so the mel loss does not
+    need a gradient through the hard alignment."""
+    hidden = _encode(params, config, tokens)
+    if durations is None:
+        _, durations = _durations_from_hidden(params, config, tokens,
+                                              hidden)
+    frames, total = regulate(hidden, durations.astype(jnp.float32),
+                             config.max_frames)
+    return L.linear(params["mel_head"], frames), total
 
 
 def synthesize(params, config: TTSConfig, tokens, n_iter: int = 32):
-    """tokens → waveform [B, samples] via mel → linear → Griffin-Lim.
-    One jittable program: batched synthesis runs on device end-to-end."""
-    from ..ops.audio import griffin_lim, mel_to_linear
+    """tokens → (waveform [B, samples], voiced sample counts [B]) via
+    predicted durations → mel → linear → Griffin-Lim.  One jittable
+    program: batched synthesis runs on device end-to-end; callers trim
+    each row to its sample count (the static tail past the predicted
+    length synthesizes silence-garbage)."""
+    from ..ops.audio import WHISPER_HOP, griffin_lim, mel_to_linear
 
-    mel = tts_forward(params, config, tokens)
+    mel, total_frames = tts_forward(params, config, tokens)
     magnitude = mel_to_linear(mel.astype(jnp.float32),
                               num_mels=config.n_mels)
-    return griffin_lim(magnitude, n_iter=n_iter)
+    audio = griffin_lim(magnitude, n_iter=n_iter)
+    samples = jnp.clip(jnp.ceil(total_frames), 0,
+                       config.max_frames).astype(jnp.int32) * WHISPER_HOP
+    return audio, samples

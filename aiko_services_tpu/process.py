@@ -71,6 +71,8 @@ class ProcessRuntime:
         self._transport_factory = transport_factory or self._default_factory
         self.message = None
         self._message_handlers: list[tuple[str, object]] = []
+        self._exact_handlers: dict[str, list] = {}
+        self._wildcard_handlers: list[tuple[str, object]] = []
         self._binary_topics: set[str] = set()
         self._services: dict[int, object] = {}
         self._service_counter = itertools.count(1)
@@ -144,7 +146,13 @@ class ProcessRuntime:
                 payload = payload.decode("utf-8")
             except UnicodeDecodeError:
                 pass
-        for pattern, handler in list(self._message_handlers):
+        # exact handlers hash-match; only wildcard patterns scan — a
+        # linear topic_matches walk here is O(handlers) per message,
+        # which turns an N-consumer fan-out into O(N²) (the reference's
+        # documented bottleneck, its lifecycle.py:18-24)
+        for handler in list(self._exact_handlers.get(topic, ())):
+            handler(topic, payload)
+        for pattern, handler in list(self._wildcard_handlers):
             if topic_matches(pattern, topic):
                 handler(topic, payload)
 
@@ -154,6 +162,10 @@ class ProcessRuntime:
     def add_message_handler(self, handler, topic: str,
                             binary: bool = False) -> None:
         self._message_handlers.append((topic, handler))
+        if "+" in topic or "#" in topic:
+            self._wildcard_handlers.append((topic, handler))
+        else:
+            self._exact_handlers.setdefault(topic, []).append(handler)
         if binary:
             self._binary_topics.add(topic)
         if self.message is not None:
@@ -163,6 +175,15 @@ class ProcessRuntime:
         self._message_handlers = [
             (t, h) for t, h in self._message_handlers
             if not (t == topic and h == handler)]
+        self._wildcard_handlers = [
+            (t, h) for t, h in self._wildcard_handlers
+            if not (t == topic and h == handler)]
+        exact = self._exact_handlers.get(topic)
+        if exact is not None:
+            self._exact_handlers[topic] = [h for h in exact
+                                           if h != handler]
+            if not self._exact_handlers[topic]:
+                del self._exact_handlers[topic]
         if self.message is not None and \
                 not any(t == topic for t, _ in self._message_handlers):
             self.message.unsubscribe(topic)

@@ -68,32 +68,37 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
     k_cache = k_cache.at[slots, :, lengths].set(k[:, :, 0])
     v_cache = v_cache.at[slots, :, lengths].set(v[:, :, 0])
 
-    # attend over each slot's valid prefix (inclusive of the new token)
+    # attend over each slot's valid prefix (inclusive of the new token).
+    # GQA via a grouped einsum against the SHARED KV — materializing
+    # repeated caches (jnp.repeat) costs group× HBM and halves the slot
+    # capacity that fits on a chip.
+    slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
     valid = (jnp.arange(k_cache.shape[2])[None] <=
-             lengths[:, None])[:, None, None]          # [S,1,1,T]
-    if num_kv != num_heads:
-        group = num_heads // num_kv
-        k_attend = jnp.repeat(k_cache, group, axis=1)
-        v_attend = jnp.repeat(v_cache, group, axis=1)
-    else:
-        k_attend, v_attend = k_cache, v_cache
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    scores = jnp.einsum("shqd,shtd->shqt", q.astype(jnp.float32),
-                        k_attend.astype(jnp.float32)) * scale
+             lengths[:, None])[:, None, None, None]    # [S,1,1,1,T]
+    group = num_heads // num_kv
+    q_grouped = q.reshape(slots_n, num_kv, group, num_q, head_dim)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    scores = jnp.einsum("skgqd,sktd->skgqt",
+                        q_grouped.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
     scores = jnp.where(valid, scores, -1e30)
-    weights = jax.nn.softmax(scores, axis=-1).astype(v_attend.dtype)
-    out = jnp.einsum("shqt,shtd->shqd", weights, v_attend)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("skgqt,sktd->skgqd", weights, v_cache)
+    out = out.reshape(slots_n, num_heads, num_q, head_dim)
     return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
             k_cache, v_cache)
 
 
-def _build_step(params, config: LlamaConfig):
+def _build_step(config: LlamaConfig):
     """One decode iteration for every slot; jitted once, caches donated
-    so the slot buffers update in place on device."""
+    so the slot buffers update in place on device.  Params are an
+    ARGUMENT, not a closure capture — captured trees get baked into the
+    compiled program as constants (gigabytes for real checkpoints,
+    duplicated per recompile)."""
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
 
-    def one_token(tokens, lengths, k_caches, v_caches):
+    def one_token(params, tokens, lengths, k_caches, v_caches):
         x = L.embedding(params["embed"],
                         tokens[:, None]).astype(config.dtype)
         new_k, new_v = [], []
@@ -113,13 +118,14 @@ def _build_step(params, config: LlamaConfig):
         next_tokens = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         return next_tokens, new_k, new_v
 
-    def step_k(tokens, lengths, active, k_caches, v_caches, num_steps):
+    def step_k(params, tokens, lengths, active, k_caches, v_caches,
+               num_steps):
         """lax.scan of `num_steps` iterations; returns tokens emitted
         [K, S].  Inactive slots keep length (no cache growth)."""
         def body(carry, _):
             tokens, lengths, k_caches, v_caches = carry
             next_tokens, k_caches, v_caches = one_token(
-                tokens, lengths, k_caches, v_caches)
+                params, tokens, lengths, k_caches, v_caches)
             next_tokens = jnp.where(active, next_tokens, tokens)
             lengths = jnp.where(active, lengths + 1, lengths)
             return (next_tokens, lengths, k_caches, v_caches), next_tokens
@@ -169,7 +175,7 @@ class ContinuousDecoder:
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._lengths = jnp.zeros((max_slots,), jnp.int32)
 
-        self._step = _build_step(params, config)
+        self._step = _build_step(config)
         self._prefill_fns: dict = {}
         self._slots: list[DecodeRequest | None] = [None] * max_slots
         self._pending: list[DecodeRequest] = []
@@ -233,7 +239,7 @@ class ContinuousDecoder:
         key = (bucket, width)
         if key in self._prefill_fns:
             return self._prefill_fns[key]
-        from .models.llama import init_llama_caches, llama_decode_step
+        from .models.llama import init_llama_caches, llama_hidden
 
         def admit(params, k_caches, v_caches, tokens, lengths,
                   prompts, true_lens, slots, valid):
@@ -241,11 +247,16 @@ class ContinuousDecoder:
             # rows point at other distinct slots and write back their
             # own current content — a no-op); valid: [A] bool.
             caches = init_llama_caches(self.config, width, bucket)
-            logits, caches = llama_decode_step(params, self.config,
-                                               prompts, caches)
+            hidden, caches = llama_hidden(params, self.config,
+                                          prompts, caches)
             idx = jnp.maximum(true_lens - 1, 0)
-            last = jnp.take_along_axis(
-                logits, idx[:, None, None], axis=1)[:, 0]
+            # select each prompt's last position BEFORE the vocab
+            # projection: full prefill logits are [A, bucket, vocab] —
+            # gigabytes at serving widths
+            last_hidden = jnp.take_along_axis(
+                hidden, idx[:, None, None], axis=1)[:, 0]
+            last = L.linear(params["lm_head"],
+                            last_hidden.astype(jnp.float32))
             firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
             mask = valid[:, None, None, None]
             for i, cache in enumerate(caches):
@@ -364,8 +375,9 @@ class ContinuousDecoder:
         self.stats["occupancy_sum"] += float(active.mean())
         decode_start = time.perf_counter()
         emitted, self._tokens, self._lengths, self._k, self._v = \
-            self._step(self._tokens, self._lengths, jnp.asarray(active),
-                       self._k, self._v, num_steps=self.steps_per_sync)
+            self._step(self.params, self._tokens, self._lengths,
+                       jnp.asarray(active), self._k, self._v,
+                       num_steps=self.steps_per_sync)
         self.stats["steps"] += self.steps_per_sync
         emitted = np.asarray(emitted)            # [K, S] host sync
         self.stats["decode_s"] += time.perf_counter() - decode_start

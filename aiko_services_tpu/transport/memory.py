@@ -89,6 +89,22 @@ class MemoryMessage(Message):
         if lwt_topic is not None:
             self.wills.append((lwt_topic, lwt_payload, lwt_retain))
         self._connected = False
+        # delivery index: exact topics hash-match in O(1); only
+        # wildcard patterns scan.  A process with N services holds N+
+        # subscriptions, and a linear topic_matches scan per inbound
+        # message is O(N²) for an N-consumer fan-out — the reference's
+        # documented scale bottleneck (its lifecycle.py:18-24).
+        self._exact: set[str] = set()
+        self._wild: list[str] = []
+        for pattern in self.subscriptions:
+            self._index(pattern)
+
+    def _index(self, pattern: str) -> None:
+        if "+" in pattern or "#" in pattern:
+            if pattern not in self._wild:
+                self._wild.append(pattern)
+        else:
+            self._exact.add(pattern)
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self) -> None:
@@ -117,11 +133,15 @@ class MemoryMessage(Message):
     def subscribe(self, topic) -> None:
         new = topic not in self.subscriptions
         self.subscriptions.add(topic)
+        self._index(topic)
         if self._connected and new:
             self.broker.deliver_retained(self, topic)
 
     def unsubscribe(self, topic) -> None:
         self.subscriptions.discard(topic)
+        self._exact.discard(topic)
+        if topic in self._wild:
+            self._wild.remove(topic)
 
     def set_last_will_and_testament(self, topic, payload,
                                     retain=False) -> None:
@@ -140,7 +160,10 @@ class MemoryMessage(Message):
     def _deliver(self, topic: str, payload) -> None:
         if not self._connected or self.on_message is None:
             return
-        for pattern in self.subscriptions:
+        if topic in self._exact:
+            self.on_message(topic, payload)
+            return
+        for pattern in self._wild:
             if topic_matches(pattern, topic):
                 self.on_message(topic, payload)
                 return

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Assemble numbered images into a video file through the pipeline
+(reference parity: examples/pipeline/images_to_video.py —
+ImageReadFile → VideoWriteFile on the 2020 pipeline).
+
+Usage:
+    python examples/pipeline/images_to_video.py \
+        "in/image_{frame:06d}.jpg" output.mp4 [--fps 29.97]
+"""
+
+import argparse
+import glob
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("image_pattern",
+                        help='e.g. "in/image_{frame:06d}.jpg"')
+    parser.add_argument("video")
+    parser.add_argument("--fps", type=float, default=29.97)
+    args = parser.parse_args()
+
+    from aiko_services_tpu.event import EventEngine
+    from aiko_services_tpu.pipeline import Pipeline, \
+        parse_pipeline_definition
+    from aiko_services_tpu.process import ProcessRuntime
+    from aiko_services_tpu.transport.memory import (MemoryBroker,
+                                                    MemoryMessage)
+
+    # expand the numbered pattern to the existing, sorted input files
+    wildcard = re.sub(r"\{frame[^}]*\}", "*", args.image_pattern)
+    pathnames = sorted(glob.glob(wildcard))
+    if not pathnames:
+        print(f"no images match {wildcard}", file=sys.stderr)
+        return 1
+
+    engine = EventEngine()
+    broker = MemoryBroker()
+    runtime = ProcessRuntime(
+        name="images_to_video", engine=engine,
+        transport_factory=lambda on_message, lt, lp, lr: MemoryMessage(
+            on_message=on_message, broker=broker, lwt_topic=lt,
+            lwt_payload=lp, lwt_retain=lr)).initialize()
+
+    pipeline = Pipeline(
+        runtime,
+        parse_pipeline_definition({
+            "version": 0, "name": "p_i2v", "runtime": "python",
+            "graph": ["(PE_ImageReadFile (PE_VideoWriteFile))"],
+            "parameters": {"PE_VideoWriteFile.pathname": args.video,
+                           "PE_VideoWriteFile.rate": args.fps},
+            "elements": [
+                {"name": "PE_ImageReadFile", "input": [],
+                 "output": [{"name": "image"}]},
+                {"name": "PE_VideoWriteFile",
+                 "input": [{"name": "image"}], "output": []},
+            ],
+        }),
+        stream_lease_time=0)
+
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream("v", lease_time=0)
+    for pathname in pathnames:
+        pipeline.post("process_frame", "v", {"pathname": pathname})
+    engine.run_until(lambda: len(done) >= len(pathnames), timeout=600.0)
+    pipeline.destroy_stream("v")          # flushes/releases the writer
+    runtime.terminate()
+    print(f"wrote {len(done)} frames to {args.video}")
+    return 0 if len(done) == len(pathnames) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,8 +1,10 @@
-# Neural TTS tests: model shapes/jit, the DSP inverse path, and a golden
-# synthesis check — train the test-preset acoustic model to speak the
+# Neural TTS tests: model shapes/jit, the learned duration predictor,
+# and golden synthesis — train the test-preset acoustic model
+# FastSpeech-style (supervised durations + teacher-forced mel) on the
 # same three-word tone language the ASR golden test listens to, then
-# verify the synthesized waveform carries the right dominant frequency
-# per word through the full pipeline element (reference parity:
+# verify (a) the element speaks the right dominant frequency and
+# (b) the full round trip: synthesized "charlie alpha" AUDIO transcribes
+# correctly through the golden ASR (reference parity:
 # examples/speech/speech_elements.py:96-131, Coqui VITS).
 
 import numpy as np
@@ -15,29 +17,48 @@ from aiko_services_tpu.compute import ComputeRuntime
 from aiko_services_tpu.elements.speech import save_flat_npz
 from aiko_services_tpu.models.tokenizer import ByteTokenizer
 from aiko_services_tpu.models.tts import (
-    TTS_PRESETS, TTSConfig, synthesize, tts_axes, tts_forward, tts_init)
+    TTS_PRESETS, TTSConfig, predict_durations, synthesize, tts_axes,
+    tts_forward, tts_init)
 from aiko_services_tpu.ops.audio import log_mel_spectrogram
 from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+
+import test_speech_golden as asr_golden
 
 WORDS = {"alpha": 330.0, "bravo": 550.0, "charlie": 770.0}
 SAMPLE_RATE = 16000
 CONFIG = TTS_PRESETS["test"]
+MAX_TOKENS = 16
+TONE_FRAMES = 25               # 0.25 s word tone at 100 mel frames/s
+GAP_FRAMES = 5                 # 0.05 s inter-word gap (the space byte)
 
 
 def test_tts_forward_shape_and_jit():
     params = tts_init(jax.random.PRNGKey(0), CONFIG)
     tokens = jnp.zeros((2, 10), jnp.int32)
-    mel = jax.jit(lambda t: tts_forward(params, CONFIG, t))(tokens)
-    assert mel.shape == (2, 10 * CONFIG.frames_per_token, CONFIG.n_mels)
+    mel, total = jax.jit(lambda t: tts_forward(params, CONFIG, t))(tokens)
+    assert mel.shape == (2, CONFIG.max_frames, CONFIG.n_mels)
+    assert total.shape == (2,)
     assert np.isfinite(np.asarray(mel)).all()
+
+
+def test_untrained_durations_near_prior():
+    """The duration head's log bias is the frames_per_token prior, so an
+    untrained model regulates near the old fixed factor."""
+    params = tts_init(jax.random.PRNGKey(0), CONFIG)
+    tokens = jnp.asarray([[97, 98, 99, 0, 0]], jnp.int32)
+    _, durations = predict_durations(params, CONFIG, tokens)
+    durations = np.asarray(durations)
+    assert durations[0, 3] == 0.0 and durations[0, 4] == 0.0   # pads
+    ratio = durations[0, :3] / CONFIG.frames_per_token
+    assert (ratio > 0.2).all() and (ratio < 5.0).all()
 
 
 def test_tts_synthesize_produces_audio():
     params = tts_init(jax.random.PRNGKey(0), CONFIG)
     tokens = jnp.ones((1, 8), jnp.int32) * 97
-    audio = synthesize(params, CONFIG, tokens, n_iter=4)
+    audio, samples = synthesize(params, CONFIG, tokens, n_iter=4)
     assert audio.ndim == 2 and audio.shape[0] == 1
-    assert audio.shape[1] > 4000          # 48 frames * 160 hop ≈ 0.5 s
+    assert int(samples[0]) > 0
     assert np.isfinite(np.asarray(audio)).all()
 
 
@@ -56,45 +77,70 @@ def dominant_frequency(audio, sample_rate=SAMPLE_RATE):
     return np.fft.rfftfreq(audio.size, 1.0 / sample_rate)[spectrum.argmax()]
 
 
-def word_tone(freq, seconds):
-    t = np.arange(int(SAMPLE_RATE * seconds)) / SAMPLE_RATE
-    return (0.5 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+def byte_durations(words):
+    """Ground-truth per-byte durations for a word sequence: each word's
+    25 tone frames split over its bytes, 5 frames per separating space —
+    exactly the asr_golden utterance() geometry."""
+    durations = []
+    for w, word in enumerate(words):
+        if w:
+            durations.append(GAP_FRAMES)
+        count = len(word)
+        base, remainder = divmod(TONE_FRAMES, count)
+        durations += [base + (1 if i < remainder else 0)
+                      for i in range(count)]
+    return durations
 
 
 def train_tts():
-    """Overfit test-preset TTS: word text → that word's tone mel."""
+    """FastSpeech-style overfit on the ASR golden tone language: mel
+    loss under TEACHER-FORCED ground-truth durations + supervised
+    log-duration loss for the duration head."""
     import optax
 
     tokenizer = ByteTokenizer()
     mel_fn = jax.jit(log_mel_spectrogram)
-    token_rows, mel_rows, mask_rows = [], [], []
-    max_tokens = 8
-    for word, freq in WORDS.items():
-        ids = tokenizer.encode(word)[:max_tokens]
-        real = len(ids)
-        ids = ids + [0] * (max_tokens - real)
-        frames = max_tokens * CONFIG.frames_per_token
-        seconds = (frames * 160 + 240) / SAMPLE_RATE
-        mel = np.asarray(mel_fn(word_tone(freq, seconds)[None]))[0]
-        token_rows.append(ids)
-        mel_rows.append(mel[:frames])
-        # pad tokens would be trained against conflicting targets (each
-        # word's tone) — mask their frames out; inference trims them
-        mask = np.zeros((frames,), np.float32)
-        mask[:real * CONFIG.frames_per_token] = 1.0
-        mask_rows.append(mask)
+    texts = [["alpha"], ["bravo"], ["charlie"],
+             ["alpha", "bravo"], ["bravo", "charlie"],
+             ["charlie", "alpha"], ["alpha", "charlie"],
+             ["bravo", "alpha"], ["charlie", "bravo"]]
+    token_rows, dur_rows, mel_rows, frame_mask, token_mask = \
+        [], [], [], [], []
+    for words in texts:
+        ids = tokenizer.encode(" ".join(words))[:MAX_TOKENS]
+        durations = byte_durations(words)[:len(ids)]
+        total = int(sum(durations))
+        mel = np.asarray(mel_fn(asr_golden.utterance(words)[None]))[0]
+        buffer = np.zeros((CONFIG.max_frames, CONFIG.n_mels), np.float32)
+        frames = min(mel.shape[0], total, CONFIG.max_frames)
+        buffer[:frames] = mel[:frames]
+        mask = np.zeros((CONFIG.max_frames,), np.float32)
+        mask[:frames] = 1.0
+        pad = MAX_TOKENS - len(ids)
+        token_rows.append(ids + [0] * pad)
+        dur_rows.append(durations + [0] * pad)
+        token_mask.append([1.0] * len(ids) + [0.0] * pad)
+        mel_rows.append(buffer)
+        frame_mask.append(mask)
     tokens = jnp.asarray(token_rows, jnp.int32)
+    true_durations = jnp.asarray(dur_rows, jnp.float32)
     target = jnp.asarray(np.stack(mel_rows))
-    mask = jnp.asarray(np.stack(mask_rows))[..., None]
+    fmask = jnp.asarray(np.stack(frame_mask))[..., None]
+    tmask = jnp.asarray(token_mask)
 
     params = tts_init(jax.random.PRNGKey(0), CONFIG)
     optim = optax.adam(3e-3)
     opt_state = optim.init(params)
 
     def loss_fn(p):
-        mel = tts_forward(p, CONFIG, tokens)
-        return jnp.sum(mask * (mel - target) ** 2) / \
-            (jnp.sum(mask) * CONFIG.n_mels)
+        mel, _ = tts_forward(p, CONFIG, tokens,
+                             durations=true_durations)
+        mel_loss = jnp.sum(fmask * (mel - target) ** 2) / \
+            (jnp.sum(fmask) * CONFIG.n_mels)
+        log_d, _ = predict_durations(p, CONFIG, tokens)
+        dur_loss = jnp.sum(tmask * (log_d - jnp.log(
+            jnp.maximum(true_durations, 1.0))) ** 2) / jnp.sum(tmask)
+        return mel_loss + 0.1 * dur_loss
 
     @jax.jit
     def step(p, s):
@@ -102,7 +148,7 @@ def train_tts():
         updates, s = optim.update(grads, s)
         return optax.apply_updates(p, updates), s, loss
 
-    for _ in range(400):
+    for _ in range(700):
         params, opt_state, loss = step(params, opt_state)
         if float(loss) < 2e-3:
             break
@@ -111,17 +157,37 @@ def train_tts():
 
 
 @pytest.fixture(scope="module")
-def tts_weights(tmp_path_factory):
+def tts_params():
+    return train_tts()
+
+
+@pytest.fixture(scope="module")
+def tts_weights(tts_params, tmp_path_factory):
     path = tmp_path_factory.mktemp("tts") / "tts.npz"
-    save_flat_npz(train_tts(), str(path))
+    save_flat_npz(tts_params, str(path))
     return str(path)
+
+
+def test_learned_durations_match_ground_truth(tts_params):
+    """The trained duration head recovers the tone-language timing: per
+    byte within one frame, total utterance length within 10%."""
+    tokenizer = ByteTokenizer()
+    words = ["charlie", "alpha"]
+    ids = tokenizer.encode(" ".join(words))
+    tokens = jnp.asarray([ids + [0] * (MAX_TOKENS - len(ids))], jnp.int32)
+    _, durations = predict_durations(tts_params, CONFIG, tokens)
+    durations = np.asarray(durations)[0, :len(ids)]
+    truth = np.asarray(byte_durations(words), np.float32)
+    assert np.abs(durations - truth).max() < 1.5, \
+        f"per-byte durations off: {durations} vs {truth}"
+    assert abs(durations.sum() - truth.sum()) < 0.1 * truth.sum()
 
 
 def test_neural_tts_element_speaks_the_right_tone(
         tts_weights, make_runtime, engine):
     """Full element path: text through PE_NeuralTTS (batched program,
     Griffin-Lim on device) → audio whose dominant frequency matches the
-    word's tone."""
+    word's tone and whose length tracks the LEARNED duration."""
     runtime = make_runtime("tts_host").initialize()
     ComputeRuntime(runtime, "compute")
     definition = parse_pipeline_definition({
@@ -132,9 +198,7 @@ def test_neural_tts_element_speaks_the_right_tone(
             "PE_NeuralTTS.mode": "sync",
             "PE_NeuralTTS.weights": tts_weights,
             "PE_NeuralTTS.gl_iters": 24,
-            # the golden model is trained at 8-token sequences; serve the
-            # same geometry (pad tokens synthesize silence-garbage)
-            "PE_NeuralTTS.max_tokens": 8,
+            "PE_NeuralTTS.max_tokens": MAX_TOKENS,
         },
         "elements": [
             {"name": "PE_NeuralTTS", "input": [{"name": "text"}],
@@ -149,6 +213,34 @@ def test_neural_tts_element_speaks_the_right_tone(
         assert ok
         audio = np.asarray(swag["audio"])
         assert swag["sample_rate"] == SAMPLE_RATE
+        # learned duration: one word ≈ 25 frames ≈ 4000 samples
+        assert 2400 <= audio.size <= 8000, f"{word}: {audio.size} samples"
         measured = dominant_frequency(audio)
         assert abs(measured - freq) < 60.0, \
             f"{word}: dominant {measured:.0f} Hz, expected {freq:.0f}"
+
+
+def test_tts_to_asr_roundtrip_text_equality(tts_params):
+    """The chained golden gate: TTS speaks "charlie alpha"; the golden
+    ASR transcribes the SYNTHESIZED WAVEFORM back to the same text —
+    closing text → audio → text entirely through trained models."""
+    from aiko_services_tpu.models.whisper import greedy_decode
+
+    tokenizer = ByteTokenizer()
+    words = ["charlie", "alpha"]
+    ids = tokenizer.encode(" ".join(words))
+    tokens = jnp.asarray([ids + [0] * (MAX_TOKENS - len(ids))], jnp.int32)
+    audio, samples = synthesize(tts_params, CONFIG, tokens, n_iter=48)
+    waveform = np.asarray(audio)[0, :int(samples[0])]
+
+    asr_params = asr_golden.train_whisper()
+    mel = np.asarray(jax.jit(log_mel_spectrogram)(waveform[None]))[0]
+    buffer = np.zeros((asr_golden.BUCKET, 80), np.float32)
+    frames = min(mel.shape[0], asr_golden.BUCKET)
+    buffer[:frames] = mel[:frames]
+    out_tokens, lengths = greedy_decode(
+        asr_params, asr_golden.CONFIG, jnp.asarray(buffer[None]),
+        max_tokens=asr_golden.MAX_TOKENS)
+    text = tokenizer.decode(
+        [int(t) for t in np.asarray(out_tokens)[0][:int(lengths[0])]])
+    assert text.strip() == "charlie alpha", f"round trip got {text!r}"

@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""Measure the reference's aloha-honua pass-through rate on this host.
+
+BASELINE.md needs a MEASURED reference number (not an assumed 1.0) to
+anchor `vs_baseline`.  The aloha example is one actor whose hot path is
+the reference event loop's mailbox drain
+(/root/reference/aiko_services/event.py:261-319: drain mailboxes, then
+sleep 10 ms); its sustainable frames/sec is that loop's message
+throughput.  This script drives exactly that loop — imported from the
+reference tree, mosquitto-less (the transport never enters the hot
+path) — with an open-loop poster thread, counts handled messages over a
+fixed window, and prints one JSON line.
+
+--ours runs the same experiment on this framework's EventEngine
+mailboxes for the apples-to-apples ratio.
+
+Usage:
+    python tools/measure_reference_baseline.py [--seconds 5] [--ours]
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+import types
+
+
+def load_reference_event():
+    """Import aiko_services.event from the reference tree WITHOUT
+    executing the package __init__ (which pulls paho/mqtt)."""
+    sys.path.insert(0, "/root/reference")
+    package = types.ModuleType("aiko_services")
+    package.__path__ = ["/root/reference/aiko_services"]
+    sys.modules["aiko_services"] = package
+    import aiko_services.event as ref_event
+    return ref_event
+
+
+def measure_reference(seconds: float) -> dict:
+    event = load_reference_event()
+    handled = [0]
+    stop = threading.Event()
+
+    def handler(name, item, time_posted):
+        handled[0] += 1
+
+    event.add_mailbox_handler(handler, "aloha")
+
+    def poster():
+        # open-loop: keep the mailbox non-empty, as a busy pipeline
+        # would; bounded bursts so memory stays flat
+        while not stop.is_set():
+            for _ in range(256):
+                event.mailbox_put("aloha", ("aloha", "Pele"))
+            time.sleep(0.001)
+
+    thread = threading.Thread(target=poster, daemon=True)
+    thread.start()
+
+    def terminator():
+        time.sleep(seconds)
+        stop.set()
+        event.terminate()
+
+    threading.Thread(target=terminator, daemon=True).start()
+    start = time.perf_counter()
+    event.loop(loop_when_no_handlers=True)
+    elapsed = time.perf_counter() - start
+    thread.join(timeout=2.0)
+    return {"which": "reference", "messages": handled[0],
+            "seconds": round(elapsed, 3),
+            "messages_per_sec": round(handled[0] / elapsed, 1)}
+
+
+def measure_ours(seconds: float) -> dict:
+    sys.path.insert(0, ".")
+    from aiko_services_tpu.event import EventEngine
+
+    engine = EventEngine()
+    handled = [0]
+    stop = threading.Event()
+
+    def handler(name, item, time_posted):
+        handled[0] += 1
+
+    engine.add_mailbox_handler(handler, "aloha")
+
+    def poster():
+        while not stop.is_set():
+            for _ in range(256):
+                engine.mailbox_put("aloha", ("aloha", "Pele"))
+            time.sleep(0.001)
+
+    thread = threading.Thread(target=poster, daemon=True)
+    thread.start()
+    start = time.perf_counter()
+    deadline = start + seconds
+    engine.run_until(lambda: time.perf_counter() >= deadline,
+                     timeout=seconds + 10)
+    stop.set()
+    elapsed = time.perf_counter() - start
+    thread.join(timeout=2.0)
+    return {"which": "aiko_services_tpu", "messages": handled[0],
+            "seconds": round(elapsed, 3),
+            "messages_per_sec": round(handled[0] / elapsed, 1)}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seconds", type=float, default=5.0)
+    parser.add_argument("--ours", action="store_true")
+    args = parser.parse_args()
+    result = measure_ours(args.seconds) if args.ours else \
+        measure_reference(args.seconds)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
