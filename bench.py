@@ -111,6 +111,35 @@ def compiled_flops(compiled) -> float | None:
         return None
 
 
+def _transient_compile_error(exc: Exception) -> bool:
+    """Retry ONLY transport-layer compile failures.  Deterministic
+    failures (OOM — which the batch ladders rely on to fail fast —
+    shape/tracer errors) must surface immediately."""
+    text = repr(exc)
+    if "RESOURCE_EXHAUSTED" in text or "ResourceExhausted" in text:
+        return False
+    if isinstance(exc, (TypeError, ValueError)):
+        return False
+    return ("remote_compile" in text or "read body" in text or
+            "INTERNAL" in text or "UNAVAILABLE" in text)
+
+
+def compile_with_retry(fn, *args, attempts: int = 3, delay: float = 5.0):
+    """lower+compile with retries: the tunnel's remote-compile service
+    occasionally drops a response mid-body (transient), which must not
+    abort a 20-minute bench run."""
+    for attempt in range(attempts):
+        try:
+            return jax.jit(fn).lower(*args).compile()
+        except Exception as exc:
+            if attempt == attempts - 1 or \
+                    not _transient_compile_error(exc):
+                raise
+            print(f"compile attempt {attempt + 1} failed ({exc!r}); "
+                  f"retrying in {delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+
+
 def measure_compiled(compiled, *args, repeats: int = REPEATS,
                      chain: int = 1):
     """p50 of per-call wall time with hard host-transfer sync
@@ -137,9 +166,9 @@ def measure_model(config, params, batch: int):
     frames = config.n_audio_ctx * 2
     mel = jax.random.normal(jax.random.PRNGKey(1),
                             (batch, frames, config.n_mels), jnp.bfloat16)
-    compiled = jax.jit(lambda params, mel: greedy_decode(
-        params, config, mel, max_tokens=MAX_TOKENS)).lower(
-        params, mel).compile()
+    compiled = compile_with_retry(
+        lambda params, mel: greedy_decode(
+            params, config, mel, max_tokens=MAX_TOKENS), params, mel)
     return measure_compiled(compiled, params, mel), \
         compiled_flops(compiled)
 
@@ -200,7 +229,7 @@ def bench_chip_asr(config, params, batch: int):
             codes = jax.random.randint(
                 jax.random.PRNGKey(2), (chip_batch, samples), 0, 256,
                 jnp.int32).astype(jnp.uint8)  # resident on device
-            compiled = jax.jit(fused).lower(params, codes).compile()
+            compiled = compile_with_retry(fused, params, codes)
             # queue-full throughput (how serving runs): the tunnel's
             # fixed dispatch+sync latency amortizes away
             elapsed = measure_compiled(compiled, params, codes, chain=4)
@@ -539,7 +568,7 @@ def bench_detect_device():
                           images=raw.astype(jnp.float32) / 255.0,
                           score_threshold=0.3)
 
-        compiled = jax.jit(forward).lower(params, images).compile()
+        compiled = compile_with_retry(forward, params, images)
         elapsed = measure_compiled(compiled, params, images, chain=8)
         flops = compiled_flops(compiled)
         mfu = (flops / elapsed / peak) if (peak and flops) else None
@@ -722,11 +751,34 @@ def bench_llama(window: float):
     } | ({} if mfu is None else {"llama_mfu": round(mfu, 4)})
 
 
+def _hbm_in_use() -> str:
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        return f"{stats.get('bytes_in_use', 0) / 1e9:.2f} GB"
+    except Exception:
+        return "n/a"
+
+
 def main() -> None:
     debug = "--debug" in sys.argv
     if debug:
         from aiko_services_tpu.ops import attention as attn_mod
         attn_mod.dispatch_stats.update(flash=0, xla=0)
+
+    # llama first: the 1b preset at 128 slots needs ~12 GB HBM, which
+    # only fits while nothing else has allocated; its own buffers are
+    # dropped before the ASR/detect sections run
+    try:
+        llama = bench_llama(PIPELINE_SECONDS)
+        print(f"llama serving: {llama}", file=sys.stderr)
+    except Exception as exc:
+        llama = {}
+        print(f"llama bench failed: {exc!r}", file=sys.stderr)
+    import gc
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    print(f"hbm after llama section: {_hbm_in_use()}", file=sys.stderr)
 
     config, params, model_times, (model_streams, model_latency,
                                   model_batch), model_mfu = model_ladder()
@@ -752,10 +804,27 @@ def main() -> None:
     # the device always runs the full batch shape, so bigger amortizes
     # every per-batch cost); frontend picked empirically (see _FRONTENDS)
     batch = max(model_times)
+    # the serving program compiles lazily inside warmup(), so the
+    # transient-tunnel retry has to wrap the whole probe, not a
+    # compile call site
+    def run_with_fresh_bench(make):
+        for attempt in (1, 2):
+            instance = make()
+            try:
+                instance.warmup(batch)
+                return instance
+            except Exception as exc:
+                del instance
+                if attempt == 2 or not _transient_compile_error(exc):
+                    raise
+                print(f"pipeline warmup failed transiently ({exc!r}); "
+                      f"retrying", file=sys.stderr)
+                time.sleep(5.0)
+
     rounds = {}
     for frontend in _FRONTENDS:
-        probe = PipelineBench(batch, frontend)
-        probe.warmup(batch)
+        probe = run_with_fresh_bench(lambda: PipelineBench(batch,
+                                                           frontend))
         rounds[frontend] = probe.measure_round(batch)
         del probe            # frees the probe's device params/runtime
         print(f"frontend={frontend}: {rounds[frontend]:.2f}s per "
@@ -772,13 +841,15 @@ def main() -> None:
     # either way)
     wait = min(2.0, max(0.1, 0.75 * t_round))
     drain_budget = max(2.0, 2.5 * t_round + wait)
-    bench = PipelineBench(batch, frontend, max_wait=wait)
-    bench.warmup(batch)
+    bench = run_with_fresh_bench(
+        lambda: PipelineBench(batch, frontend, max_wait=wait))
     sustained, p50, frames, mean_batch, verified, rung_attempts = \
         bench_pipeline(bench, capacity, drain_budget)
     asr_program = bench.compute.programs["whisper_asr.PE_WhisperASR"]
     depth_peak = (asr_program.in_flight or {}).get("peak", 0)
-    del bench
+    # drop the pipeline stack's device buffers (the program closure
+    # holds the ASR params) before the remaining sections
+    del asr_program, bench
 
     # independent sections run after the headline: a stalled section
     # must not discard the already-measured ASR numbers — report
@@ -801,12 +872,6 @@ def main() -> None:
         detect_device_fps, detect_mfu = None, None
         detect_device_batch = 0
         print(f"detect device bench failed: {exc!r}", file=sys.stderr)
-    try:
-        llama = bench_llama(PIPELINE_SECONDS)
-        print(f"llama serving: {llama}", file=sys.stderr)
-    except Exception as exc:
-        llama = {}
-        print(f"llama bench failed: {exc!r}", file=sys.stderr)
 
     if debug:
         from aiko_services_tpu.ops import attention as attn_mod
