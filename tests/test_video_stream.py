@@ -275,3 +275,40 @@ def test_h264_udp_egress_standard_consumer(make_runtime, engine):
     receiver.destroy_stream("rx")
     assert len(received) >= 2, "no H.264 frames decoded from UDP"
     assert received[0].shape == (96, 128, 3)
+
+
+def test_h264_write_open_failure_reports_and_recovers(make_runtime,
+                                                      engine, tmp_path):
+    """A failed egress open must surface the real error as a frame
+    diagnostic and must NOT poison the stream state — a later stream
+    with a valid target works."""
+    pytest.importorskip("cv2")
+    runtime = make_runtime("h264_fail_host").initialize()
+
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_badwrite", "runtime": "python",
+        "graph": ["(PE_VideoStreamWrite)"],
+        "parameters": {"PE_VideoStreamWrite.url":
+                       str(tmp_path / "no_such_dir" / "x.mp4"),
+                       "PE_VideoStreamWrite.fourcc": "zzzz",
+                       "PE_VideoStreamWrite.fourcc_fallback": "zzzz"},
+        "elements": [element("PE_VideoStreamWrite", ["image"], [])],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    pipeline.create_stream("bad", lease_time=0,
+                           parameters={})
+    ok, result = pipeline.process_frame("bad", {"image": test_image(1)})
+    assert not ok
+    pipeline.destroy_stream("bad")
+
+    good = str(tmp_path / "ok.mp4")
+    pipeline.create_stream("good", lease_time=0, parameters={
+        "PE_VideoStreamWrite.url": good,
+        "PE_VideoStreamWrite.fourcc": "mp4v",
+        "PE_VideoStreamWrite.fourcc_fallback": "mp4v"})
+    for i in range(3):
+        ok, _ = pipeline.process_frame("good", {"image": test_image(i)})
+        assert ok
+    pipeline.destroy_stream("good")
+    import os
+    assert os.path.getsize(good) > 0
