@@ -193,6 +193,14 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
+def _state_entry(value):
+    """State-file values are [pid, start_time] (older files: bare
+    pid → start_time None)."""
+    if isinstance(value, (list, tuple)):
+        return int(value[0]), value[1]
+    return int(value), None
+
+
 @main.group()
 def system() -> None:
     """Bring a whole control plane up/down (registrar, recorder,
@@ -213,8 +221,22 @@ def system_start(transport, state_file, services) -> None:
     import subprocess
     import sys
 
-    state = {name: pid for name, pid in _load_state(state_file).items()
-             if _pid_alive(pid)}
+    from .utils.configuration import pid_start_time, pid_verified
+
+    def _still_ours(value):
+        pid, start = _state_entry(value)
+        if not _pid_alive(pid):
+            return False
+        # a recycled pid (different start time) is NOT our process —
+        # don't let a stale state file block startup forever; legacy
+        # bare-pid entries fall back to the cmdline heuristic
+        if start is not None:
+            return pid_verified(pid, start_time=start)
+        return pid_verified(pid)
+
+    state = {name: value
+             for name, value in _load_state(state_file).items()
+             if _still_ours(value)}
     if state:
         raise click.ClickException(
             f"system already running ({', '.join(state)}); "
@@ -227,7 +249,7 @@ def system_start(transport, state_file, services) -> None:
             broker = subprocess.Popen(
                 ["mosquitto", "-p", str(config.port)],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-            state["mosquitto"] = broker.pid
+            state["mosquitto"] = [broker.pid, pid_start_time(broker.pid)]
             click.echo(f"mosquitto: pid {broker.pid} (port {config.port})")
 
     for name in [s.strip() for s in services.split(",") if s.strip()]:
@@ -235,7 +257,9 @@ def system_start(transport, state_file, services) -> None:
             [sys.executable, "-m", "aiko_services_tpu", name,
              "--transport", transport],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        state[name] = child.pid
+        # record (pid, start_time): the exact process identity, so a
+        # later `stop` can never signal a recycled pid
+        state[name] = [child.pid, pid_start_time(child.pid)]
         click.echo(f"{name}: pid {child.pid}")
     _state_path(state_file).write_text(json.dumps(state))
     if transport == "memory":
@@ -257,15 +281,22 @@ def system_stop(state_file) -> None:
         click.echo("nothing recorded as running")
         return
     from .utils.configuration import pid_verified
-    for name, pid in state.items():
+    for name, value in state.items():
+        pid, start = _state_entry(value)
         if _pid_alive(pid):
             # a stale pid file can point at a recycled pid belonging to
-            # an unrelated process — only signal pids whose cmdline
-            # still matches what we spawned (the recorded name covers
-            # non-aiko children like mosquitto)
-            if not (pid_verified(pid, name) or pid_verified(pid)):
-                click.echo(f"{name}: pid {pid} alive but cmdline no "
-                           f"longer matches — likely recycled, skipped")
+            # an unrelated process — only signal the exact process we
+            # spawned (start-time identity when recorded; cmdline
+            # heuristic for older state files)
+            if start is not None:
+                ok = pid_verified(pid, start_time=start)
+                why = "start time changed"
+            else:
+                ok = pid_verified(pid, name) or pid_verified(pid)
+                why = "cmdline no longer matches"
+            if not ok:
+                click.echo(f"{name}: pid {pid} alive but {why} — "
+                           f"likely recycled, skipped")
                 continue
             try:
                 os.kill(pid, signal.SIGTERM)
@@ -291,7 +322,8 @@ def system_status(state_file) -> None:
     if not state:
         click.echo("not running")
         return
-    for name, pid in state.items():
+    for name, value in state.items():
+        pid, _ = _state_entry(value)
         click.echo(f"{name}: pid {pid} "
                    f"{'alive' if _pid_alive(pid) else 'DEAD'}")
 

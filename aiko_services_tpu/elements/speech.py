@@ -199,10 +199,12 @@ class PE_WhisperASR(PipelineElement):
         per_bucket_config = {}
 
         audio_frontend = self.frontend == "audio"
-        # audio wire format: "mulaw" ships uint8 μ-law codes (half of
-        # int16 — the host→device wire is the pipeline's bottleneck on
-        # thin links) and expands them on device; "int16" ships PCM.
-        wire, _ = self.get_parameter("wire", "mulaw")
+        # audio wire format: "int16" (default) ships lossless PCM;
+        # "mulaw" ships uint8 μ-law codes (half the bytes — worth it
+        # when the host→device wire is the bottleneck, at ~38 dB SNR)
+        # and expands them on device.  Lossy encoding is opt-in so
+        # existing pipelines keep full input fidelity.
+        wire, _ = self.get_parameter("wire", "int16")
         wire = str(wire)
 
         def make_fn(bucket):

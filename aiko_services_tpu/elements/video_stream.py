@@ -343,11 +343,20 @@ class PE_VideoStreamWrite(PipelineElement):
             state["writer"].release()
         proc = state.get("proc")
         if proc is not None:
+            # close stdin separately: a broken pipe here must not stop
+            # a healthy ffmpeg from finalizing the container mux
             try:
                 proc.stdin.close()
+            except Exception:
+                pass
+            try:
                 proc.wait(timeout=10.0)
             except Exception:
                 proc.kill()
+                try:
+                    proc.wait(timeout=5.0)   # reap; never leave a zombie
+                except Exception:
+                    pass
 
 
 # -- JPEG over UDP -----------------------------------------------------------

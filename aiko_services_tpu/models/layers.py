@@ -125,10 +125,16 @@ def conv1d(params, x, stride: int = 1, padding=None):
     `padding=k//2` convention, which whisper checkpoints are trained
     under.  XLA's "SAME" pads asymmetrically under stride>1 (left 0 /
     right 1 for k=3, s=2), silently shifting every strided frame by one
-    sample relative to the checkpoint."""
+    sample relative to the checkpoint.  The symmetric default only
+    preserves length for ODD kernels; even kernels must pass an
+    explicit `padding`."""
     if padding is None:
-        half = (params["w"].shape[0] - 1) // 2
-        padding = [(half, half)]
+        k = params["w"].shape[0]
+        if k % 2 == 0:
+            raise ValueError(
+                f"conv1d default padding requires an odd kernel, got "
+                f"{k}; pass padding explicitly for even kernels")
+        padding = [((k - 1) // 2, (k - 1) // 2)]
     y = jax.lax.conv_general_dilated(
         x, params["w"], window_strides=(stride,), padding=padding,
         dimension_numbers=("NWC", "WIO", "NWC"),

@@ -41,13 +41,47 @@ def get_pid() -> str:
     return str(os.getpid())
 
 
-def pid_verified(pid: int, marker: str = "aiko") -> bool:
-    """True when `pid` is alive AND its command line still contains
-    `marker` — guards SIGKILL paths against pid reuse by an unrelated
+def pid_start_time(pid: int):
+    """Kernel start time of `pid` (jiffies since boot from
+    /proc/<pid>/stat field 22), or None when unknowable.  A (pid,
+    start_time) pair uniquely names a process for the machine's
+    uptime — the identity check that a bare pid (recyclable) or a
+    cmdline substring (spoofable, brittle) cannot give.  Off-Linux
+    falls back to `ps -o lstart=` (a wall-clock string; still unique
+    per incarnation)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm (field 2) may contain spaces/parens — split after the
+        # LAST ')' so field indices are stable
+        fields = stat[stat.rindex(")") + 2:].split()
+        return int(fields[19])          # starttime is field 22 overall
+    except (OSError, ValueError, IndexError):
+        import subprocess
+        try:
+            out = subprocess.run(
+                ["ps", "-p", str(pid), "-o", "lstart="],
+                capture_output=True, text=True, timeout=2).stdout.strip()
+            return out or None
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+
+def pid_verified(pid: int, marker: str = "aiko",
+                 start_time=None) -> bool:
+    """True when `pid` is alive AND still names the process we think
+    it does — guards SIGKILL paths against pid reuse by an unrelated
     process (a stale dashboard row or pid file can outlive its
-    process).  Off-Linux (no /proc) falls back to `ps -o command=`;
-    when neither source can answer, the result is False (callers
-    degrade to a graceful stop)."""
+    process).
+
+    When `start_time` (a value previously captured via
+    `pid_start_time`) is given, identity is exact: the live process's
+    start time must match.  Otherwise falls back to the weaker
+    cmdline-contains-`marker` heuristic.  When neither source can
+    answer, the result is False (callers degrade to a graceful
+    stop)."""
+    if start_time is not None:
+        return pid_start_time(pid) == start_time
     try:
         with open(f"/proc/{pid}/cmdline", "rb") as f:
             cmdline = f.read().replace(b"\0", b" ").decode(

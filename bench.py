@@ -297,8 +297,11 @@ def pipeline_definition(batch: int, frontend: str = "mel",
         "PE_WhisperASR.max_in_flight": DEPTH,
     }
     if frontend == "audio":
-        # mel fused into the device program: zero host work per frame
+        # mel fused into the device program: zero host work per frame;
+        # μ-law wire opt-in (element default is lossless int16) — the
+        # tunnel is the bottleneck here and halving bytes wins
         parameters["PE_WhisperASR.frontend"] = "audio"
+        parameters["PE_WhisperASR.wire"] = "mulaw"
         return {
             "version": 0, "name": "p_bench", "runtime": "jax",
             "graph": ["(PE_BenchAudioSource (PE_WhisperASR))"],

@@ -322,7 +322,8 @@ def test_system_start_stop_cycle(tmp_path):
     assert "stopped" in result.output
 
     deadline = time.monotonic() + 5
-    pid = state["registrar"]
+    from aiko_services_tpu.cli import _state_entry
+    pid, _ = _state_entry(state["registrar"])
     import os
     while time.monotonic() < deadline:
         # the child is pytest's: reap so it cannot linger as a zombie
