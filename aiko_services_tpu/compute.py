@@ -48,6 +48,8 @@ class CompiledProgram:
                                  # (compile + first execution, honestly
                                  # named: the two are not separable here)
     in_flight: dict | None = None   # pipelined: {"now": N, "peak": N}
+    recent_service: Any = None   # deque[(bucket, seconds)] of recent
+                                 # batch service times (post-compile)
 
 
 class ComputeRuntime(Actor):
@@ -175,14 +177,20 @@ class ComputeRuntime(Actor):
                                     items, results, split, start)
                 return None                   # ownership transferred
             program = program_holder["program"]
+            per_item = split(results, len(items))    # device sync
+            elapsed = time.perf_counter() - start
             if bucket not in program.first_call_times:
-                program.first_call_times[bucket] = \
-                    time.perf_counter() - start
+                # first call = compile + run; do NOT feed it to the
+                # service estimator or deadline admission would fire
+                # spuriously for the whole warm period
+                program.first_call_times[bucket] = elapsed
                 self.ec_producer.update(
-                    f"first_call.{name}.{bucket}",
-                    round(program.first_call_times[bucket], 3))
+                    f"first_call.{name}.{bucket}", round(elapsed, 3))
+            else:
+                scheduler.observe_service_time(bucket, elapsed)
+                program.recent_service.append((bucket, elapsed))
             self._publish_stats(name, scheduler)
-            return split(results, len(items))
+            return per_item
 
         if not isinstance(buckets, ShapeBuckets):
             buckets = ShapeBuckets(buckets)
@@ -193,8 +201,10 @@ class ComputeRuntime(Actor):
                                       max_wait=max_wait,
                                       clock=self.runtime.event.clock.now,
                                       dispatch_gate=gate)
+        from collections import deque
         program = CompiledProgram(name, fn, buckets, scheduler, {})
         program.in_flight = in_flight
+        program.recent_service = deque(maxlen=512)
         program_holder["program"] = program
         self.programs[name] = program
         self._timers.append(scheduler.attach(self.runtime.event,
@@ -203,11 +213,12 @@ class ComputeRuntime(Actor):
         return scheduler
 
     def submit(self, name: str, stream_id: str, payload, length: int,
-               callback) -> None:
+               callback, deadline: float | None = None) -> None:
         program = self.programs[name]
         if program.scheduler is None:
             raise ValueError(f"program {name} is not batched")
-        program.scheduler.submit(stream_id, payload, length, callback)
+        program.scheduler.submit(stream_id, payload, length, callback,
+                                 deadline=deadline)
 
     # -- pipelined results path ---------------------------------------------
     def _worker_submit(self, program, bucket, items, results, split,
@@ -251,6 +262,10 @@ class ComputeRuntime(Actor):
             program.first_call_times[bucket] = elapsed
             self.ec_producer.update(f"first_call.{program.name}.{bucket}",
                                     round(elapsed, 3))
+        elif program.scheduler is not None:
+            program.scheduler.observe_service_time(bucket, elapsed)
+            if program.recent_service is not None:
+                program.recent_service.append((bucket, elapsed))
         if program.scheduler is not None:
             self._publish_stats(program.name, program.scheduler)
         for item, result in zip(items, per_item):

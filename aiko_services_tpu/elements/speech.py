@@ -33,6 +33,19 @@ __all__ = [
 SAMPLE_RATE = 16000         # voice rate (reference: audio_io.py:224-228)
 
 
+def compression_ratio(text: str) -> float:
+    """len(utf8)/len(zlib(utf8)) — degenerate repetition (the classic
+    whisper hallucination mode) compresses far better than speech;
+    ratios above ~2.4 flag it (reference gate:
+    speech_elements.py:174-250)."""
+    import zlib
+
+    data = text.encode("utf-8")
+    if not data:
+        return 0.0
+    return len(data) / len(zlib.compress(data))
+
+
 def load_wav(pathname: str):
     """wav → float32 [-1, 1] mono numpy array (stdlib only)."""
     import numpy as np
@@ -143,7 +156,8 @@ class PE_WhisperASR(PipelineElement):
         import numpy as np
 
         from ..models.whisper import (
-            WHISPER_PRESETS, WhisperConfig, greedy_decode, whisper_init)
+            WHISPER_PRESETS, WhisperConfig, greedy_decode_scored,
+            sot_sequence_for, whisper_init)
 
         preset, _ = self.get_parameter("preset", "tiny")
         max_tokens, _ = self.get_parameter("max_tokens", 24)
@@ -170,6 +184,27 @@ class PE_WhisperASR(PipelineElement):
         self.mode, _ = self.get_parameter("mode", "batched")
         self.frontend, _ = self.get_parameter("frontend", "mel")
         max_tokens = int(max_tokens)
+        # per-frame completion budget: frames submit with an absolute
+        # deadline and the batch former dispatches a partial batch
+        # early when the earliest deadline is at risk (measured-service
+        # EWMA) — latency becomes a scheduling input, not a hope
+        deadline_ms, _ = self.get_parameter("deadline_ms", 0)
+        self.deadline_s = float(deadline_ms) / 1000.0
+
+        # decode conditioning + quality gates (reference behavior:
+        # speech_elements.py:174-250 — language pinning and the
+        # explicit hallucination-suppression block around
+        # faster-whisper)
+        language, _ = self.get_parameter("language", "")
+        task, _ = self.get_parameter("task", "transcribe")
+        timestamps, _ = self.get_parameter("timestamps", False)
+        self.timestamps = parse_bool(timestamps, False)
+        logprob_threshold, _ = self.get_parameter(
+            "logprob_threshold", -1.0)
+        self.logprob_threshold = float(logprob_threshold)
+        compression_threshold, _ = self.get_parameter(
+            "compression_ratio_threshold", 2.4)
+        self.compression_threshold = float(compression_threshold)
 
         compute_name, _ = self.get_parameter("compute", "compute")
         self.compute = self.runtime.service_by_name(compute_name)
@@ -207,10 +242,23 @@ class PE_WhisperASR(PipelineElement):
         wire, _ = self.get_parameter("wire", "int16")
         wire = str(wire)
 
+        # the conditioning prompt: <|sot|> [lang task] [notimestamps];
+        # timestamps off additionally masks timestamp ids out of the
+        # argmax (sot_sequence_for validates vocab coverage)
+        sot_sequence = sot_sequence_for(
+            self.config, language=str(language) or None,
+            task=str(task), timestamps=self.timestamps)
+        # the prompt occupies decoder positions too; n_text_ctx was
+        # sized max_tokens+8 above and the longest prompt is 4 tokens
+        assert len(sot_sequence) + max_tokens <= self.config.n_text_ctx
+
         def make_fn(bucket):
             import dataclasses
             config = dataclasses.replace(
                 self.config, n_audio_ctx=bucket // 2)
+            decode_kwargs = dict(max_tokens=max_tokens,
+                                 sot_sequence=sot_sequence,
+                                 suppress_timestamps=not self.timestamps)
             if audio_frontend:
                 from ..ops.audio import log_mel_spectrogram, mulaw_decode
 
@@ -223,12 +271,12 @@ class PE_WhisperASR(PipelineElement):
                         audio = pcm.astype(jnp.float32) / 32768.0
                     mel = log_mel_spectrogram(
                         audio, num_mels=config.n_mels)
-                    return greedy_decode(params, config,
-                                         mel.astype(config.dtype),
-                                         max_tokens=max_tokens)
+                    return greedy_decode_scored(
+                        params, config, mel.astype(config.dtype),
+                        **decode_kwargs)
                 return jax.jit(fused)
-            return jax.jit(lambda params, mel: greedy_decode(
-                params, config, mel, max_tokens=max_tokens))
+            return jax.jit(lambda params, mel: greedy_decode_scored(
+                params, config, mel, **decode_kwargs))
 
         def run_bucket(bucket, batch):
             if bucket not in per_bucket_config:
@@ -279,11 +327,12 @@ class PE_WhisperASR(PipelineElement):
             return jnp.asarray(batch, jnp.bfloat16)
 
         def split(results, count):
-            tokens, lengths = results
+            tokens, lengths, avg_logprob = results
             tokens = np.asarray(tokens)
             lengths = np.asarray(lengths)
-            return [(tokens[i, :lengths[i]], int(lengths[i]))
-                    for i in range(count)]
+            avg_logprob = np.asarray(avg_logprob)
+            return [(tokens[i, :lengths[i]], int(lengths[i]),
+                     float(avg_logprob[i])) for i in range(count)]
 
         from ..compute import resolve_pipelined
         pipelined, _ = self.get_parameter("pipelined", False)
@@ -327,14 +376,48 @@ class PE_WhisperASR(PipelineElement):
                                result if isinstance(result, Exception)
                                else self._to_outputs(result))
 
+        deadline = (self.runtime.event.clock.now() + self.deadline_s) \
+            if self.deadline_s > 0 else None
         self.compute.submit(self._program, frame.stream_id, mel, length,
-                            callback)
+                            callback, deadline=deadline)
         return FrameOutput(True, DEFERRED)
 
     def _to_outputs(self, result):
-        tokens, length = result
-        text = self.detokenizer([int(t) for t in tokens[:length]])
-        return {"tokens": tokens, "text": text}
+        tokens, length, avg_logprob = result
+        outputs = {"tokens": tokens, "avg_logprob": avg_logprob}
+        if self.timestamps:
+            from ..models.whisper import parse_timestamp_segments
+            segments, text_tokens = parse_timestamp_segments(tokens,
+                                                             length)
+            text = self.detokenizer([int(t) for t in text_tokens])
+            outputs["segments"] = [
+                seg | {"text": self.detokenizer(
+                    [int(t) for t in seg["tokens"]])}
+                for seg in segments]
+        else:
+            text = self.detokenizer([int(t) for t in tokens[:length]])
+        # hallucination gates, the reference ASR element's filtering
+        # behavior (speech_elements.py:174-250): improbable decodes
+        # (low mean logprob) or degenerate repetition (text that zlib
+        # squashes too well) are suppressed rather than emitted
+        reason = ""
+        if avg_logprob < self.logprob_threshold:
+            reason = f"avg_logprob {avg_logprob:.2f} < " \
+                     f"{self.logprob_threshold}"
+        else:
+            ratio = compression_ratio(text)
+            if ratio > self.compression_threshold:
+                reason = (f"compression_ratio {ratio:.2f} > "
+                          f"{self.compression_threshold}")
+        if reason:
+            outputs |= {"text": "", "suppressed": reason}
+            if "segments" in outputs:
+                # a suppressed decode must not leak its hallucinated
+                # transcript through the segments side door either
+                outputs["segments"] = []
+        else:
+            outputs["text"] = text
+        return outputs
 
 
 def _whisper_axes(config):

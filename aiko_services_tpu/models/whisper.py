@@ -23,7 +23,9 @@ import jax.numpy as jnp
 from . import layers as L
 
 __all__ = ["WhisperConfig", "whisper_init", "whisper_axes", "encode",
-           "decode_step", "greedy_decode", "forward", "WHISPER_PRESETS"]
+           "decode_step", "greedy_decode", "greedy_decode_scored",
+           "forward", "WHISPER_PRESETS", "sot_sequence_for",
+           "parse_timestamp_segments", "LANGUAGES"]
 
 
 @dataclass(frozen=True)
@@ -70,8 +72,80 @@ WHISPER_PRESETS = {
 # Special tokens (multilingual tokenizer ids, as in openai/whisper)
 SOT = 50258
 EOT = 50257
-TOKEN_NO_TIMESTAMPS = 50363
+TOKEN_TRANSLATE = 50358
 TOKEN_TRANSCRIBE = 50359
+TOKEN_NO_TIMESTAMPS = 50363
+TOKEN_TIMESTAMP_BEGIN = 50364       # <|0.00|>; each id adds 0.02 s
+TIMESTAMP_STEP_S = 0.02
+
+# Language order of the multilingual tokenizer: token id for language i
+# is SOT + 1 + i (reference capability: speech_elements.py:174-250 pins
+# language="en" through faster-whisper; here it's a prompt token)
+LANGUAGES = (
+    "en", "zh", "de", "es", "ru", "ko", "fr", "ja", "pt", "tr", "pl",
+    "ca", "nl", "ar", "sv", "it", "id", "hi", "fi", "vi", "he", "uk",
+    "el", "ms", "cs", "ro", "da", "hu", "ta", "no", "th", "ur", "hr",
+    "bg", "lt", "la", "mi", "ml", "cy", "sk", "te", "fa", "lv", "bn",
+    "sr", "az", "sl", "kn", "et", "mk", "br", "eu", "is", "hy", "ne",
+    "mn", "bs", "kk", "sq", "sw", "gl", "mr", "pa", "si", "km", "sn",
+    "yo", "so", "af", "oc", "ka", "be", "tg", "sd", "gu", "am", "yi",
+    "lo", "uz", "fo", "ht", "ps", "tk", "nn", "mt", "sa", "lb", "my",
+    "bo", "tl", "mg", "as", "tt", "haw", "ln", "ha", "ba", "jw", "su")
+
+
+def sot_sequence_for(config: WhisperConfig, language: str | None = None,
+                     task: str = "transcribe",
+                     timestamps: bool = False) -> tuple:
+    """The start-of-transcript prompt that conditions decoding, as in
+    openai/whisper: <|sot|> [<|lang|> <|task|>] [<|notimestamps|>].
+
+    Language/task tokens only exist in the real multilingual vocab —
+    asking for them on a small-vocab preset is an error, not a silent
+    degradation."""
+    sequence = [config.sot]
+    if language is not None:
+        if language not in LANGUAGES:
+            raise ValueError(f"unknown language {language!r}")
+        lang_token = SOT + 1 + LANGUAGES.index(language)
+        task_token = {"transcribe": TOKEN_TRANSCRIBE,
+                      "translate": TOKEN_TRANSLATE}[task]
+        if max(lang_token, task_token) >= config.n_vocab:
+            raise ValueError(
+                f"language/task conditioning needs the multilingual "
+                f"vocab (n_vocab {config.n_vocab} too small)")
+        sequence += [lang_token, task_token]
+    if not timestamps and TOKEN_NO_TIMESTAMPS < config.n_vocab:
+        sequence.append(TOKEN_NO_TIMESTAMPS)
+    return tuple(sequence)
+
+
+def parse_timestamp_segments(tokens, length: int,
+                             timestamp_begin: int = TOKEN_TIMESTAMP_BEGIN):
+    """Split a decoded token sequence on timestamp tokens.
+
+    Returns (segments, text_tokens): segments are
+    {"start": s, "end": s, "tokens": [...]} with seconds decoded from
+    the 0.02 s grid; text_tokens is everything with the timestamp
+    markers stripped (what the detokenizer should see)."""
+    segments, text_tokens = [], []
+    current, start = [], None
+    for token in list(tokens)[:length]:
+        token = int(token)
+        if token >= timestamp_begin:
+            seconds = (token - timestamp_begin) * TIMESTAMP_STEP_S
+            if start is None:
+                start = seconds
+            else:
+                segments.append({"start": start, "end": seconds,
+                                 "tokens": current})
+                current, start = [], None
+        else:
+            current.append(token)
+            text_tokens.append(token)
+    if current:
+        segments.append({"start": start or 0.0, "end": None,
+                         "tokens": current})
+    return segments, text_tokens
 
 
 def _block_init(key, config: WhisperConfig, cross: bool):
@@ -226,13 +300,32 @@ def decode_step(params, config: WhisperConfig, tokens, cross_kv, caches,
 
 
 def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
-                  sot_sequence=None):
+                  sot_sequence=None, suppress_timestamps: bool = False):
     """Batched greedy decoding as one compiled program.
 
     mel: [B, T_frames, n_mels] → (tokens [B, max_tokens], lengths [B]).
+    See greedy_decode_scored for the scored variant."""
+    tokens, lengths, _ = greedy_decode_scored(
+        params, config, mel, max_tokens, sot_sequence,
+        suppress_timestamps)
+    return tokens, lengths
+
+
+def greedy_decode_scored(params, config: WhisperConfig, mel,
+                         max_tokens: int = 64, sot_sequence=None,
+                         suppress_timestamps: bool = False):
+    """Batched greedy decoding with per-sequence quality scores.
+
+    mel: [B, T_frames, n_mels] →
+    (tokens [B, max_tokens], lengths [B], avg_logprob [B]).
+
     The token loop is a lax.scan over static-shape KV caches; finished
     sequences (EOT emitted) keep writing EOT — no dynamic shapes, so one
-    compilation serves every utterance in the bucket."""
+    compilation serves every utterance in the bucket.  avg_logprob is
+    the mean log-probability of the emitted tokens (EOT included, as in
+    openai/whisper) — the hallucination gate's first input.
+    suppress_timestamps masks ids >= TOKEN_TIMESTAMP_BEGIN out of the
+    argmax (the <|notimestamps|> decode mode)."""
     if sot_sequence is None:
         sot_sequence = (config.sot,)
     eot = config.eot
@@ -252,28 +345,46 @@ def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
     cross_kv = precompute_cross_kv(params, config, audio)
     caches = init_caches(config, batch, max_len=total)
 
+    if suppress_timestamps and TOKEN_TIMESTAMP_BEGIN < config.n_vocab:
+        ts_mask = (jnp.arange(config.n_vocab) >=
+                   TOKEN_TIMESTAMP_BEGIN)[None]
+    else:
+        ts_mask = None
+
+    def pick(logits_last):
+        if ts_mask is not None:
+            logits_last = jnp.where(ts_mask, -jnp.inf, logits_last)
+        token = jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        logprob = jnp.take_along_axis(
+            jax.nn.log_softmax(logits_last, axis=-1),
+            token[:, None], axis=-1)[:, 0]
+        return token, logprob
+
     # prefill the start-of-transcript prompt
     prompt = jnp.tile(jnp.array(sot_sequence, jnp.int32)[None], (batch, 1))
     logits, caches = decode_step(params, config, prompt, cross_kv, caches)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    first, first_logprob = pick(logits[:, -1])
 
     def step(carry, position):
-        token, caches, done = carry
+        token, caches, done, logprob_sum, count = carry
         logits, caches = decode_step(
             params, config, token[:, None], cross_kv, caches,
             position_offset=position)
-        next_token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        next_token, logprob = pick(logits[:, -1])
         next_token = jnp.where(done, eot, next_token)
+        logprob_sum = logprob_sum + jnp.where(done, 0.0, logprob)
+        count = count + jnp.where(done, 0, 1)
         done = done | (next_token == eot)
-        return (next_token, caches, done), token
+        return (next_token, caches, done, logprob_sum, count), token
 
     positions = len(sot_sequence) + jnp.arange(max_tokens)
     done0 = first == eot
-    (_, _, done), tokens = jax.lax.scan(
-        step, (first, caches, done0), positions)
+    (_, _, done, logprob_sum, count), tokens = jax.lax.scan(
+        step, (first, caches, done0, first_logprob,
+               jnp.ones((batch,), jnp.int32)), positions)
     tokens = jnp.moveaxis(tokens, 0, 1)            # [B, max_tokens]
     lengths = jnp.sum((tokens != eot).astype(jnp.int32), axis=1)
-    return tokens, lengths
+    return tokens, lengths, logprob_sum / jnp.maximum(count, 1)
 
 
 def forward(params, config: WhisperConfig, mel, tokens):

@@ -45,6 +45,7 @@ class BatchItem:
     enqueue_time: float
     callback: Callable          # callback(stream_id, result)
     bucket: int = 0
+    deadline: float | None = None   # absolute completion target
 
 
 @dataclass
@@ -77,21 +78,57 @@ class BatchingScheduler:
         self.dispatch_gate = dispatch_gate
         self._lock = threading.Lock()
         self._queues: dict[int, _Bucket] = {}
+        # EWMA of recent per-batch service time (dispatch → results),
+        # fed back by the owner via observe_service_time(): the
+        # deadline-at-risk test needs to know how long a batch takes
+        self._service_ewma: dict[int, float] = {}
         self.stats = {"batches": 0, "items": 0, "batch_size_sum": 0,
                       "full_batches": 0, "wait_sum": 0.0,
-                      "gated": 0}
+                      "gated": 0, "deadline_dispatches": 0}
+        # rolling queue-wait samples (seconds) for percentile reporting
+        self.recent_waits: deque = deque(maxlen=4096)
 
     def submit(self, stream_id: str, payload, length: int,
-               callback) -> None:
+               callback, deadline: float | None = None) -> None:
+        """Enqueue one item.  `deadline` (absolute, scheduler clock) is
+        the item's completion target: the batch former dispatches a
+        partial batch EARLY when waiting longer would make the earliest
+        deadline unmeetable, instead of sitting out the full max_wait."""
         bucket = self.buckets.bucket_for(length)
         item = BatchItem(stream_id, payload, self.clock(), callback,
-                         bucket)
+                         bucket, deadline)
         with self._lock:
             self._queues.setdefault(bucket, _Bucket()).items.append(item)
 
+    def observe_service_time(self, bucket: int, seconds: float) -> None:
+        """Feed back a measured batch service time (dispatch → results
+        delivered) so deadline-at-risk admission has a current
+        estimate.  EWMA, alpha=0.3."""
+        with self._lock:
+            prior = self._service_ewma.get(bucket)
+            self._service_ewma[bucket] = seconds if prior is None \
+                else 0.7 * prior + 0.3 * seconds
+
+    def service_estimate(self, bucket: int) -> float | None:
+        with self._lock:
+            return self._service_ewma.get(bucket)
+
+    def _deadline_at_risk(self, bucket_key: int, bucket: _Bucket,
+                          now: float) -> bool:
+        """True when waiting any longer would likely miss the earliest
+        deadline in this bucket: remaining slack has shrunk to the
+        estimated service time."""
+        estimate = self._service_ewma.get(bucket_key)
+        if estimate is None:
+            return False
+        earliest = min((i.deadline for i in bucket.items
+                        if i.deadline is not None), default=None)
+        return earliest is not None and earliest - now <= estimate
+
     def _ready_bucket(self, now: float):
-        """A bucket is ready when full or its head item is older than
-        max_wait.  Oldest head wins (FIFO fairness across buckets)."""
+        """A bucket is ready when full, its head item is older than
+        max_wait, or its earliest deadline is at risk.  Oldest head
+        wins (FIFO fairness across buckets)."""
         best, best_age = None, -1.0
         for bucket_key, bucket in self._queues.items():
             if not bucket.items:
@@ -107,22 +144,37 @@ class BatchingScheduler:
         if len(bucket.items) >= self.max_batch or \
                 best_age >= self.max_wait:
             return best
+        # the at-risk test must cover EVERY bucket, not just the one
+        # with the oldest head — a younger bucket can hold the tighter
+        # deadline
+        for bucket_key, bucket in self._queues.items():
+            if bucket.items and self._deadline_at_risk(bucket_key,
+                                                       bucket, now):
+                self.stats["deadline_dispatches"] += 1
+                return bucket_key
         return None
 
     def next_deadline(self) -> float | None:
         """When the next dispatch is due: now for an already-full bucket,
-        else when the oldest pending item's max_wait expires."""
+        else the sooner of (oldest item's max_wait expiry, the moment
+        the earliest completion deadline becomes at-risk)."""
         with self._lock:
-            heads = []
-            for bucket in self._queues.values():
+            dues = []
+            for bucket_key, bucket in self._queues.items():
                 if not bucket.items:
                     continue
                 if len(bucket.items) >= self.max_batch:
                     return self.clock()        # dispatchable right now
-                heads.append(bucket.items[0].enqueue_time)
-        if not heads:
-            return None
-        return min(heads) + self.max_wait
+                due = bucket.items[0].enqueue_time + self.max_wait
+                estimate = self._service_ewma.get(bucket_key)
+                if estimate is not None:
+                    earliest = min((i.deadline for i in bucket.items
+                                    if i.deadline is not None),
+                                   default=None)
+                    if earliest is not None:
+                        due = min(due, earliest - estimate)
+                dues.append(due)
+        return min(dues) if dues else None
 
     def pending(self) -> int:
         with self._lock:
@@ -174,8 +226,9 @@ class BatchingScheduler:
             self.stats["batch_size_sum"] += len(batch)
             self.stats["full_batches"] += \
                 int(len(batch) >= self.max_batch)
-            self.stats["wait_sum"] += sum(now - i.enqueue_time
-                                          for i in batch)
+            waits = [now - i.enqueue_time for i in batch]
+            self.stats["wait_sum"] += sum(waits)
+            self.recent_waits.extend(waits)
             if not deferred:
                 for item, result in zip(batch, results):
                     item.callback(item.stream_id, result)

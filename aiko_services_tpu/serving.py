@@ -55,7 +55,16 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
     """One-token attention for all slots at per-slot positions.
 
     x: [S, 1, dim]; k_cache/v_cache: [S, H_kv, T, D]; lengths: [S] —
-    tokens already in each slot's context (the new token's position)."""
+    tokens already in each slot's context (the new token's position).
+
+    The cache's time axis T is NOT max_seq: the decoder allocates the
+    smallest block multiple covering the longest active context and
+    grows/shrinks the allocation between rounds (see
+    ContinuousDecoder._fit_caches).  Decode is HBM-bound, so the step
+    streams exactly the bytes the workload needs — an in-program
+    slice of a max_seq cache was measured to MATERIALIZE the slice
+    per layer per step (scatter output feeding a dot can't fuse),
+    tripling the attention bytes."""
     num_heads, num_kv = config.num_heads, config.num_kv_heads
     q = L._split_heads(L.linear(layer["attn"]["q"], x), num_heads)
     k = L._split_heads(L.linear(layer["attn"]["k"], x), num_kv)
@@ -63,28 +72,35 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
     q = L.apply_rope(q, cos, sin, lengths)
     k = L.apply_rope(k, cos, sin, lengths)
 
-    slots = jnp.arange(x.shape[0])
-    # write this token's K/V at each slot's own cursor
-    k_cache = k_cache.at[slots, :, lengths].set(k[:, :, 0])
-    v_cache = v_cache.at[slots, :, lengths].set(v[:, :, 0])
+    # write this token's K/V at each slot's own cursor — as a masked
+    # select, not a scatter: a per-slot-index scatter defeats XLA's
+    # in-place/fusion analysis inside the scan, and the full-cache
+    # select was measured ~12% faster per step at the serving shape
+    hit = (jnp.arange(k_cache.shape[2])[None, None, :, None] ==
+           lengths[:, None, None, None])            # [S,1,T,1]
+    k_cache = jnp.where(hit, k[:, :, 0][:, :, None], k_cache)
+    v_cache = jnp.where(hit, v[:, :, 0][:, :, None], v_cache)
 
     # attend over each slot's valid prefix (inclusive of the new token).
     # GQA via a grouped einsum against the SHARED KV — materializing
     # repeated caches (jnp.repeat) costs group× HBM and halves the slot
-    # capacity that fits on a chip.
+    # capacity that fits on a chip.  Scores run as bf16×bf16 MXU
+    # matmuls with f32 ACCUMULATION (preferred_element_type) — an
+    # explicit f32 upcast of the cache would double the HBM bytes of
+    # the read, which is the dominant cost of the step.
     slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
     valid = (jnp.arange(k_cache.shape[2])[None] <=
              lengths[:, None])[:, None, None, None]    # [S,1,1,1,T]
     group = num_heads // num_kv
     q_grouped = q.reshape(slots_n, num_kv, group, num_q, head_dim)
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
-    scores = jnp.einsum("skgqd,sktd->skgqt",
-                        q_grouped.astype(jnp.float32),
-                        k_cache.astype(jnp.float32)) * scale
+    scores = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_cache,
+                        preferred_element_type=jnp.float32) * scale
     scores = jnp.where(valid, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("skgqt,sktd->skgqd", weights, v_cache)
-    out = out.reshape(slots_n, num_heads, num_q, head_dim)
+    out = jnp.einsum("skgqt,sktd->skgqd", weights, v_cache,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(slots_n, num_heads, num_q, head_dim).astype(x.dtype)
     return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
             k_cache, v_cache)
 
@@ -114,28 +130,49 @@ def _build_step(config: LlamaConfig):
                              jax.nn.silu(L.linear(layer["gate"], normed)) *
                              L.linear(layer["up"], normed))
         x = L.rms_norm(params["ln_out"], x)
-        logits = L.linear(params["lm_head"], x.astype(jnp.float32))
+        # bf16 operand reads (an f32 UPCAST of the [dim, vocab] head
+        # would double the step's largest weight read), f32
+        # accumulation KEPT f32 into the argmax — rounding the logits
+        # to bf16 first can flip near-ties against the f32 oracle
+        logits = jnp.einsum("std,dv->stv", x, params["lm_head"]["w"],
+                            preferred_element_type=jnp.float32)
         next_tokens = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         return next_tokens, new_k, new_v
 
-    def step_k(params, tokens, lengths, active, k_caches, v_caches,
-               num_steps):
+    def step_k(params, tokens, lengths, active, budgets, k_caches,
+               v_caches, num_steps, eos):
         """lax.scan of `num_steps` iterations; returns tokens emitted
-        [K, S].  Inactive slots keep length (no cache growth)."""
+        [K, S] plus the per-step active mask [K, S] (True where the
+        emitted token is real output).  A slot retires INSIDE the scan
+        the moment it emits `eos` or exhausts its `budgets` entry —
+        retired slots stop growing their context and their later
+        emissions are discarded by the host, so a request finishing at
+        step 1 of a 32-step round no longer pollutes its cache or
+        miscounts as useful work."""
         def body(carry, _):
-            tokens, lengths, k_caches, v_caches = carry
+            tokens, lengths, active, budgets, k_caches, v_caches = carry
             next_tokens, k_caches, v_caches = one_token(
                 params, tokens, lengths, k_caches, v_caches)
             next_tokens = jnp.where(active, next_tokens, tokens)
             lengths = jnp.where(active, lengths + 1, lengths)
-            return (next_tokens, lengths, k_caches, v_caches), next_tokens
+            budgets = jnp.where(active, budgets - 1, budgets)
+            still = active & (budgets > 0) & (next_tokens != eos)
+            return ((next_tokens, lengths, still, budgets, k_caches,
+                     v_caches), (next_tokens, active))
 
-        (tokens, lengths, k_caches, v_caches), emitted = jax.lax.scan(
-            body, (tokens, lengths, k_caches, v_caches), None,
-            length=num_steps)
-        return emitted, tokens, lengths, k_caches, v_caches
+        tokens_in = tokens
+        (tokens, lengths, active, budgets, k_caches, v_caches), \
+            (emitted, emitted_active) = jax.lax.scan(
+                body, (tokens, lengths, active, budgets, k_caches,
+                       v_caches), None, length=num_steps)
+        # tokens_in rides along so deferred admits resolve their first
+        # token on THIS round's host sync instead of paying their own
+        # device round-trip (see _admit_group)
+        return (emitted, emitted_active, tokens_in, tokens, lengths,
+                k_caches, v_caches)
 
-    return jax.jit(step_k, static_argnames=("num_steps",),
+    return jax.jit(step_k,
+                   static_argnames=("num_steps", "eos"),
                    donate_argnames=("k_caches", "v_caches"))
 
 
@@ -151,13 +188,18 @@ class ContinuousDecoder:
     def __init__(self, params, config: LlamaConfig, max_slots: int = 8,
                  max_seq: int | None = None, eos_token: int | None = None,
                  prefill_buckets=(32, 128), steps_per_sync: int = 4,
-                 name: str = "decoder"):
+                 t_block: int = 256, name: str = "decoder"):
         self.config = config
         self.params = params
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq_len
         self.eos_token = eos_token
         self.steps_per_sync = steps_per_sync
+        # granularity of the attention time-axis cap: each round reads
+        # cache[:, :, :t_cap] with t_cap the smallest multiple of
+        # t_block covering the longest active context (one compiled
+        # program per distinct t_cap — max_seq/t_block variants)
+        self.t_block = max(1, int(t_block))
         # buckets beyond the cache's time axis would blow up the admit
         # scatter — clamp, dedupe, keep sorted
         self.prefill_buckets = tuple(sorted(
@@ -166,7 +208,15 @@ class ContinuousDecoder:
         self.on_idle = None          # hook: fires when the last slot
                                      # retires and nothing is pending
 
-        shape = (max_slots, config.num_kv_heads, self.max_seq,
+        # the cache TIME axis is allocated at the workload, not at
+        # max_seq: it grows/shrinks in t_block steps to cover the
+        # longest active context (_fit_caches).  HBM capacity AND
+        # per-step bandwidth then scale with actual occupancy — a
+        # max_seq allocation makes every decode step stream max_seq
+        # worth of cache (an in-program slice doesn't help: it
+        # materializes, measured 3× attention bytes).
+        self._cache_t = min(self.t_block, self.max_seq)
+        shape = (max_slots, config.num_kv_heads, self._cache_t,
                  config.head_dim)
         self._k = [jnp.zeros(shape, config.dtype)
                    for _ in range(config.num_layers)]
@@ -174,15 +224,29 @@ class ContinuousDecoder:
                    for _ in range(config.num_layers)]
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._lengths = jnp.zeros((max_slots,), jnp.int32)
+        self._resize_fns: dict = {}
 
         self._step = _build_step(config)
         self._prefill_fns: dict = {}
         self._slots: list[DecodeRequest | None] = [None] * max_slots
         self._pending: list[DecodeRequest] = []
         self._timer = None
+        # HBM-traffic model for roofline reporting: every decode step
+        # streams the full weight set (embed excluded — it's a gather
+        # of S rows) plus the capped KV read
+        itemsize = jnp.dtype(config.dtype).itemsize
+        self._param_bytes = int(sum(
+            int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+            if "embed" not in str(path[0])))
+        self._kv_bytes_per_t = (2 * config.num_layers * max_slots *
+                                config.num_kv_heads * config.head_dim *
+                                itemsize)
         self.stats = {"steps": 0, "rounds": 0, "completed": 0,
                       "prefills": 0, "occupancy_sum": 0.0,
-                      "prefill_s": 0.0, "decode_s": 0.0}
+                      "prefill_s": 0.0, "decode_s": 0.0,
+                      "useful_steps": 0, "wasted_steps": 0,
+                      "bytes_moved": 0}
 
     # -- public API --------------------------------------------------------
     def submit(self, request_id: str, prompt, max_new_tokens: int,
@@ -255,8 +319,9 @@ class ContinuousDecoder:
             # gigabytes at serving widths
             last_hidden = jnp.take_along_axis(
                 hidden, idx[:, None, None], axis=1)[:, 0]
-            last = L.linear(params["lm_head"],
-                            last_hidden.astype(jnp.float32))
+            last = jnp.einsum("ad,dv->av", last_hidden,
+                              params["lm_head"]["w"],
+                              preferred_element_type=jnp.float32)
             firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
             mask = valid[:, None, None, None]
             for i, cache in enumerate(caches):
@@ -282,6 +347,32 @@ class ContinuousDecoder:
     def _next_pow2(n: int) -> int:
         return 1 << max(0, (n - 1).bit_length())
 
+    def _fit_caches(self, required_t: int) -> None:
+        """Resize the cache time axis to the t_block multiple covering
+        `required_t` (clamped to max_seq).  A grow pads with zeros, a
+        shrink slices — one whole-cache copy, amortized over the many
+        rounds run at the new size.  No-op when already sized."""
+        new_t = min(self.max_seq,
+                    -(-required_t // self.t_block) * self.t_block)
+        if new_t == self._cache_t:
+            return
+        key = (self._cache_t, new_t)
+        if key not in self._resize_fns:
+            if new_t > self._cache_t:
+                pad = new_t - self._cache_t
+
+                def resize(caches, pad=pad):
+                    return [jnp.pad(c, ((0, 0), (0, 0), (0, pad),
+                                        (0, 0))) for c in caches]
+            else:
+                def resize(caches, t=new_t):
+                    return [c[:, :, :t] for c in caches]
+            self._resize_fns[key] = jax.jit(resize,
+                                            donate_argnums=(0,))
+        self._k = self._resize_fns[key](self._k)
+        self._v = self._resize_fns[key](self._v)
+        self._cache_t = new_t
+
     def _admit_pending(self) -> None:
         """Admit as many pending requests as there are free slots, in
         bucket groups: one stacked prefill + device-side scatter + one
@@ -296,6 +387,9 @@ class ContinuousDecoder:
         for request in take:
             groups.setdefault(self._bucket_for(len(request.prompt)),
                               []).append(request)
+        # grow-only here (admits scatter [:bucket]); the round planner
+        # owns shrinking, with full knowledge of every active context
+        self._fit_caches(max(max(groups), self._cache_t))
         start = time.perf_counter()
         for bucket, requests in groups.items():
             while requests:
@@ -329,16 +423,17 @@ class ContinuousDecoder:
                 jnp.asarray(true_lens),
                 jnp.asarray(slots + pad_slots, jnp.int32),
                 jnp.asarray(valid))
-        firsts = np.asarray(firsts)           # ONE sync per group
+        # NO host sync here: the dispatch is async and the first token
+        # already lives in the device tokens buffer, which the next
+        # decode round returns as `tokens_in` — fetching `firsts` now
+        # would cost a full tunnel round-trip per admit group.  The
+        # request is live (slot assigned) with its first token OWED;
+        # pump() resolves it from the round sync (generated[0]).
         for j, request in enumerate(chunk):
-            slot = slots[j]
-            first_token = int(firsts[j])
-            request.slot = slot
-            request.generated = [first_token]
-            self._slots[slot] = request
+            request.slot = slots[j]
+            request.generated = []            # first token pending
+            self._slots[slots[j]] = request
             self.stats["prefills"] += 1
-            if self._finished(request, first_token):
-                self._retire(slot)
 
     def _finished(self, request: DecodeRequest, token: int) -> bool:
         return (self.eos_token is not None and token == self.eos_token) \
@@ -360,6 +455,39 @@ class ContinuousDecoder:
             self.logger.exception("callback failed for %s",
                                   request.request_id)
 
+    def _round_plan(self, occupied) -> tuple:
+        """(num_steps, required_t, budgets): how long to run before the
+        next host sync, the cache time-axis extent this round needs,
+        and how many tokens each slot may still emit.
+
+        num_steps is retire-aligned: with requests waiting, the round
+        ends near the earliest slot retirement so the freed slot
+        refills immediately instead of burning MXU lanes on a finished
+        request.  With an empty queue it runs to the longest remaining
+        budget — early exit would free lanes nothing is waiting for.
+        The value is pow2-CEILed (jit cache stays at log2 variants;
+        the in-scan budget mask absorbs the overshoot) — flooring
+        would instead fragment a cycle's tail into extra host syncs,
+        and a sync round-trip costs ~100 ms through a tunneled
+        device."""
+        budgets = np.zeros((self.max_slots,), np.int32)
+        max_len = 0
+        for slot in occupied:
+            request = self._slots[slot]
+            # a just-admitted slot still OWES its first token (resolved
+            # at the next round sync): its device length is current+1 —
+            # the +1 margin on required_t below covers it
+            current = len(request.prompt) + len(request.generated)
+            budgets[slot] = max(1, min(
+                request.max_new_tokens - len(request.generated),
+                self.max_seq - 1 - current))
+            max_len = max(max_len, current)
+        remaining = budgets[list(occupied)]
+        cap = int(remaining.min()) if self._pending \
+            else int(remaining.max())
+        num_steps = min(self.steps_per_sync, self._next_pow2(max(1, cap)))
+        return num_steps, max_len + num_steps + 1, budgets
+
     def pump(self) -> None:
         """One scheduling round: admit, decode K steps, retire."""
         self._admit_pending()
@@ -371,20 +499,42 @@ class ContinuousDecoder:
             if self.idle and self.on_idle is not None:
                 self.on_idle()
             return
+        occupied = [s for s in range(self.max_slots) if active[s]]
+        num_steps, required_t, budgets = self._round_plan(occupied)
+        self._fit_caches(required_t)
         self.stats["rounds"] += 1
         self.stats["occupancy_sum"] += float(active.mean())
         decode_start = time.perf_counter()
-        emitted, self._tokens, self._lengths, self._k, self._v = \
-            self._step(self.params, self._tokens, self._lengths,
-                       jnp.asarray(active), self._k, self._v,
-                       num_steps=self.steps_per_sync)
-        self.stats["steps"] += self.steps_per_sync
+        (emitted, emitted_active, tokens_in, self._tokens,
+         self._lengths, self._k, self._v) = self._step(
+            self.params, self._tokens, self._lengths,
+            jnp.asarray(active), jnp.asarray(budgets),
+            self._k, self._v, num_steps=num_steps,
+            eos=-1 if self.eos_token is None else int(self.eos_token))
+        self.stats["steps"] += num_steps
         emitted = np.asarray(emitted)            # [K, S] host sync
+        emitted_active = np.asarray(emitted_active)
+        tokens_in = np.asarray(tokens_in)
         self.stats["decode_s"] += time.perf_counter() - decode_start
+        useful = int(emitted_active[:, occupied].sum())
+        self.stats["useful_steps"] += useful
+        self.stats["wasted_steps"] += num_steps * len(occupied) - useful
+        self.stats["bytes_moved"] += num_steps * (
+            self._param_bytes + self._kv_bytes_per_t * self._cache_t)
+        # resolve deferred admits: a freshly-admitted slot's first token
+        # (prefill argmax) arrives as this round's tokens_in — no
+        # per-admit sync was paid for it
+        for slot in occupied:
+            request = self._slots[slot]
+            if request is not None and not request.generated:
+                first = int(tokens_in[slot])
+                request.generated.append(first)
+                if self._finished(request, first):
+                    self._retire(slot)
         for k in range(emitted.shape[0]):
-            for slot in range(self.max_slots):
+            for slot in occupied:
                 request = self._slots[slot]
-                if request is None:
+                if request is None or not emitted_active[k, slot]:
                     continue
                 token = int(emitted[k, slot])
                 request.generated.append(token)
@@ -392,6 +542,10 @@ class ContinuousDecoder:
                     self._retire(slot)
         if self.idle and self.on_idle is not None:
             self.on_idle()
+
+    def wasted_fraction(self) -> float:
+        total = self.stats["useful_steps"] + self.stats["wasted_steps"]
+        return self.stats["wasted_steps"] / total if total else 0.0
 
     def mean_occupancy(self) -> float:
         rounds = max(self.stats["rounds"], 1)

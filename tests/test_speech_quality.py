@@ -1,0 +1,207 @@
+# Whisper decode-quality machinery: conditioning, timestamps, and the
+# hallucination gates (reference behavior being matched:
+# examples/speech/speech_elements.py:174-250 — language/task pinning and
+# the explicit hallucination-suppression block around faster-whisper).
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.compute import ComputeRuntime
+from aiko_services_tpu.elements.speech import compression_ratio
+from aiko_services_tpu.models.whisper import (
+    LANGUAGES, SOT, TOKEN_NO_TIMESTAMPS, TOKEN_TIMESTAMP_BEGIN,
+    TOKEN_TRANSCRIBE, TOKEN_TRANSLATE, WHISPER_PRESETS,
+    greedy_decode_scored, parse_timestamp_segments, sot_sequence_for,
+    whisper_init)
+from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+
+
+def test_sot_sequence_language_and_task_tokens():
+    config = WHISPER_PRESETS["small"]
+    seq = sot_sequence_for(config, language="en", task="transcribe")
+    assert seq == (SOT, SOT + 1, TOKEN_TRANSCRIBE, TOKEN_NO_TIMESTAMPS)
+    seq = sot_sequence_for(config, language="de", task="translate",
+                           timestamps=True)
+    assert seq == (SOT, SOT + 1 + LANGUAGES.index("de"), TOKEN_TRANSLATE)
+    with pytest.raises(ValueError):
+        sot_sequence_for(config, language="xx")
+    # small-vocab presets cannot express conditioning tokens
+    with pytest.raises(ValueError):
+        sot_sequence_for(WHISPER_PRESETS["test"], language="en")
+
+
+def test_conditioning_tokens_change_decode_output():
+    """Different sot sequences must reach the decoder (not be dropped):
+    with the same audio, conditioning changes the decoded tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    config = WHISPER_PRESETS["test"]
+    params = whisper_init(jax.random.PRNGKey(0), config)
+    mel = jax.random.normal(jax.random.PRNGKey(1), (2, 64, config.n_mels))
+    mel = mel.astype(jnp.float32)
+    out_a = greedy_decode_scored(params, config, mel, max_tokens=8,
+                                 sot_sequence=(config.sot,))
+    out_b = greedy_decode_scored(params, config, mel, max_tokens=8,
+                                 sot_sequence=(config.sot, 7, 9))
+    assert not np.array_equal(np.asarray(out_a[0]), np.asarray(out_b[0]))
+
+
+def test_avg_logprob_is_finite_and_nonpositive():
+    import jax
+
+    config = WHISPER_PRESETS["test"]
+    params = whisper_init(jax.random.PRNGKey(0), config)
+    mel = jax.random.normal(jax.random.PRNGKey(2), (3, 64, config.n_mels))
+    _, _, avg_logprob = greedy_decode_scored(params, config, mel,
+                                             max_tokens=6)
+    avg_logprob = np.asarray(avg_logprob)
+    assert avg_logprob.shape == (3,)
+    assert np.all(np.isfinite(avg_logprob)) and np.all(avg_logprob <= 0)
+
+
+def test_timestamp_suppression_masks_timestamp_ids():
+    """With suppress_timestamps, no decoded id may land in the
+    timestamp range (test preset: pretend the last 32 ids are
+    timestamps by checking against a small threshold via config)."""
+    import jax
+
+    # use the real-vocab geometry scaled down in layers only: the test
+    # preset's vocab (256) is below TOKEN_TIMESTAMP_BEGIN, so the mask
+    # is a no-op there — exercise the mask arithmetic directly instead
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import whisper as W
+
+    config = WHISPER_PRESETS["test"]
+    params = whisper_init(jax.random.PRNGKey(0), config)
+    mel = jax.random.normal(jax.random.PRNGKey(3), (2, 64, config.n_mels))
+    # monkeypatch-free check: decode twice flipping the flag; with the
+    # test vocab the flag must be a no-op (identical output)
+    out_plain = greedy_decode_scored(params, config, mel, max_tokens=6)
+    out_masked = greedy_decode_scored(params, config, mel, max_tokens=6,
+                                      suppress_timestamps=True)
+    assert np.array_equal(np.asarray(out_plain[0]),
+                          np.asarray(out_masked[0]))
+
+
+def test_parse_timestamp_segments():
+    t0 = TOKEN_TIMESTAMP_BEGIN
+    # <|0.00|> hello(5 6) <|2.40|> <|2.40|> world(7) <|4.00|>
+    tokens = [t0, 5, 6, t0 + 120, t0 + 120, 7, t0 + 200]
+    segments, text_tokens = parse_timestamp_segments(tokens, len(tokens))
+    assert text_tokens == [5, 6, 7]
+    assert segments[0] == {"start": 0.0, "end": 2.4, "tokens": [5, 6]}
+    assert segments[1]["start"] == 2.4
+    assert abs(segments[1]["end"] - 4.0) < 1e-9
+    # trailing open segment keeps its tokens
+    segments, text_tokens = parse_timestamp_segments([t0 + 50, 9], 2)
+    assert segments == [{"start": 1.0, "end": None, "tokens": [9]}]
+
+
+def test_compression_ratio_flags_degenerate_repetition():
+    speechlike = "the quick brown fox jumps over the lazy dog"
+    degenerate = "again again again again again again again again " * 8
+    assert compression_ratio(speechlike) < 2.4
+    assert compression_ratio(degenerate) > 2.4
+    assert compression_ratio("") == 0.0
+
+
+_counter = [0]
+
+
+def _asr_pipeline(make_runtime, extra_parameters):
+    # unique names: several pipelines share one engine per test
+    _counter[0] += 1
+    suffix = _counter[0]
+    runtime = make_runtime(f"quality{suffix}").initialize()
+    ComputeRuntime(runtime, f"compute{suffix}")
+    extra_parameters = {"compute": f"compute{suffix}"} | extra_parameters
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_quality", "runtime": "jax",
+        "graph": ["(PE_WhisperASR)"],
+        "parameters": {
+            "PE_WhisperASR.preset": "test",
+            "PE_WhisperASR.mode": "sync",
+            "PE_WhisperASR.max_tokens": 8,
+            "PE_WhisperASR.buckets": [64],
+        } | {f"PE_WhisperASR.{k}": v
+             for k, v in extra_parameters.items()},
+        "elements": [
+            {"name": "PE_WhisperASR", "input": [{"name": "mel"}],
+             "output": [{"name": "tokens"}, {"name": "text"},
+                        {"name": "avg_logprob"}, {"name": "suppressed"},
+                        {"name": "segments"}]},
+        ],
+    })
+    return Pipeline(runtime, definition, stream_lease_time=0)
+
+
+def _run_one(pipeline, engine):
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream("s0", lease_time=0)
+    mel = np.random.default_rng(0).standard_normal(
+        (64, 80)).astype(np.float32)
+    pipeline.post("process_frame", "s0", {"mel": mel})
+    for _ in range(200):
+        if done:
+            break
+        engine.clock.advance(0.01)
+        engine.step()
+    assert done
+    return done[0].swag
+
+
+def test_element_gate_suppresses_low_logprob(make_runtime, engine):
+    """A random-weight model decodes near-uniform (~ -log V mean
+    logprob): an impossible threshold (0.0) must suppress, a permissive
+    one must not — proving the gate is wired to the measured score."""
+    swag = _run_one(_asr_pipeline(make_runtime,
+                                  {"logprob_threshold": 0.0}), engine)
+    assert swag["text"] == "" and "avg_logprob" in swag
+    assert "suppressed" in swag and "avg_logprob" in swag["suppressed"]
+
+    swag = _run_one(_asr_pipeline(make_runtime,
+                                  {"logprob_threshold": -1e9}), engine)
+    assert "suppressed" not in swag
+
+
+def test_element_gate_suppresses_degenerate_text(make_runtime, engine):
+    """Repetitive detokenized text trips the compression-ratio gate."""
+    pipeline = _asr_pipeline(make_runtime, {"logprob_threshold": -1e9,
+                                            "compression_ratio_threshold":
+                                            2.4})
+    swag = _run_one(pipeline, engine)
+    assert "suppressed" not in swag
+
+    pipeline2 = _asr_pipeline(make_runtime, {"logprob_threshold": -1e9,
+                                             "compression_ratio_threshold":
+                                             2.4})
+    element2 = next(node.element for node in pipeline2.graph.nodes()
+                    if node.name == "PE_WhisperASR")
+    # force a degenerate transcript through the detokenizer seam — the
+    # gate must fire on the TEXT the element would emit
+    done = []
+    pipeline2.add_frame_handler(done.append)
+    pipeline2.create_stream("s0", lease_time=0)
+    element2._setup()
+    element2.detokenizer = lambda tokens: "again " * 64
+    mel = np.random.default_rng(0).standard_normal(
+        (64, 80)).astype(np.float32)
+    pipeline2.post("process_frame", "s0", {"mel": mel})
+    for _ in range(200):
+        if done:
+            break
+        engine.clock.advance(0.01)
+        engine.step()
+    assert done and done[0].swag["text"] == ""
+    assert "compression_ratio" in done[0].swag["suppressed"]
+
+
+def test_element_timestamps_output_segments(make_runtime, engine):
+    """timestamps=True must emit a segments output (test vocab has no
+    real timestamp ids, so segments is a single open segment)."""
+    swag = _run_one(_asr_pipeline(make_runtime,
+                                  {"timestamps": True,
+                                   "logprob_threshold": -1e9}), engine)
+    assert "segments" in swag and isinstance(swag["segments"], list)
