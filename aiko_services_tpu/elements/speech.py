@@ -410,10 +410,13 @@ class PE_WhisperASR(PipelineElement):
                 reason = (f"compression_ratio {ratio:.2f} > "
                           f"{self.compression_threshold}")
         if reason:
-            outputs |= {"text": "", "suppressed": reason}
+            # a suppressed decode must not leak its hallucinated
+            # transcript through ANY output — text, segments, or the
+            # raw token ids a downstream detokenizer/agent would read
+            import numpy as np
+            outputs |= {"text": "", "suppressed": reason,
+                        "tokens": np.zeros((0,), np.int32)}
             if "segments" in outputs:
-                # a suppressed decode must not leak its hallucinated
-                # transcript through the segments side door either
                 outputs["segments"] = []
         else:
             outputs["text"] = text

@@ -17,7 +17,7 @@ from . import layers as L
 
 __all__ = ["LlamaConfig", "llama_init", "llama_axes", "llama_forward",
            "llama_forward_sp", "llama_decode_step", "llama_greedy_decode",
-           "init_llama_caches", "LLAMA_PRESETS"]
+           "llama_ffn", "init_llama_caches", "LLAMA_PRESETS"]
 
 
 @dataclass(frozen=True)
@@ -31,10 +31,22 @@ class LlamaConfig:
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
     dtype: object = jnp.float32
+    # num_experts > 0 swaps the dense SwiGLU FFN for a top-k
+    # mixture-of-experts layer (models/moe.py) — the Mixtral-style
+    # geometry.  Every path (prefill, SP forward, ContinuousDecoder)
+    # routes through llama_ffn, so the MoE variant serves identically.
+    num_experts: int = 0
+    top_k: int = 2
 
     @property
     def head_dim(self):
         return self.dim // self.num_heads
+
+    def moe_config(self):
+        from .moe import MoeConfig
+        return MoeConfig(dim=self.dim, ffn_dim=self.ffn_dim,
+                         num_experts=self.num_experts,
+                         top_k=self.top_k, dtype=self.dtype)
 
 
 LLAMA_PRESETS = {
@@ -45,35 +57,57 @@ LLAMA_PRESETS = {
                         num_heads=4, num_kv_heads=2, max_seq_len=128),
     "1b": LlamaConfig(vocab=128256, dim=2048, ffn_dim=8192, num_layers=16,
                       num_heads=32, num_kv_heads=8),
+    # MoE variants (Mixtral-style FFN): tiny for tests/dryrun, 8x1b as
+    # the serving-scale geometry
+    "tiny_moe": LlamaConfig(vocab=256, dim=64, ffn_dim=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=128,
+                            num_experts=4, top_k=2),
+    "8x1b": LlamaConfig(vocab=128256, dim=2048, ffn_dim=8192,
+                        num_layers=16, num_heads=32, num_kv_heads=8,
+                        num_experts=8, top_k=2),
 }
 
 
 def _layer_init(key, config: LlamaConfig):
     keys = jax.random.split(key, 4)
     dim, dtype = config.dim, config.dtype
-    return {
+    layer = {
         "ln_attn": L.rms_norm_init(dim, dtype),
         "attn": L.mha_init(keys[0], dim, config.num_heads,
                            config.num_kv_heads, bias=False, dtype=dtype),
         "ln_mlp": L.rms_norm_init(dim, dtype),
-        "gate": L.linear_init(keys[1], dim, config.ffn_dim, bias=False,
-                              dtype=dtype),
-        "up": L.linear_init(keys[2], dim, config.ffn_dim, bias=False,
-                            dtype=dtype),
-        "down": L.linear_init(keys[3], config.ffn_dim, dim, bias=False,
-                              dtype=dtype),
     }
+    if config.num_experts:
+        from .moe import moe_init
+        layer["moe"] = moe_init(keys[1], config.moe_config())
+    else:
+        layer |= {
+            "gate": L.linear_init(keys[1], dim, config.ffn_dim,
+                                  bias=False, dtype=dtype),
+            "up": L.linear_init(keys[2], dim, config.ffn_dim,
+                                bias=False, dtype=dtype),
+            "down": L.linear_init(keys[3], config.ffn_dim, dim,
+                                  bias=False, dtype=dtype),
+        }
+    return layer
 
 
-def _layer_axes():
-    return {
+def _layer_axes(config: LlamaConfig | None = None):
+    axes = {
         "ln_attn": L.rms_norm_axes(),
         "attn": L.mha_axes(bias=False),
         "ln_mlp": L.rms_norm_axes(),
-        "gate": L.linear_axes("embed", "ffn", bias=False),
-        "up": L.linear_axes("embed", "ffn", bias=False),
-        "down": L.linear_axes("ffn", "embed", bias=False),
     }
+    if config is not None and config.num_experts:
+        from .moe import moe_axes
+        axes["moe"] = moe_axes()
+    else:
+        axes |= {
+            "gate": L.linear_axes("embed", "ffn", bias=False),
+            "up": L.linear_axes("embed", "ffn", bias=False),
+            "down": L.linear_axes("ffn", "embed", bias=False),
+        }
+    return axes
 
 
 def llama_init(key, config: LlamaConfig):
@@ -92,7 +126,7 @@ def llama_init(key, config: LlamaConfig):
 def llama_axes(config: LlamaConfig):
     return {
         "embed": L.embedding_axes(),
-        "layers": [_layer_axes()] * config.num_layers,
+        "layers": [_layer_axes(config)] * config.num_layers,
         "ln_out": L.rms_norm_axes(),
         "lm_head": L.linear_axes("embed", "vocab", bias=False),
     }
@@ -126,6 +160,18 @@ def _swiglu(layer, x):
                     L.linear(layer["up"], x))
 
 
+def llama_ffn(layer, config: LlamaConfig, x):
+    """The per-layer FFN: dense SwiGLU, or top-k MoE when the config
+    says so.  Single seam shared by prefill, SP forward, and the
+    continuous-batching decode step — an MoE checkpoint serves through
+    the same machinery as a dense one."""
+    if config.num_experts:
+        from .moe import moe_forward
+        y, _ = moe_forward(layer["moe"], config.moe_config(), x)
+        return y
+    return _swiglu(layer, x)
+
+
 def llama_hidden(params, config: LlamaConfig, tokens, caches,
                  position_offset=0):
     """tokens: [B, T] → (final hidden states [B, T, dim], new_caches).
@@ -150,7 +196,7 @@ def llama_hidden(params, config: LlamaConfig, tokens, caches,
             layer, config, L.rms_norm(layer["ln_attn"], x), cos, sin,
             cache, position_offset, mask)
         x = x + attn_out
-        x = x + _swiglu(layer, L.rms_norm(layer["ln_mlp"], x))
+        x = x + llama_ffn(layer, config, L.rms_norm(layer["ln_mlp"], x))
         new_caches.append(cache)
     return L.rms_norm(params["ln_out"], x), new_caches
 
@@ -210,7 +256,7 @@ def llama_forward_sp(params, config: LlamaConfig, tokens, mesh,
                                           causal=True)
             x = x + L.linear(layer["attn"]["o"], L._merge_heads(attn))
             normed = L.rms_norm(layer["ln_mlp"], x)
-            x = x + _swiglu(layer, normed)
+            x = x + llama_ffn(layer, config, normed)
         x = L.rms_norm(params["ln_out"], x)
         return L.linear(params["lm_head"], x.astype(jnp.float32))
 

@@ -102,6 +102,13 @@ def sot_sequence_for(config: WhisperConfig, language: str | None = None,
     Language/task tokens only exist in the real multilingual vocab —
     asking for them on a small-vocab preset is an error, not a silent
     degradation."""
+    if task not in ("transcribe", "translate"):
+        raise ValueError(f"unknown task {task!r}")
+    if task == "translate" and language is None:
+        # the task token only exists alongside a language token —
+        # silently transcribing instead would be exactly the quiet
+        # degradation this function promises not to do
+        raise ValueError("task='translate' requires a language")
     sequence = [config.sot]
     if language is not None:
         if language not in LANGUAGES:
@@ -366,22 +373,29 @@ def greedy_decode_scored(params, config: WhisperConfig, mel,
     first, first_logprob = pick(logits[:, -1])
 
     def step(carry, position):
-        token, caches, done, logprob_sum, count = carry
+        # the carry token is EMITTED this iteration — its logprob
+        # (computed when it was chosen) is scored now, so the final
+        # never-emitted carry token never biases the mean
+        token, token_logprob, caches, done_before, logprob_sum, \
+            count = carry
+        logprob_sum = logprob_sum + jnp.where(done_before, 0.0,
+                                              token_logprob)
+        count = count + jnp.where(done_before, 0, 1)
+        done = done_before | (token == eot)
         logits, caches = decode_step(
             params, config, token[:, None], cross_kv, caches,
             position_offset=position)
-        next_token, logprob = pick(logits[:, -1])
+        next_token, next_logprob = pick(logits[:, -1])
         next_token = jnp.where(done, eot, next_token)
-        logprob_sum = logprob_sum + jnp.where(done, 0.0, logprob)
-        count = count + jnp.where(done, 0, 1)
-        done = done | (next_token == eot)
-        return (next_token, caches, done, logprob_sum, count), token
+        return (next_token, next_logprob, caches, done, logprob_sum,
+                count), token
 
     positions = len(sot_sequence) + jnp.arange(max_tokens)
-    done0 = first == eot
-    (_, _, done, logprob_sum, count), tokens = jax.lax.scan(
-        step, (first, caches, done0, first_logprob,
-               jnp.ones((batch,), jnp.int32)), positions)
+    (_, _, _, _, logprob_sum, count), tokens = jax.lax.scan(
+        step, (first, first_logprob, caches,
+               jnp.zeros((batch,), bool),
+               jnp.zeros((batch,), jnp.float32),
+               jnp.zeros((batch,), jnp.int32)), positions)
     tokens = jnp.moveaxis(tokens, 0, 1)            # [B, max_tokens]
     lengths = jnp.sum((tokens != eot).astype(jnp.int32), axis=1)
     return tokens, lengths, logprob_sum / jnp.maximum(count, 1)

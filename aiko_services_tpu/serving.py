@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .models import layers as L
-from .models.llama import LlamaConfig
+from .models.llama import LlamaConfig, llama_ffn
 from .utils import get_logger
 
 __all__ = ["ContinuousDecoder", "DecodeRequest"]
@@ -126,9 +126,9 @@ def _build_step(config: LlamaConfig):
             new_v.append(v_c)
             x = x + attn_out
             normed = L.rms_norm(layer["ln_mlp"], x)
-            x = x + L.linear(layer["down"],
-                             jax.nn.silu(L.linear(layer["gate"], normed)) *
-                             L.linear(layer["up"], normed))
+            # dense SwiGLU or MoE per the config — MoE llama serves
+            # through the same continuous-batching step
+            x = x + llama_ffn(layer, config, normed)
         x = L.rms_norm(params["ln_out"], x)
         # bf16 operand reads (an f32 UPCAST of the [dim, vocab] head
         # would double the step's largest weight read), f32
@@ -475,11 +475,14 @@ class ContinuousDecoder:
         for slot in occupied:
             request = self._slots[slot]
             # a just-admitted slot still OWES its first token (resolved
-            # at the next round sync): its device length is current+1 —
-            # the +1 margin on required_t below covers it
-            current = len(request.prompt) + len(request.generated)
+            # at the next round sync): account for it now or the device
+            # generates one extra token per request that the host
+            # discards — phantom "useful" work in the stats
+            owed = 0 if request.generated else 1
+            generated = len(request.generated) + owed
+            current = len(request.prompt) + generated
             budgets[slot] = max(1, min(
-                request.max_new_tokens - len(request.generated),
+                request.max_new_tokens - generated,
                 self.max_seq - 1 - current))
             max_len = max(max_len, current)
         remaining = budgets[list(occupied)]

@@ -224,18 +224,37 @@ def bench_chip_asr(config, params, batch: int):
     sustains with the host→device wire out of the picture.  The
     'chip sustains X streams' claim is measured here, not inferred.
     Walks a short batch ladder (bigger batches amortize decode-scan
-    overhead); returns the best (streams, round_s, mfu, batch)."""
+    overhead); returns the best
+    (streams, round_s, mfu, batch, phases)."""
+    from aiko_services_tpu.models.whisper import (encode,
+                                                  precompute_cross_kv)
     from aiko_services_tpu.ops.audio import (WHISPER_HOP,
                                              log_mel_spectrogram,
                                              mulaw_decode)
     samples = config.n_audio_ctx * 2 * WHISPER_HOP
     peak, _ = device_peak_flops()
 
-    def fused(params, pcm):
+    def frontend(pcm):
         audio = mulaw_decode(pcm)
         mel = log_mel_spectrogram(audio, num_mels=config.n_mels)
-        return greedy_decode(params, config, mel.astype(config.dtype),
+        return mel.astype(config.dtype)
+
+    def fused(params, pcm):
+        return greedy_decode(params, config, frontend(pcm),
                              max_tokens=MAX_TOKENS)
+
+    # phase programs return device-side SCALAR reductions: returning
+    # the real activations would ship ~100 MB per sync through the
+    # tunnel and time the wire, not the phase
+    def enc_only(params, pcm):
+        return (jnp.sum(encode(params, config, frontend(pcm)),
+                        dtype=jnp.float32),)
+
+    def enc_kv(params, pcm):
+        audio = encode(params, config, frontend(pcm))
+        kv = precompute_cross_kv(params, config, audio)
+        return (sum(jnp.sum(leaf, dtype=jnp.float32)
+                    for leaf in jax.tree_util.tree_leaves(kv)),)
 
     best = None
     for chip_batch in (batch, 2 * batch, 4 * batch):
@@ -255,10 +274,36 @@ def bench_chip_asr(config, params, batch: int):
         mfu = (flops / elapsed / peak) if (peak and flops) else None
         streams = chip_batch * CHUNK_SECONDS / elapsed
         if best is None or streams > best[0]:
-            best = (streams, elapsed, mfu, chip_batch)
+            best = (streams, elapsed, mfu, chip_batch, codes)
     if best is None:
         raise RuntimeError("no chip ASR rung completed")
-    return best
+
+    # phase decomposition at the winning batch: where do the non-MFU
+    # milliseconds go?  encoder (MXU-bound), cross-KV projection, and
+    # the autoregressive decode tail (bandwidth-bound: every token
+    # re-reads the decoder weights AND the full cross-KV)
+    streams, elapsed, mfu, chip_batch, codes = best
+    phases = {}
+    try:
+        enc_compiled = compile_with_retry(enc_only, params, codes)
+        enc_s = measure_compiled(enc_compiled, params, codes, chain=4)
+        enc_flops = compiled_flops(enc_compiled)
+        kv_compiled = compile_with_retry(enc_kv, params, codes)
+        kv_s = measure_compiled(kv_compiled, params, codes, chain=4)
+        phases = {
+            "chip_encoder_ms": round(enc_s * 1000.0, 1),
+            "chip_cross_kv_ms": round(max(0.0, kv_s - enc_s) * 1000.0,
+                                      1),
+            "chip_decode_tail_ms": round(max(0.0, elapsed - kv_s) *
+                                         1000.0, 1),
+        }
+        if peak and enc_flops:
+            phases["chip_encoder_mfu"] = round(enc_flops / enc_s / peak,
+                                               4)
+        del enc_compiled, kv_compiled
+    except Exception as exc:
+        print(f"chip asr phase split failed: {exc!r}", file=sys.stderr)
+    return streams, elapsed, mfu, chip_batch, phases
 
 
 _FRONTENDS = ("audio", "mel")
@@ -578,6 +623,7 @@ class PE_BenchImageSource:
 DETECT_IMAGE = 256
 DETECT_PRESET = os.environ.get("AIKO_BENCH_DETECT_PRESET", "detector_r18")
 DETECT_BATCH = 32
+DETECT_WIRE = os.environ.get("AIKO_BENCH_DETECT_WIRE", "dct8")
 DETECT_FRAMES = int(os.environ.get("AIKO_BENCH_DETECT_FRAMES", "512"))
 # in-flight rounds during the pipeline detect bench (uploads of rounds
 # k+1..k+d cover round k's compute + result sync on thin links)
@@ -650,6 +696,9 @@ def bench_detect():
             "PE_Detect.pipelined": True,
             "PE_Detect.max_wait": 0.05,
             "PE_Detect.max_in_flight": DEPTH,
+            # DCT wire: 4x fewer bytes over the tunnel (the r03 detect
+            # number was wire-bound at raw uint8; opt-in like mu-law)
+            "PE_Detect.wire": DETECT_WIRE,
         },
         "elements": [
             {"name": "PE_BenchImageSource", "input": [],
@@ -906,12 +955,18 @@ def bench_latency():
                           deadline_ms=LAT_DEADLINE_MS)
     bench.warmup(LAT_BATCH)
     wire_fields = {}
+    program = bench.compute.programs["whisper_asr.PE_WhisperASR"]
     for n in LAT_RUNGS:
+        # per-rung decomposition must not blend samples from warmup or
+        # earlier rungs — clear the rolling collections and snapshot
+        # cumulative counters
+        program.scheduler.recent_waits.clear()
+        program.recent_service.clear()
+        deadline_before = program.scheduler.stats["deadline_dispatches"]
         ok, p50, done, mean_batch = bench.measure(
             n, PIPELINE_SECONDS, drain_budget=2.0)
         ordered = sorted(bench._latencies) or [float("inf")]
         p95 = ordered[int(0.95 * (len(ordered) - 1))]
-        program = bench.compute.programs["whisper_asr.PE_WhisperASR"]
         waits = sorted(program.scheduler.recent_waits) or [0.0]
         queue_p50 = waits[len(waits) // 2]
         service = sorted(s for _, s in program.recent_service) or [0.0]
@@ -928,7 +983,8 @@ def bench_latency():
                 max(0.0, service_p50 - compute_chained) * 1000.0, 1),
             "lat_mean_batch": round(mean_batch, 1),
             "lat_deadline_dispatches":
-                program.scheduler.stats["deadline_dispatches"],
+                program.scheduler.stats["deadline_dispatches"] -
+                deadline_before,
             "lat_wire_budget_met": bool(
                 ok and p50 <= LATENCY_BUDGET and n >= 200),
         }
@@ -982,16 +1038,19 @@ def main() -> None:
     # (a failed section reports absent fields, not zeros — same policy
     # as detect/llama below)
     try:
-        chip_streams, chip_round, chip_mfu, chip_batch = bench_chip_asr(
-            config, params, max(model_times))
+        (chip_streams, chip_round, chip_mfu, chip_batch,
+         chip_phases) = bench_chip_asr(config, params,
+                                       max(model_times))
         print(f"chip (device-resident μ-law fused): "
               f"{chip_streams:.0f} streams @ batch {chip_batch}, "
               f"{chip_round * 1000:.0f} ms/round"
-              + (f", mfu={chip_mfu:.3f}" if chip_mfu else ""),
+              + (f", mfu={chip_mfu:.3f}" if chip_mfu else "")
+              + (f", phases={chip_phases}" if chip_phases else ""),
               file=sys.stderr)
     except Exception as exc:
         chip_streams = chip_round = chip_mfu = None
         chip_batch = 0
+        chip_phases = {}
         print(f"chip asr bench failed: {exc!r}", file=sys.stderr)
     del params
 
@@ -1119,14 +1178,15 @@ def main() -> None:
         "chip_sustained_streams": round(chip_streams, 1),
         "chip_round_ms": round(chip_round * 1000.0, 1),
         "chip_batch": chip_batch,
-    }) | ({} if model_mfu is None else {
+    } | chip_phases) | ({} if model_mfu is None else {
         "model_mfu": round(model_mfu, 4)})
       | ({} if chip_mfu is None else {
         "chip_mfu": round(chip_mfu, 4)})
       | ({} if detect_fps is None else {
         "detect_fps_per_chip": round(detect_fps, 1),
         "detect_config": f"{DETECT_PRESET}@{DETECT_IMAGE}px"
-                         f"→tracker, batch {DETECT_BATCH}",
+                         f"→tracker, batch {DETECT_BATCH}, "
+                         f"wire {DETECT_WIRE}",
     }) | ({} if detect_device_fps is None else {
         "detect_fps_device": round(detect_device_fps, 1),
         "detect_device_batch": detect_device_batch,

@@ -234,3 +234,69 @@ def test_llama_agent_batched_coalesces(make_runtime, engine):
     assert len(done) == 4
     stats = compute.programs["agent.PE_LlamaAgent"].scheduler.stats
     assert stats["items"] == 4 and stats["batches"] <= 2
+
+
+def test_dct8_wire_roundtrip_psnr():
+    """The camera-wire codec: 4x fewer bytes than raw uint8 with
+    JPEG-grade fidelity on camera-like (low-frequency) content."""
+    import numpy as np
+    from aiko_services_tpu.ops.image_wire import (dct8_decode,
+                                                  dct8_encode,
+                                                  dct8_wire_bytes)
+
+    rng = np.random.default_rng(0)
+    x = np.linspace(0, 4 * np.pi, 64)
+    img = (127 + 80 * np.sin(x)[:, None, None] *
+           np.cos(x)[None, :, None] +
+           rng.normal(0, 4, (64, 64, 3))).clip(0, 255).astype(np.uint8)
+    codes = dct8_encode(img)
+    assert codes.nbytes == dct8_wire_bytes(64, 64) == img.nbytes // 4
+    out = np.asarray(dct8_decode(codes[None], 64, 64))[0] * 255.0
+    mse = np.mean((out - img.astype(np.float64)) ** 2)
+    psnr = 10 * np.log10(255.0 ** 2 / mse)
+    assert psnr > 30.0, f"PSNR {psnr:.1f} dB too low"
+    # misaligned frames are an error, not silent corruption
+    import pytest
+    with pytest.raises(ValueError):
+        dct8_encode(img[:60])
+
+
+def test_detect_element_dct8_wire(make_runtime, engine):
+    """PE_Detect with wire=dct8 produces detections through the fused
+    dequant+iDCT+model program."""
+    import numpy as np
+    from aiko_services_tpu.compute import ComputeRuntime
+    from aiko_services_tpu.pipeline import (Pipeline,
+                                            parse_pipeline_definition)
+
+    runtime = make_runtime("detect_dct").initialize()
+    ComputeRuntime(runtime, "compute_dct")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_dct", "runtime": "jax",
+        "graph": ["(PE_Detect)"],
+        "parameters": {
+            "PE_Detect.preset": "detector_test",
+            "PE_Detect.image_size": 64,
+            "PE_Detect.mode": "sync",
+            "PE_Detect.wire": "dct8",
+            "PE_Detect.compute": "compute_dct",
+        },
+        "elements": [
+            {"name": "PE_Detect", "input": [{"name": "image"}],
+             "output": [{"name": "boxes"}, {"name": "scores"},
+                        {"name": "classes"}]},
+        ],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream("s0", lease_time=0)
+    image = np.random.default_rng(1).integers(
+        0, 255, (64, 64, 3), dtype=np.uint8)
+    pipeline.post("process_frame", "s0", {"image": image})
+    for _ in range(200):
+        if done:
+            break
+        engine.clock.advance(0.01)
+        engine.step()
+    assert done and "boxes" in done[0].swag

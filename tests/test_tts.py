@@ -92,10 +92,12 @@ def byte_durations(words):
     return durations
 
 
-def train_tts():
+def train_tts(exclude: list | None = None):
     """FastSpeech-style overfit on the ASR golden tone language: mel
     loss under TEACHER-FORCED ground-truth durations + supervised
-    log-duration loss for the duration head."""
+    log-duration loss for the duration head.  `exclude` drops one text
+    from the corpus so it can serve as held-out ground truth for the
+    objective-quality (MCD) check."""
     import optax
 
     tokenizer = ByteTokenizer()
@@ -104,6 +106,9 @@ def train_tts():
              ["alpha", "bravo"], ["bravo", "charlie"],
              ["charlie", "alpha"], ["alpha", "charlie"],
              ["bravo", "alpha"], ["charlie", "bravo"]]
+    if exclude is not None:
+        texts = [t for t in texts if t != exclude]
+        assert len(texts) == 8, f"exclude {exclude} not in corpus"
     token_rows, dur_rows, mel_rows, frame_mask, token_mask = \
         [], [], [], [], []
     for words in texts:
@@ -244,3 +249,44 @@ def test_tts_to_asr_roundtrip_text_equality(tts_params):
     text = tokenizer.decode(
         [int(t) for t in np.asarray(out_tokens)[0][:int(lengths[0])]])
     assert text.strip() == "charlie alpha", f"round trip got {text!r}"
+
+
+# -- objective quality: mel-cepstral distortion on HELD-OUT text ---------
+
+def test_tts_held_out_mcd():
+    """Non-self-referential quality metric (VERDICT r3 item 9): train
+    WITHOUT ["alpha", "charlie"], synthesize it with PREDICTED
+    durations, and measure mel-cepstral distortion against the
+    ground-truth utterance features.  The trained model must beat an
+    untrained one by a wide margin and land under an absolute bound —
+    no ASR (and no other model the repo trained) is in the loop."""
+    from aiko_services_tpu.ops.audio import mel_cepstral_distortion
+
+    held_out = ["alpha", "charlie"]
+    params = train_tts(exclude=held_out)
+    tokenizer = ByteTokenizer()
+    ids = tokenizer.encode(" ".join(held_out))[:MAX_TOKENS]
+    tokens = jnp.asarray([ids + [0] * (MAX_TOKENS - len(ids))],
+                         jnp.int32)
+    truth = np.asarray(jax.jit(log_mel_spectrogram)(
+        asr_golden.utterance(held_out)[None]))[0]
+
+    def mcd_for(p):
+        mel, total = tts_forward(p, CONFIG, tokens)
+        frames = int(np.clip(np.asarray(total)[0], 1,
+                             CONFIG.max_frames))
+        return mel_cepstral_distortion(np.asarray(mel)[0][:frames],
+                                       truth)
+
+    mcd_trained = mcd_for(params)
+    mcd_random = mcd_for(tts_init(jax.random.PRNGKey(99), CONFIG))
+    print(f"held-out MCD: trained {mcd_trained:.2f} dB vs random "
+          f"{mcd_random:.2f} dB")
+    # absolute values on this scale are inflated vs literature MCD (the
+    # whisper log-mel floor sits at log10(1e-10) in silence, so silent
+    # regions dominate the cepstral distance); the tracked regression
+    # bounds are the measured-good level (~63 dB) plus margin, and the
+    # trained/untrained separation (measured ~4x)
+    assert mcd_trained < 0.35 * mcd_random, \
+        f"trained {mcd_trained:.2f} not well under random {mcd_random:.2f}"
+    assert mcd_trained < 90.0, f"absolute MCD bound: {mcd_trained:.2f}"
