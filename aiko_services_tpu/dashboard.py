@@ -208,6 +208,31 @@ class DashboardState:
         self.runtime.publish(f"{fields.topic_path}/in", "(control_stop)")
         self.status = f"sent control_stop to {fields.name}"
 
+    # -- clipboard (reference: dashboard.py 'c' key handler) ----------------
+    def copy_topic_path(self) -> str | None:
+        """Copy the selected service's topic path to the system
+        clipboard ('c' key, as in the reference dashboard).  Tries the
+        usual clipboard tools; headless hosts still get the path in
+        the status line (and the return value) to select manually."""
+        fields = self.selected()
+        if fields is None:
+            return None
+        text = fields.topic_path
+        import shutil
+        import subprocess
+        for tool in (["wl-copy"], ["xclip", "-selection", "clipboard"],
+                     ["xsel", "--clipboard", "--input"], ["pbcopy"]):
+            if shutil.which(tool[0]):
+                try:
+                    subprocess.run(tool, input=text.encode(),
+                                   timeout=2, check=True)
+                    self.status = f"copied {text}"
+                    return text
+                except (OSError, subprocess.SubprocessError):
+                    continue
+        self.status = f"no clipboard tool; topic: {text}"
+        return text
+
     # -- log level (reference: dashboard.py:663-707 popup) ------------------
     def set_log_level(self, level: str) -> None:
         """Publish `(update log_level LEVEL)` to the selected service —
@@ -341,6 +366,8 @@ def run_dashboard(runtime, tick: float = 0.05) -> None:
                 state.open_history()
             elif key == ord("x") and state.page == "services":
                 state.kill_selected()
+            elif key == ord("c"):
+                state.copy_topic_path()
             elif state.page == "variables" and key in (
                     ord("d"), ord("i"), ord("w"), ord("e")):
                 state.set_log_level({"d": "DEBUG", "i": "INFO",

@@ -399,46 +399,103 @@ class PE_VideoUDPSend(PipelineElement):
         return FrameOutput(True, {})
 
 
+def _frame_id_newer(a: int, b: int) -> bool:
+    """True when frame id `a` is newer than `b` under u32 wraparound."""
+    return ((a - b) & 0xFFFFFFFF) < 0x80000000
+
+
 class PE_VideoUDPReceive(PipelineElement):
-    """Source: reassembles JPEG-over-UDP frames from PE_VideoUDPSend.
-    Incomplete frames (datagram loss) are dropped, not queued — live
-    semantics.  Parameter `port` (0 = ephemeral; bound port lands in the
-    EC share as `udp_port`)."""
+    """Source: reassembles JPEG-over-UDP frames from PE_VideoUDPSend
+    through a JITTER BUFFER — datagrams may arrive reordered, delayed,
+    interleaved across frames, or not at all (the reference's GStreamer
+    chain runs rtpjitterbuffer with explicit latency for the same
+    reason: gstreamer/video_stream_reader.py:22-98).
+
+    Per-frame assembly buffers tolerate cross-frame interleaving and
+    out-of-order parts; a frame older than `latency_ms` that never
+    completed is purged (counted `udp_incomplete`), and a frame that
+    completes AFTER a newer frame was already delivered is dropped
+    (`udp_late`) — live semantics never step backwards.  Parameter
+    `port` (0 = ephemeral; bound port lands in the EC share as
+    `udp_port`)."""
 
     def start_stream(self, stream) -> None:
         port, _ = self.get_parameter("port", 0, stream)
+        latency_ms, _ = self.get_parameter("latency_ms", 50.0, stream)
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         sock.bind(("0.0.0.0", int(port)))
         sock.settimeout(0.25)
-        state = {"socket": sock, "stop": False, "latest": None}
+        state = {"socket": sock, "stop": False, "latest": None,
+                 "stats": {"complete": 0, "incomplete": 0, "late": 0}}
         stream.variables[f"{self.definition.name}.state"] = state
         self.ec_producer.update("udp_port", sock.getsockname()[1])
+        window = float(latency_ms) / 1000.0
 
         def receive_loop():
-            parts: dict = {}
-            current = -1
+            import time as _time
+            pending: dict = {}       # frame_id -> {parts, count, t0}
+            delivered = None         # newest frame_id handed over
+            stale_run = 0            # consecutive not-newer datagrams
             while not state["stop"]:
                 try:
                     datagram = sock.recv(65535)
                 except socket.timeout:
-                    continue
+                    datagram = None
                 except OSError:
                     return
-                if len(datagram) < _UDP_HEADER.size:
-                    continue
-                frame_id, part, count = _UDP_HEADER.unpack(
-                    datagram[:_UDP_HEADER.size])
-                if frame_id != current:
-                    parts = {}
-                    current = frame_id
-                parts[part] = datagram[_UDP_HEADER.size:]
-                if len(parts) == count:
-                    data = b"".join(parts[i] for i in range(count))
-                    try:
-                        state["latest"] = decode_jpeg(data)
-                    except ValueError:
-                        pass
-                    parts = {}
+                now = _time.monotonic()
+                if datagram is not None and \
+                        len(datagram) >= _UDP_HEADER.size:
+                    frame_id, part, count = _UDP_HEADER.unpack(
+                        datagram[:_UDP_HEADER.size])
+                    stale = delivered is not None and (
+                        frame_id == delivered or
+                        not _frame_id_newer(frame_id, delivered))
+                    if stale:
+                        state["stats"]["late"] += 1
+                        stale_run += 1
+                        # a RESTARTED sender counts from 1 again — a
+                        # large backwards jump, or a sustained run of
+                        # "late" traffic, is a new stream, not jitter;
+                        # resync instead of freezing until the new ids
+                        # catch up (the pre-jitter-buffer code resynced
+                        # on any id change)
+                        backwards = (delivered - frame_id) & 0xFFFFFFFF
+                        if backwards > 4096 or stale_run > 32:
+                            delivered = None
+                            pending.clear()
+                            stale_run = 0
+                    else:
+                        stale_run = 0
+                        entry = pending.setdefault(
+                            frame_id, {"parts": {}, "count": count,
+                                       "t0": now})
+                        entry["parts"][part] = \
+                            datagram[_UDP_HEADER.size:]
+                        if len(entry["parts"]) == entry["count"]:
+                            data = b"".join(
+                                entry["parts"][i]
+                                for i in range(entry["count"]))
+                            del pending[frame_id]
+                            try:
+                                state["latest"] = decode_jpeg(data)
+                                state["stats"]["complete"] += 1
+                                delivered = frame_id
+                                # frames older than the delivered one
+                                # can never be shown — purge them
+                                for stale in [f for f in pending
+                                              if not _frame_id_newer(
+                                                  f, frame_id)]:
+                                    del pending[stale]
+                                    state["stats"]["incomplete"] += 1
+                            except ValueError:
+                                state["stats"]["incomplete"] += 1
+                # age out frames whose missing parts exceeded the
+                # jitter window — they are loss, not jitter
+                for stale in [f for f, e in pending.items()
+                              if now - e["t0"] > window]:
+                    del pending[stale]
+                    state["stats"]["incomplete"] += 1
 
         state["thread"] = threading.Thread(
             target=receive_loop, name=f"{self.name}.udp", daemon=True)
@@ -451,6 +508,10 @@ class PE_VideoUDPReceive(PipelineElement):
             if latest is not None:
                 state["latest"] = None
                 self.create_frame(stream, {"image": latest})
+            for key, value in state["stats"].items():
+                share_key = f"udp_{key}"
+                if self.ec_producer.get(share_key) != value:
+                    self.ec_producer.update(share_key, value)
 
         state["timer"] = self.runtime.event.add_timer_handler(
             tick, 1.0 / float(rate))

@@ -378,3 +378,83 @@ def test_bootstrap_discovery_loopback():
         assert result == ("broker.local", 1883)
     finally:
         responder.stop()
+
+
+def test_cli_element_flag_parsing():
+    """Autogenerated per-element flags (reference discoverable-flags
+    UX, aiko_services/cli.py:96-206): exact and kebab spellings parse
+    into stream parameters; unknown flags name the elements."""
+    import click
+    import pytest
+    from aiko_services_tpu.cli import parse_element_flags
+    from aiko_services_tpu.pipeline import parse_pipeline_definition
+
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p", "runtime": "python",
+        "graph": ["(PE_WhisperASR)"],
+        "parameters": {"PE_WhisperASR.max_tokens": 24},
+        "elements": [{"name": "PE_WhisperASR",
+                      "input": [{"name": "audio"}],
+                      "output": [{"name": "text"}]}],
+    })
+    overrides = parse_element_flags(
+        definition, ["--PE_WhisperASR.max_tokens", "8",
+                     "--pe-whisper-asr-wire=int16",
+                     "--pe_whisper_asr-max-wait", "0.25"])
+    assert overrides == {"PE_WhisperASR.max_tokens": 8,
+                         "PE_WhisperASR.wire": "int16",
+                         "PE_WhisperASR.max_wait": 0.25}
+    with pytest.raises(click.ClickException):
+        parse_element_flags(definition, ["--PE_Nope.x", "1"])
+    with pytest.raises(click.ClickException):
+        parse_element_flags(definition, ["--PE_WhisperASR.x"])
+
+
+def test_cli_pipeline_params_lists_flags():
+    runner = CliRunner()
+    result = runner.invoke(cli_main, [
+        "pipeline", "params", "examples/pipeline/pipeline_local.json"])
+    assert result.exit_code == 0, result.output
+    assert "PE_1" in result.output
+    assert "--" in result.output
+
+
+def test_dashboard_copy_topic_path(make_runtime, engine):
+    """'c' copies the selected topic path (reference dashboard's
+    clipboard handler); headless hosts still surface it in status."""
+    reg_rt = make_runtime("copy_reg").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+    app_rt = make_runtime("copy_app").initialize()
+    Actor(app_rt, "copyme", share={})
+    state = DashboardState(make_runtime("copy_dash").initialize())
+    settle(engine, 15)
+    state.selected_index = [f.name for f in state.services()].index(
+        "copyme")
+    text = state.copy_topic_path()
+    assert text == state.selected().topic_path
+    assert text in state.status
+    state.terminate()
+
+
+def test_cli_element_flag_longest_prefix_wins():
+    """PE_Microphone must not capture PE_MicrophoneSim's kebab flags."""
+    from aiko_services_tpu.cli import parse_element_flags
+    from aiko_services_tpu.pipeline import parse_pipeline_definition
+
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p", "runtime": "python",
+        "graph": ["(PE_Microphone (PE_MicrophoneSim))"],
+        "elements": [
+            {"name": "PE_Microphone", "input": [],
+             "output": [{"name": "audio"}]},
+            {"name": "PE_MicrophoneSim", "input": [{"name": "audio"}],
+             "output": [{"name": "audio2"}]},
+        ],
+    })
+    overrides = parse_element_flags(
+        definition, ["--pe-microphone-sim-rate", "10",
+                     "--pe-microphone-rate", "20"])
+    assert overrides == {"PE_MicrophoneSim.rate": 10,
+                         "PE_Microphone.rate": 20}

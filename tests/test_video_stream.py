@@ -312,3 +312,165 @@ def test_h264_write_open_failure_reports_and_recovers(make_runtime,
     pipeline.destroy_stream("good")
     import os
     assert os.path.getsize(good) > 0
+
+
+def test_udp_receive_survives_loss_reorder_and_interleaving(
+        make_runtime, engine):
+    """Lossy-network ingest robustness (reference runs rtpjitterbuffer
+    for this: gstreamer/video_stream_reader.py:22-98): datagrams
+    reordered within a frame, interleaved across frames, lost parts,
+    and a stale late frame — complete frames still deliver, losses are
+    counted, playback never steps backwards."""
+    import socket as _socket
+
+    from aiko_services_tpu.elements.video_stream import (_UDP_HEADER,
+                                                         encode_jpeg)
+    from aiko_services_tpu.pipeline import FrameOutput, PipelineElement
+
+    runtime = make_runtime("udp_lossy").initialize()
+    received = []
+
+    class PE_Collect(PipelineElement):
+        def process_frame(self, frame, image=None, **_):
+            received.append(np.asarray(image))
+            return FrameOutput(True, {})
+
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_rx2", "runtime": "python",
+        "graph": ["(PE_VideoUDPReceive (PE_Collect))"],
+        "parameters": {"PE_VideoUDPReceive.rate": 100.0,
+                       "PE_VideoUDPReceive.latency_ms": 200.0},
+        "elements": [
+            element("PE_VideoUDPReceive", [], ["image"]),
+            element("PE_Collect", ["image"], []),
+        ],
+    })
+    receiver = Pipeline(runtime, definition,
+                        element_classes={"PE_Collect": PE_Collect},
+                        stream_lease_time=0)
+    receiver.create_stream("rx", lease_time=0)
+    rx_element = receiver.graph.node("PE_VideoUDPReceive").element
+    port = int(rx_element.ec_producer.get("udp_port"))
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    address = ("127.0.0.1", port)
+
+    def parts_for(frame_id, image, chunk=1000):
+        payload = encode_jpeg(image, 80)
+        chunks = [payload[i:i + chunk]
+                  for i in range(0, len(payload), chunk)]
+        return [(_UDP_HEADER.pack(frame_id, part, len(chunks)) + data)
+                for part, data in enumerate(chunks)]
+
+    rng = np.random.default_rng(3)
+    img1 = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    img2 = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    f1 = parts_for(1, img1)
+    f2 = parts_for(2, img2)
+    f3 = parts_for(3, img1)
+    assert len(f1) >= 3, "need multi-part frames for this test"
+
+    def pump_until(count, budget=10.0):
+        deadline = time.monotonic() + budget
+        while len(received) < count and time.monotonic() < deadline:
+            engine.clock.advance(0.01)
+            engine.step()
+            time.sleep(0.005)
+        assert len(received) >= count, \
+            f"{len(received)}/{count} frames delivered"
+
+    # frame 1 fully REVERSED (reorder within a frame) with frame 2's
+    # early parts INTERLEAVED between them (cross-frame interleaving);
+    # frame 2's final part held back so each completion is observed
+    # (the tick is latest-wins); frame 3 loses a part (never completes)
+    wire = []
+    for a, b in zip(reversed(f1), f2[:-1]):
+        wire += [a, b]
+    wire += f1[::-1][len(f2) - 1:] + f2[len(f1):-1]
+    for datagram in wire:
+        sock.sendto(datagram, address)
+    pump_until(1)                   # frame 1 assembled from chaos
+    sock.sendto(f2[-1], address)
+    pump_until(2)                   # frame 2 completes after its tail
+    for datagram in f3[:-1]:
+        sock.sendto(datagram, address)
+
+    # a LATE stale frame (id 1 again) must not be assembled or shown
+    for datagram in parts_for(1, img2):
+        sock.sendto(datagram, address)
+    time.sleep(0.3)
+    before = len(received)
+    for _ in range(20):
+        engine.clock.advance(0.01)
+        engine.step()
+    state = receiver.streams["rx"].variables[
+        "PE_VideoUDPReceive.state"]
+    assert state["stats"]["complete"] == 2
+    assert state["stats"]["late"] >= 1
+    # frame 3 purges once its jitter window expires
+    deadline = time.monotonic() + 2.0
+    while state["stats"]["incomplete"] < 1 and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert state["stats"]["incomplete"] >= 1
+    assert len(received) == before                 # no backwards step
+    receiver.destroy_stream("rx")
+    sock.close()
+
+
+def test_udp_receive_resyncs_after_sender_restart(make_runtime, engine):
+    """A restarted sender counts frame ids from 1 again; the jitter
+    buffer must resync (large backwards jump) instead of dropping the
+    new stream as 'late' until ids catch up."""
+    import socket as _socket
+
+    from aiko_services_tpu.elements.video_stream import (_UDP_HEADER,
+                                                         encode_jpeg)
+    from aiko_services_tpu.pipeline import FrameOutput, PipelineElement
+
+    runtime = make_runtime("udp_restart").initialize()
+    received = []
+
+    class PE_Collect(PipelineElement):
+        def process_frame(self, frame, image=None, **_):
+            received.append(np.asarray(image))
+            return FrameOutput(True, {})
+
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_rx3", "runtime": "python",
+        "graph": ["(PE_VideoUDPReceive (PE_Collect))"],
+        "parameters": {"PE_VideoUDPReceive.rate": 100.0},
+        "elements": [
+            element("PE_VideoUDPReceive", [], ["image"]),
+            element("PE_Collect", ["image"], []),
+        ],
+    })
+    receiver = Pipeline(runtime, definition,
+                        element_classes={"PE_Collect": PE_Collect},
+                        stream_lease_time=0)
+    receiver.create_stream("rx", lease_time=0)
+    rx_element = receiver.graph.node("PE_VideoUDPReceive").element
+    port = int(rx_element.ec_producer.get("udp_port"))
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    address = ("127.0.0.1", port)
+    img = np.random.default_rng(5).integers(0, 255, (32, 32, 3),
+                                            dtype=np.uint8)
+
+    def send_frame(frame_id):
+        payload = encode_jpeg(img, 80)
+        sock.sendto(_UDP_HEADER.pack(frame_id, 0, 1) + payload, address)
+
+    def pump_until(count):
+        deadline = time.monotonic() + 10.0
+        while len(received) < count and time.monotonic() < deadline:
+            engine.clock.advance(0.01)
+            engine.step()
+            time.sleep(0.005)
+        assert len(received) >= count, f"{len(received)}/{count}"
+
+    send_frame(50_000)               # long-running sender
+    pump_until(1)
+    send_frame(1)                    # restarted sender: id resets
+    send_frame(2)                    # first id after resync delivers
+    pump_until(2)
+    receiver.destroy_stream("rx")
+    sock.close()
