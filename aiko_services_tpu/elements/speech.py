@@ -252,6 +252,25 @@ class PE_WhisperASR(PipelineElement):
         # sized max_tokens+8 above and the longest prompt is 4 tokens
         assert len(sot_sequence) + max_tokens <= self.config.n_text_ctx
 
+        # pp_stages >= 2: TRUE pipeline parallelism over device groups —
+        # the mel+encoder stage runs on one group, the autoregressive
+        # decode stage on another (StagedExecutor), with batch k+1
+        # encoding while batch k decodes.  The compute program's
+        # in_flight peak (EC share) is the measured overlap.  Each
+        # stage carries only ITS OWN param subtree (encoder weights on
+        # stage 0, decoder on stage 1), built once and shared by every
+        # bucket's executor — not a full-model copy per bucket/stage.
+        pp_stages, _ = self.get_parameter("pp_stages", 0)
+        pp_stages = int(pp_stages)
+        if pp_stages >= 2:
+            self._stage_params = (
+                {k: self.params[k]
+                 for k in ("conv1", "conv2", "enc_blocks", "ln_enc")},
+                {k: self.params[k]
+                 for k in ("tok_embed", "pos_embed", "dec_blocks",
+                           "ln_dec")},
+            )
+
         def make_fn(bucket):
             import dataclasses
             config = dataclasses.replace(
@@ -259,24 +278,54 @@ class PE_WhisperASR(PipelineElement):
             decode_kwargs = dict(max_tokens=max_tokens,
                                  sot_sequence=sot_sequence,
                                  suppress_timestamps=not self.timestamps)
-            if audio_frontend:
-                from ..ops.audio import log_mel_spectrogram, mulaw_decode
 
-                def fused(params, pcm):
-                    # wire codes expand to float on device: the host
-                    # does no per-frame feature work at all
-                    if wire == "mulaw":
-                        audio = mulaw_decode(pcm)
-                    else:
-                        audio = pcm.astype(jnp.float32) / 32768.0
-                    mel = log_mel_spectrogram(
-                        audio, num_mels=config.n_mels)
-                    return greedy_decode_scored(
-                        params, config, mel.astype(config.dtype),
-                        **decode_kwargs)
-                return jax.jit(fused)
-            return jax.jit(lambda params, mel: greedy_decode_scored(
-                params, config, mel, **decode_kwargs))
+            def to_mel(payload):
+                if not audio_frontend:
+                    return payload
+                from ..ops.audio import (log_mel_spectrogram,
+                                         mulaw_decode)
+                if wire == "mulaw":
+                    audio = mulaw_decode(payload)
+                else:
+                    audio = payload.astype(jnp.float32) / 32768.0
+                return log_mel_spectrogram(
+                    audio, num_mels=config.n_mels).astype(config.dtype)
+
+            if pp_stages >= 2:
+                from ..models.whisper import (encode,
+                                              greedy_decode_from_audio)
+                from ..parallel.pipeline_parallel import StagedExecutor
+
+                def stage_encode(params, payload):
+                    return encode(params, config,
+                                  to_mel(payload).astype(config.dtype))
+
+                def stage_decode(params, audio):
+                    return greedy_decode_from_audio(params, config,
+                                                    audio,
+                                                    **decode_kwargs)
+
+                executor = StagedExecutor(
+                    [(stage_encode, self._stage_params[0]),
+                     (stage_decode, self._stage_params[1])])
+
+                def run_staged(_params, batch):
+                    y = executor.submit(batch)
+                    # occupancy here is tracked by the compute
+                    # program's in_flight (split() retires there, not
+                    # through executor.collect) — undo submit's count
+                    # so the executor's gauge can't drift upward
+                    executor.in_flight -= 1
+                    return y
+                return run_staged
+
+            def fused(params, payload):
+                # wire codes expand to float on device: the host does
+                # no per-frame feature work at all
+                return greedy_decode_scored(
+                    params, config, to_mel(payload).astype(config.dtype),
+                    **decode_kwargs)
+            return jax.jit(fused)
 
         def run_bucket(bucket, batch):
             if bucket not in per_bucket_config:

@@ -435,7 +435,8 @@ class PE_VideoUDPReceive(PipelineElement):
             import time as _time
             pending: dict = {}       # frame_id -> {parts, count, t0}
             delivered = None         # newest frame_id handed over
-            stale_run = 0            # consecutive not-newer datagrams
+            stale_run = 0            # consecutive not-newer FRAMES
+            last_stale = None
             while not state["stop"]:
                 try:
                     datagram = sock.recv(65535)
@@ -448,12 +449,23 @@ class PE_VideoUDPReceive(PipelineElement):
                         len(datagram) >= _UDP_HEADER.size:
                     frame_id, part, count = _UDP_HEADER.unpack(
                         datagram[:_UDP_HEADER.size])
+                    if count == 0 or part >= count:
+                        # corrupt/hostile header: an out-of-range part
+                        # would satisfy the length==count completion
+                        # check while leaving a hole for the join
+                        state["stats"]["incomplete"] += 1
+                        continue
                     stale = delivered is not None and (
                         frame_id == delivered or
                         not _frame_id_newer(frame_id, delivered))
                     if stale:
                         state["stats"]["late"] += 1
-                        stale_run += 1
+                        # count stale FRAMES, not datagrams: one late
+                        # multi-part frame must not masquerade as a
+                        # sender restart
+                        if frame_id != last_stale:
+                            stale_run += 1
+                            last_stale = frame_id
                         # a RESTARTED sender counts from 1 again — a
                         # large backwards jump, or a sustained run of
                         # "late" traffic, is a new stream, not jitter;
@@ -465,11 +477,17 @@ class PE_VideoUDPReceive(PipelineElement):
                             delivered = None
                             pending.clear()
                             stale_run = 0
+                            last_stale = None
                     else:
                         stale_run = 0
+                        last_stale = None
                         entry = pending.setdefault(
                             frame_id, {"parts": {}, "count": count,
                                        "t0": now})
+                        if part >= entry["count"]:
+                            # headers disagree across datagrams of one
+                            # frame id — drop rather than corrupt
+                            continue
                         entry["parts"][part] = \
                             datagram[_UDP_HEADER.size:]
                         if len(entry["parts"]) == entry["count"]:

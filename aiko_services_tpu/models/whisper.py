@@ -24,8 +24,8 @@ from . import layers as L
 
 __all__ = ["WhisperConfig", "whisper_init", "whisper_axes", "encode",
            "decode_step", "greedy_decode", "greedy_decode_scored",
-           "forward", "WHISPER_PRESETS", "sot_sequence_for",
-           "parse_timestamp_segments", "LANGUAGES"]
+           "greedy_decode_from_audio", "forward", "WHISPER_PRESETS",
+           "sot_sequence_for", "parse_timestamp_segments", "LANGUAGES"]
 
 
 @dataclass(frozen=True)
@@ -333,6 +333,18 @@ def greedy_decode_scored(params, config: WhisperConfig, mel,
     openai/whisper) — the hallucination gate's first input.
     suppress_timestamps masks ids >= TOKEN_TIMESTAMP_BEGIN out of the
     argmax (the <|notimestamps|> decode mode)."""
+    return greedy_decode_from_audio(
+        params, config, encode(params, config, mel), max_tokens,
+        sot_sequence, suppress_timestamps)
+
+
+def greedy_decode_from_audio(params, config: WhisperConfig, audio,
+                             max_tokens: int = 64, sot_sequence=None,
+                             suppress_timestamps: bool = False):
+    """greedy_decode_scored from already-encoded audio features
+    [B, n_audio_ctx, dim] — the pipeline-parallel stage boundary: an
+    encoder stage on one device group hands features to a decode stage
+    on another (parallel/pipeline_parallel.StagedExecutor)."""
     if sot_sequence is None:
         sot_sequence = (config.sot,)
     eot = config.eot
@@ -347,8 +359,7 @@ def greedy_decode_scored(params, config: WhisperConfig, mel,
             f"sot({len(sot_sequence)}) + max_tokens({max_tokens}) exceeds "
             f"n_text_ctx({config.n_text_ctx}): positions past the table "
             f"would silently clamp")
-    batch = mel.shape[0]
-    audio = encode(params, config, mel)
+    batch = audio.shape[0]
     cross_kv = precompute_cross_kv(params, config, audio)
     caches = init_caches(config, batch, max_len=total)
 

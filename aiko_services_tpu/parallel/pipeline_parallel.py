@@ -86,10 +86,12 @@ class StagedExecutor:
     @staticmethod
     def result(y):
         """Block for a submitted frame's value (host numpy) without
-        touching occupancy bookkeeping."""
+        touching occupancy bookkeeping.  Stage outputs may be pytrees
+        (e.g. a decode stage's (tokens, lengths, scores))."""
+        import jax
         import numpy as np
 
-        return np.asarray(y)
+        return jax.tree.map(np.asarray, y)
 
     def map(self, frames):
         """Pipeline a sequence: submit everything (filling all stages),

@@ -571,7 +571,11 @@ def bench_pipeline(bench, capacity: float, drain_budget: float = 2.0):
     tunnel-honest, absolute p50 is not."""
     last = None
     attempts: dict = {}
-    for fraction in (1.5, 1.25, 1.05, 0.9, 0.75, 0.6, 0.45):
+    # the ladder starts well ABOVE the serial floor: depth-4 overlap
+    # hides most of the wire, so sustained capacity routinely beats the
+    # serial estimate (r4: the old 1.5x top rung passed on its first
+    # attempt — the ladder was the binding constraint, not the chip)
+    for fraction in (2.2, 1.85, 1.5, 1.25, 1.05, 0.9, 0.75, 0.6, 0.45):
         n = max(1, int(capacity * fraction))
         attempts[n] = attempts.get(n, 0) + 1
         ok, p50, frames, mean_batch = bench.measure(
