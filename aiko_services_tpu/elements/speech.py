@@ -205,6 +205,14 @@ class PE_WhisperASR(PipelineElement):
         compression_threshold, _ = self.get_parameter(
             "compression_ratio_threshold", 2.4)
         self.compression_threshold = float(compression_threshold)
+        # int8 cross-attention KV (opt-in): halves the cross-KV's HBM
+        # FOOTPRINT (a capacity lever for bigger batches); transcript
+        # parity holds on the golden model.  NOT a throughput win in
+        # the fused program — XLA re-materializes the dequantized KV
+        # per decode step (measured ~24% slower at batch 256), so
+        # enable it for memory, not speed.
+        kv_quant, _ = self.get_parameter("kv_quant", False)
+        self.kv_quant = parse_bool(kv_quant, False)
 
         compute_name, _ = self.get_parameter("compute", "compute")
         self.compute = self.runtime.service_by_name(compute_name)
@@ -277,7 +285,8 @@ class PE_WhisperASR(PipelineElement):
                 self.config, n_audio_ctx=bucket // 2)
             decode_kwargs = dict(max_tokens=max_tokens,
                                  sot_sequence=sot_sequence,
-                                 suppress_timestamps=not self.timestamps)
+                                 suppress_timestamps=not self.timestamps,
+                                 kv_quant=self.kv_quant)
 
             def to_mel(payload):
                 if not audio_frontend:

@@ -210,6 +210,30 @@ def precompute_kv(params, kv_input, num_kv_heads: int):
     return k, v
 
 
+def quantize_kv(tensor):
+    """Per-position symmetric int8 quantization of a K or V tensor
+    [..., T, D] (scale over the last axis).  Halves the HBM FOOTPRINT
+    of a precomputed KV cache (sub-1% error, golden-transcript parity
+    tested) — a capacity lever.  Measured caveat: in an isolated
+    cross-attention scan the int8 read is ~35% faster, but inside the
+    full whisper decode program XLA re-materializes the dequantized
+    bf16 KV per step and throughput LOSES ~24%; treat it as memory
+    compression, not acceleration.  Returns {"q": int8, "s": scale}."""
+    scale = (jnp.max(jnp.abs(tensor), axis=-1, keepdims=True)
+             .astype(jnp.float32) / 127.0 + 1e-12).astype(jnp.bfloat16)
+    q = jnp.clip(jnp.round(tensor.astype(jnp.float32) /
+                           scale.astype(jnp.float32)),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def dequantize_kv(kv, dtype):
+    """Inverse of quantize_kv; passes plain arrays through."""
+    if isinstance(kv, dict) and "q" in kv:
+        return (kv["q"].astype(dtype) * kv["s"].astype(dtype))
+    return kv
+
+
 def mha(params, x, kv_input=None, mask=None, cache=None,
         num_heads: int = 8, num_kv_heads: int | None = None,
         qk_transform=None, precomputed_kv=None, fused: bool = True):
@@ -225,6 +249,8 @@ def mha(params, x, kv_input=None, mask=None, cache=None,
     q = _split_heads(linear(params["q"], x), num_heads)
     if precomputed_kv is not None:
         k, v = precomputed_kv
+        k = dequantize_kv(k, x.dtype)
+        v = dequantize_kv(v, x.dtype)
     else:
         k, v = precompute_kv(params, x if kv_input is None else kv_input,
                              num_kv_heads)

@@ -258,12 +258,20 @@ def _decoder_block(block, x, cross_kv, num_heads, self_cache, mask):
     return x + _mlp(block, L.layer_norm(block["ln_mlp"], x)), self_cache
 
 
-def precompute_cross_kv(params, config: WhisperConfig, audio):
+def precompute_cross_kv(params, config: WhisperConfig, audio,
+                        quantize: bool = False):
     """Project every decoder block's cross-attention K/V over the audio
     features ONCE per utterance — the decode loop then only projects Q
-    (recomputing these per token was pure wasted MXU work)."""
-    return [L.precompute_kv(block["cross"], audio, config.num_heads)
-            for block in params["dec_blocks"]]
+    (recomputing these per token was pure wasted MXU work).
+
+    quantize=True stores them int8 with per-position scales
+    (layers.quantize_kv) — half the HBM footprint; see quantize_kv's
+    measured throughput caveat before enabling it for speed."""
+    kv = [L.precompute_kv(block["cross"], audio, config.num_heads)
+          for block in params["dec_blocks"]]
+    if quantize:
+        kv = [(L.quantize_kv(k), L.quantize_kv(v)) for k, v in kv]
+    return kv
 
 
 def init_caches(config: WhisperConfig, batch: int,
@@ -307,20 +315,22 @@ def decode_step(params, config: WhisperConfig, tokens, cross_kv, caches,
 
 
 def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
-                  sot_sequence=None, suppress_timestamps: bool = False):
+                  sot_sequence=None, suppress_timestamps: bool = False,
+                  kv_quant: bool = False):
     """Batched greedy decoding as one compiled program.
 
     mel: [B, T_frames, n_mels] → (tokens [B, max_tokens], lengths [B]).
     See greedy_decode_scored for the scored variant."""
     tokens, lengths, _ = greedy_decode_scored(
         params, config, mel, max_tokens, sot_sequence,
-        suppress_timestamps)
+        suppress_timestamps, kv_quant)
     return tokens, lengths
 
 
 def greedy_decode_scored(params, config: WhisperConfig, mel,
                          max_tokens: int = 64, sot_sequence=None,
-                         suppress_timestamps: bool = False):
+                         suppress_timestamps: bool = False,
+                         kv_quant: bool = False):
     """Batched greedy decoding with per-sequence quality scores.
 
     mel: [B, T_frames, n_mels] →
@@ -335,12 +345,13 @@ def greedy_decode_scored(params, config: WhisperConfig, mel,
     argmax (the <|notimestamps|> decode mode)."""
     return greedy_decode_from_audio(
         params, config, encode(params, config, mel), max_tokens,
-        sot_sequence, suppress_timestamps)
+        sot_sequence, suppress_timestamps, kv_quant)
 
 
 def greedy_decode_from_audio(params, config: WhisperConfig, audio,
                              max_tokens: int = 64, sot_sequence=None,
-                             suppress_timestamps: bool = False):
+                             suppress_timestamps: bool = False,
+                             kv_quant: bool = False):
     """greedy_decode_scored from already-encoded audio features
     [B, n_audio_ctx, dim] — the pipeline-parallel stage boundary: an
     encoder stage on one device group hands features to a decode stage
@@ -360,7 +371,8 @@ def greedy_decode_from_audio(params, config: WhisperConfig, audio,
             f"n_text_ctx({config.n_text_ctx}): positions past the table "
             f"would silently clamp")
     batch = audio.shape[0]
-    cross_kv = precompute_cross_kv(params, config, audio)
+    cross_kv = precompute_cross_kv(params, config, audio,
+                                   quantize=kv_quant)
     caches = init_caches(config, batch, max_len=total)
 
     if suppress_timestamps and TOKEN_TIMESTAMP_BEGIN < config.n_vocab:

@@ -70,6 +70,13 @@ REPEATS = 8
 # env overrides so the harness can smoke-test on CPU (preset=test)
 PRESET = os.environ.get("AIKO_BENCH_PRESET", "small")
 PIPELINE_SECONDS = float(os.environ.get("AIKO_BENCH_WINDOW", "12"))
+# int8 cross-attention KV (layers.quantize_kv) — OFF by default: in an
+# isolated cross-attention microbenchmark the int8 read is ~35% faster,
+# but inside the full fused program XLA re-materializes the dequantized
+# bf16 KV each scan step (measured 512 vs 414 ms/round @ batch 256), a
+# net loss.  The switch stays for memory-capacity experiments
+# (AIKO_BENCH_KV_QUANT=1 halves cross-KV HBM).
+KV_QUANT = os.environ.get("AIKO_BENCH_KV_QUANT", "0") == "1"
 
 
 def model_config(frames: int) -> WhisperConfig:
@@ -182,7 +189,8 @@ def measure_model(config, params, batch: int):
                             (batch, frames, config.n_mels), jnp.bfloat16)
     compiled = compile_with_retry(
         lambda params, mel: greedy_decode(
-            params, config, mel, max_tokens=MAX_TOKENS), params, mel)
+            params, config, mel, max_tokens=MAX_TOKENS,
+            kv_quant=KV_QUANT), params, mel)
     return measure_compiled(compiled, params, mel), \
         compiled_flops(compiled)
 
@@ -241,7 +249,7 @@ def bench_chip_asr(config, params, batch: int):
 
     def fused(params, pcm):
         return greedy_decode(params, config, frontend(pcm),
-                             max_tokens=MAX_TOKENS)
+                             max_tokens=MAX_TOKENS, kv_quant=KV_QUANT)
 
     # phase programs return device-side SCALAR reductions: returning
     # the real activations would ship ~100 MB per sync through the
@@ -252,7 +260,8 @@ def bench_chip_asr(config, params, batch: int):
 
     def enc_kv(params, pcm):
         audio = encode(params, config, frontend(pcm))
-        kv = precompute_cross_kv(params, config, audio)
+        kv = precompute_cross_kv(params, config, audio,
+                                 quantize=KV_QUANT)
         return (sum(jnp.sum(leaf, dtype=jnp.float32)
                     for leaf in jax.tree_util.tree_leaves(kv)),)
 
@@ -361,6 +370,7 @@ def pipeline_definition(batch: int, frontend: str = "mel",
         "PE_WhisperASR.buckets": [frames],
         "PE_WhisperASR.max_batch": batch,
         "PE_WhisperASR.deadline_ms": deadline_ms,
+        "PE_WhisperASR.kv_quant": KV_QUANT,
         # pad_batch means the device ALWAYS runs the full batch shape —
         # firing sparse batches wastes lanes, so the wait is tuned to
         # roughly one device round (latency here is tunnel-dominated
@@ -906,7 +916,7 @@ def bench_latency():
         audio = mulaw_decode(pcm)
         mel = log_mel_spectrogram(audio, num_mels=config.n_mels)
         return greedy_decode(params, config, mel.astype(config.dtype),
-                             max_tokens=LAT_TOKENS)
+                             max_tokens=LAT_TOKENS, kv_quant=KV_QUANT)
 
     codes = jax.random.randint(
         jax.random.PRNGKey(3), (LAT_BATCH, frames * WHISPER_HOP), 0,

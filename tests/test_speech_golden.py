@@ -208,3 +208,29 @@ def test_known_wav_transcribes_to_correct_text(
     texts = {frame.stream_id: frame.swag["text"] for frame in done}
     assert texts["s0"] == "charlie alpha"
     assert texts["s1"] == "bravo"
+
+
+def test_kv_quant_preserves_golden_transcript(golden_weights,
+                                              make_runtime, engine,
+                                              tmp_path):
+    """int8 cross-KV (the decode-tail bandwidth optimization bench
+    enables) must not change the trained model's transcript."""
+    runtime = make_runtime("golden_kvq").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = golden_definition(golden_weights)
+    definition["parameters"]["PE_WhisperASR.kv_quant"] = True
+    pipeline = Pipeline(runtime, parse_pipeline_definition(definition),
+                        stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    wav = tmp_path / "kvq.wav"
+    save_wav(str(wav), utterance(["charlie", "alpha"]))
+    pipeline.create_stream("q0", lease_time=0, parameters={
+        "PE_AudioReadFile.pathname": str(wav)})
+    pipeline.post("process_frame", "q0", {})
+    for _ in range(400):
+        if done:
+            break
+        engine.clock.advance(0.01)
+        engine.step()
+    assert done and done[0].swag["text"].strip() == "charlie alpha"
