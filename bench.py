@@ -768,10 +768,12 @@ def bench_detect():
 
 
 LLAMA_PRESET = os.environ.get("AIKO_BENCH_LLAMA_PRESET", "1b")
-# 128 slots × seq 1024 is the measured capacity edge on a 16 GB chip
-# (256 misses by ~285 MB); throughput scales near-linearly with slots
-# up to it (16→890, 32→1408, 64→1723, 128→5189 tok/s measured)
-LLAMA_SLOTS = int(os.environ.get("AIKO_BENCH_LLAMA_SLOTS", "128"))
+# Workload-sized KV allocation (serving._fit_caches) removed the old
+# 128-slot capacity edge: 256 slots measured 9.3k tok/s and stay safe
+# even if EVERY context grew to max_seq (8.6 GB KV + 2.5 GB weights);
+# 512 measured 10.3k but only fits while contexts stay short — an
+# unattended bench must not be able to OOM, so 256 is the default.
+LLAMA_SLOTS = int(os.environ.get("AIKO_BENCH_LLAMA_SLOTS", "256"))
 # 64 steps/sync = one device round per 64-token generation cycle: the
 # tunnel's ~115 ms dispatch+sync cost amortizes over the whole cycle
 # (retire-aligned rounds make the tail waste <2%, measured)
