@@ -21,11 +21,16 @@ def params():
     return llama_init(jax.random.PRNGKey(0), CONFIG)
 
 
-def oracle(params, prompt, max_new):
+def oracle(params, prompt, max_new, eos_token=None):
     out = llama_greedy_decode(params, CONFIG,
                               jnp.asarray([prompt], jnp.int32),
-                              max_tokens=max_new)
-    return [int(t) for t in np.asarray(out)[0]]
+                              max_tokens=max_new, eos_token=eos_token)
+    tokens = [int(t) for t in np.asarray(out)[0]]
+    # the serving engine returns the pre-EOS prefix; the whole-batch
+    # oracle pads with EOS after stopping — truncate to compare
+    if eos_token is not None and eos_token in tokens:
+        tokens = tokens[:tokens.index(eos_token)]
+    return tokens
 
 
 def test_single_request_matches_oracle(params):
@@ -366,3 +371,46 @@ def test_moe_llama_expert_sharded_serving():
         if done:
             break
     assert len(done.get("e0", [])) == 6
+
+
+def test_randomized_soak_matches_oracle():
+    """Property-style soak of the round-4 serving rewrite (deferred
+    admit, in-scan budgets, retire-aligned rounds, cache resize):
+    randomized prompts, budgets, EOS, and submit timing must all stay
+    bit-identical to whole-batch greedy decode."""
+    rng = np.random.default_rng(7)
+    params = llama_init(jax.random.PRNGKey(11), CONFIG)
+    # a real EOS id the random model actually emits sometimes
+    eos = 17
+    decoder = ContinuousDecoder(params, CONFIG, max_slots=6,
+                                prefill_buckets=(8, 16),
+                                steps_per_sync=8, eos_token=eos,
+                                t_block=32)
+    requests = {}
+    # prompt/budget draws quantized to a few values: the soak tests
+    # SCHEDULING randomness (admission timing, budgets, EOS), and
+    # free-form lengths would cost ~40 oracle jit compilations
+    lengths = (3, 8, 13)
+    budgets = (4, 9, 19)
+    for i in range(40):
+        prompt = rng.integers(
+            1, CONFIG.vocab,
+            size=lengths[int(rng.integers(0, 3))]).tolist()
+        requests[f"s{i}"] = (prompt, budgets[int(rng.integers(0, 3))])
+    done = {}
+    pending = list(requests.items())
+    rounds = 0
+    while (pending or len(done) < len(requests)) and rounds < 400:
+        # staggered, bursty submission
+        for _ in range(int(rng.integers(0, 4))):
+            if pending:
+                rid, (prompt, max_new) = pending.pop(0)
+                decoder.submit(rid, prompt, max_new,
+                               lambda rid, t: done.update({rid: t}))
+        decoder.pump()
+        rounds += 1
+    assert len(done) == len(requests), f"{len(done)}/{len(requests)}"
+    for rid, (prompt, max_new) in requests.items():
+        assert done[rid] == oracle(params, prompt, max_new,
+                                   eos_token=eos), rid
+    assert decoder.wasted_fraction() < 0.5       # sanity, not a target
