@@ -363,6 +363,13 @@ class PE_VideoStreamWrite(PipelineElement):
 # datagram = header(frame_id u32, part u16, part_count u16) + jpeg chunk
 _UDP_HEADER = struct.Struct("!IHH")
 _UDP_CHUNK = 60000                  # stay under the 64 KiB datagram cap
+# assembly-state bounds for the open UDP port: a flood of datagrams
+# with distinct frame ids (each claiming a large part count) must not
+# grow per-frame state without limit.  128 parts × 60 KB ≈ 7.7 MB caps
+# a single frame far above any sane JPEG; 64 concurrent frames bounds
+# the jitter window's working set (oldest assembly evicted first).
+_UDP_MAX_PARTS = 128
+_UDP_MAX_PENDING = 64
 
 
 class PE_VideoUDPSend(PipelineElement):
@@ -449,10 +456,13 @@ class PE_VideoUDPReceive(PipelineElement):
                         len(datagram) >= _UDP_HEADER.size:
                     frame_id, part, count = _UDP_HEADER.unpack(
                         datagram[:_UDP_HEADER.size])
-                    if count == 0 or part >= count:
+                    if count == 0 or part >= count or \
+                            count > _UDP_MAX_PARTS:
                         # corrupt/hostile header: an out-of-range part
                         # would satisfy the length==count completion
-                        # check while leaving a hole for the join
+                        # check while leaving a hole for the join, and
+                        # an absurd part count would reserve unbounded
+                        # assembly state
                         state["stats"]["incomplete"] += 1
                         continue
                     stale = delivered is not None and (
@@ -481,6 +491,15 @@ class PE_VideoUDPReceive(PipelineElement):
                     else:
                         stale_run = 0
                         last_stale = None
+                        if frame_id not in pending and \
+                                len(pending) >= _UDP_MAX_PENDING:
+                            # cap concurrent assemblies: evict the
+                            # oldest — under a frame-id flood the
+                            # newest ids are the live stream
+                            oldest = min(pending,
+                                         key=lambda f: pending[f]["t0"])
+                            del pending[oldest]
+                            state["stats"]["incomplete"] += 1
                         entry = pending.setdefault(
                             frame_id, {"parts": {}, "count": count,
                                        "t0": now})

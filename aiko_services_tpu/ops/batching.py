@@ -128,7 +128,10 @@ class BatchingScheduler:
     def _ready_bucket(self, now: float):
         """A bucket is ready when full, its head item is older than
         max_wait, or its earliest deadline is at risk.  Oldest head
-        wins (FIFO fairness across buckets)."""
+        wins (FIFO fairness across buckets).  Returns
+        (bucket_key, deadline_driven) or None — the caller counts
+        deadline dispatches only when the batch actually dispatches
+        (a gated drain may probe the same at-risk bucket repeatedly)."""
         best, best_age = None, -1.0
         for bucket_key, bucket in self._queues.items():
             if not bucket.items:
@@ -143,15 +146,14 @@ class BatchingScheduler:
         bucket = self._queues[best]
         if len(bucket.items) >= self.max_batch or \
                 best_age >= self.max_wait:
-            return best
+            return best, False
         # the at-risk test must cover EVERY bucket, not just the one
         # with the oldest head — a younger bucket can hold the tighter
         # deadline
         for bucket_key, bucket in self._queues.items():
             if bucket.items and self._deadline_at_risk(bucket_key,
                                                        bucket, now):
-                self.stats["deadline_dispatches"] += 1
-                return bucket_key
+                return bucket_key, True
         return None
 
     def next_deadline(self) -> float | None:
@@ -187,11 +189,16 @@ class BatchingScheduler:
         while True:
             now = self.clock()
             with self._lock:
-                bucket_key = self._ready_bucket(now)
-                if bucket_key is None and force:
+                ready = self._ready_bucket(now)
+                deadline_driven = False
+                if ready is not None:
+                    bucket_key, deadline_driven = ready
+                elif force:
                     nonempty = [k for k, b in self._queues.items()
                                 if b.items]
                     bucket_key = nonempty[0] if nonempty else None
+                else:
+                    bucket_key = None
                 if bucket_key is None:
                     return processed
                 # force (teardown) bypasses the gate: every queued item
@@ -200,6 +207,8 @@ class BatchingScheduler:
                         not self.dispatch_gate():
                     self.stats["gated"] += 1
                     return processed
+                if deadline_driven:
+                    self.stats["deadline_dispatches"] += 1
                 queue = self._queues[bucket_key].items
                 batch = [queue.popleft()
                          for _ in range(min(self.max_batch, len(queue)))]

@@ -481,7 +481,12 @@ class ContinuousDecoder:
             owed = 0 if request.generated else 1
             generated = len(request.generated) + owed
             current = len(request.prompt) + generated
-            budgets[slot] = max(1, min(
+            # budget 0 is legal: a deferred admit whose OWED first token
+            # already satisfies the request (max_new_tokens=1, or prompt
+            # at the seq cap) only needs this round's tokens_in sync —
+            # pump() masks it out of the scan so its extra device
+            # emissions are never counted as useful work
+            budgets[slot] = max(0, min(
                 request.max_new_tokens - generated,
                 self.max_seq - 1 - current))
             max_len = max(max_len, current)
@@ -508,10 +513,15 @@ class ContinuousDecoder:
         self.stats["rounds"] += 1
         self.stats["occupancy_sum"] += float(active.mean())
         decode_start = time.perf_counter()
+        # a slot with budget 0 (request satisfied by its owed first
+        # token) stays in `occupied` for the tokens_in resolution below
+        # but must not decode: masking it out of the scan keeps its
+        # discarded emissions out of useful_steps
+        scan_active = active & (budgets > 0)
         (emitted, emitted_active, tokens_in, self._tokens,
          self._lengths, self._k, self._v) = self._step(
             self.params, self._tokens, self._lengths,
-            jnp.asarray(active), jnp.asarray(budgets),
+            jnp.asarray(scan_active), jnp.asarray(budgets),
             self._k, self._v, num_steps=num_steps,
             eos=-1 if self.eos_token is None else int(self.eos_token))
         self.stats["steps"] += num_steps
