@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import Callable
 
 import jax
@@ -48,14 +49,31 @@ class DecodeRequest:
     callback: Callable                # callback(request_id, token_list)
     generated: list = dataclasses.field(default_factory=list)
     slot: int = -1
+    # SLO timestamps (scheduler clock): TTFT = first_time - submit_time;
+    # inter-token latency derives from (last_time - first_time) and the
+    # per-sync max_gap (tokens arrive in sync bursts — the gap BETWEEN
+    # syncs is what an admit stall inflates, so it is tracked per
+    # request as the worst observed stall)
+    submit_time: float = 0.0
+    first_time: float = 0.0
+    last_time: float = 0.0
+    max_gap: float = 0.0
+    # chunked-prefill progress: tokens of `prompt` already written to
+    # the slot's KV cache; prefilling=True while chunks remain
+    prefill_pos: int = 0
+    prefilling: bool = False
 
 
 def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
-                    k_cache, v_cache, lengths):
+                    k_cache, v_cache, lengths, write_mask):
     """One-token attention for all slots at per-slot positions.
 
     x: [S, 1, dim]; k_cache/v_cache: [S, H_kv, T, D]; lengths: [S] —
     tokens already in each slot's context (the new token's position).
+    write_mask: [S] bool — only these slots commit their K/V write.  A
+    mid-prefill slot's stale `lengths` entry points INTO the prompt
+    region its extend chunks are writing; an unmasked write would
+    corrupt it from the decode scan running between chunks.
 
     The cache's time axis T is NOT max_seq: the decoder allocates the
     smallest block multiple covering the longest active context and
@@ -77,7 +95,8 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
     # in-place/fusion analysis inside the scan, and the full-cache
     # select was measured ~12% faster per step at the serving shape
     hit = (jnp.arange(k_cache.shape[2])[None, None, :, None] ==
-           lengths[:, None, None, None])            # [S,1,T,1]
+           lengths[:, None, None, None]) & \
+        write_mask[:, None, None, None]             # [S,1,T,1]
     k_cache = jnp.where(hit, k[:, :, 0][:, :, None], k_cache)
     v_cache = jnp.where(hit, v[:, :, 0][:, :, None], v_cache)
 
@@ -114,14 +133,14 @@ def _build_step(config: LlamaConfig):
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
 
-    def one_token(params, tokens, lengths, k_caches, v_caches):
+    def one_token(params, tokens, lengths, active, k_caches, v_caches):
         x = L.embedding(params["embed"],
                         tokens[:, None]).astype(config.dtype)
         new_k, new_v = [], []
         for i, layer in enumerate(params["layers"]):
             attn_out, k_c, v_c = _slot_attention(
                 layer, config, L.rms_norm(layer["ln_attn"], x),
-                cos, sin, k_caches[i], v_caches[i], lengths)
+                cos, sin, k_caches[i], v_caches[i], lengths, active)
             new_k.append(k_c)
             new_v.append(v_c)
             x = x + attn_out
@@ -152,7 +171,7 @@ def _build_step(config: LlamaConfig):
         def body(carry, _):
             tokens, lengths, active, budgets, k_caches, v_caches = carry
             next_tokens, k_caches, v_caches = one_token(
-                params, tokens, lengths, k_caches, v_caches)
+                params, tokens, lengths, active, k_caches, v_caches)
             next_tokens = jnp.where(active, next_tokens, tokens)
             lengths = jnp.where(active, lengths + 1, lengths)
             budgets = jnp.where(active, budgets - 1, budgets)
@@ -188,13 +207,35 @@ class ContinuousDecoder:
     def __init__(self, params, config: LlamaConfig, max_slots: int = 8,
                  max_seq: int | None = None, eos_token: int | None = None,
                  prefill_buckets=(32, 128), steps_per_sync: int = 4,
-                 t_block: int = 256, name: str = "decoder"):
+                 t_block: int = 256, prefill_chunk: int | None = None,
+                 prefill_budget: int | None = None,
+                 name: str = "decoder"):
         self.config = config
         self.params = params
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq_len
         self.eos_token = eos_token
         self.steps_per_sync = steps_per_sync
+        # chunked prefill: prompts longer than the largest bucket are
+        # admitted to a slot immediately but their prefill runs
+        # `prefill_chunk` tokens per pump round (a compiled cache-extend
+        # program), so one long prompt stalls every active decode slot
+        # by at most ~one chunk instead of its full length — the
+        # classic inter-token-latency spike under prompt-heavy load.
+        # Also lifts the prompt-length cap from the largest bucket to
+        # max_seq.  None = single-shot bucketed prefill only.
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and not \
+                (1 <= self.prefill_chunk <= self.max_seq - 1):
+            # fail at construction, not mid-serving with a wedged slot
+            raise ValueError(
+                f"prefill_chunk must be in [1, {self.max_seq - 1}], "
+                f"got {self.prefill_chunk}")
+        # per-round prefill token budget: bucketed admits stop (FIFO,
+        # no reordering) and chunk advances are rationed once a round
+        # has dispatched this much prefill work.  None = unbounded.
+        self.prefill_budget = int(prefill_budget) if prefill_budget \
+            else None
         # granularity of the attention time-axis cap: each round reads
         # cache[:, :, :t_cap] with t_cap the smallest multiple of
         # t_block covering the longest active context (one compiled
@@ -246,20 +287,33 @@ class ContinuousDecoder:
                       "prefills": 0, "occupancy_sum": 0.0,
                       "prefill_s": 0.0, "decode_s": 0.0,
                       "useful_steps": 0, "wasted_steps": 0,
-                      "bytes_moved": 0}
+                      "bytes_moved": 0, "prefill_chunks": 0,
+                      "chunk_admits": 0, "round_prefill_tokens_max": 0}
+        # SLO samples (seconds): TTFT per request, mean inter-token
+        # latency per retired request, and each request's worst
+        # inter-sync stall — the number chunked prefill bounds
+        self.ttft_samples: deque = deque(maxlen=8192)
+        self.itl_samples: deque = deque(maxlen=8192)
+        self.gap_samples: deque = deque(maxlen=8192)
+        self._round_prefill_tokens = 0
 
     # -- public API --------------------------------------------------------
     def submit(self, request_id: str, prompt, max_new_tokens: int,
                callback) -> None:
-        # keep the TAIL on overflow (recent context matters most); the
-        # largest prefill bucket is a hard cap — an oversized prompt
-        # would blow up _admit's scatter
-        limit = min(self.max_seq - 1, self.prefill_buckets[-1])
+        # keep the TAIL on overflow (recent context matters most).
+        # Without chunked prefill the largest bucket is a hard cap (an
+        # oversized prompt would blow up _admit's scatter); with it,
+        # long prompts stream in chunks and the cap is max_seq itself.
+        if self.prefill_chunk:
+            limit = self.max_seq - 1
+        else:
+            limit = min(self.max_seq - 1, self.prefill_buckets[-1])
         # empty prompts would seed generation from a pad position —
         # normalize to a single pad token at position 0
         prompt = ([int(t) for t in prompt] or [0])[-limit:]
-        self._pending.append(DecodeRequest(request_id, prompt,
-                                           int(max_new_tokens), callback))
+        self._pending.append(DecodeRequest(
+            request_id, prompt, int(max_new_tokens), callback,
+            submit_time=time.monotonic()))
 
     def attach(self, engine, period: float = 0.002) -> int:
         # idempotent: re-attaching while already pumping (e.g. a stream
@@ -343,6 +397,196 @@ class ContinuousDecoder:
         self._prefill_fns[key] = compiled
         return compiled
 
+    def _extend_fn(self, width: int):
+        """Compiled once per (chunk, admit-width, cache_t): advances up
+        to `width` mid-prefill slots by one `prefill_chunk`-token chunk
+        of their prompt — computes the chunk's K/V against the already
+        -written cache prefix and scatters it in at each row's own
+        offset.  Rows flagged `finish` also run the lm_head on their
+        prompt's last position and land their first token + length in
+        the device buffers, exactly like a single-shot admit — the
+        first token then rides the next decode round's tokens_in sync.
+
+        No reference counterpart: the reference's pipeline blocks a
+        whole stream per frame (reference pipeline.py:650-712); chunked
+        prefill is how an iteration-level scheduler keeps decode ITL
+        flat under prompt-heavy load."""
+        key = ("extend", width)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        config = self.config
+        chunk_len = self.prefill_chunk
+        cos, sin = L.rope_frequencies(config.head_dim,
+                                      config.max_seq_len,
+                                      config.rope_theta)
+        num_heads, num_kv = config.num_heads, config.num_kv_heads
+        group = num_heads // num_kv
+
+        def extend(params, k_caches, v_caches, tokens, lengths,
+                   chunk_tokens, offsets, slots, valid, finish,
+                   final_idx):
+            # chunk_tokens: [A, C]; offsets/slots/final_idx: [A];
+            # valid/finish: [A] bool.  Pad rows (valid=False) point at
+            # DISTINCT spare slots and write back their own content.
+            x = L.embedding(params["embed"],
+                            chunk_tokens).astype(config.dtype)
+            t_cap = k_caches[0].shape[2]
+            # causal over prefix + chunk: query j (absolute position
+            # offsets+j) sees cache positions <= offsets+j — earlier
+            # chunks' rows are already in the cache, this chunk's are
+            # written below before attending
+            q_pos = offsets[:, None] + jnp.arange(chunk_len)[None, :]
+            mask = (jnp.arange(t_cap)[None, None, :] <=
+                    q_pos[:, :, None])[:, None, None]   # [A,1,1,C,T]
+            scale = 1.0 / jnp.sqrt(jnp.asarray(config.head_dim,
+                                               jnp.float32))
+
+            def write_rows(rows, chunk_kv, offs):
+                # per-row dynamic_update_slice (vmapped): offsets stay
+                # in-bounds by construction — the host slides a final
+                # chunk BACK (recomputing overlap, idempotent) so
+                # offset+C never exceeds the prompt length
+                return jax.vmap(
+                    lambda row, kv, off: jax.lax.dynamic_update_slice(
+                        row, kv, (0, off, 0)))(rows, chunk_kv, offs)
+
+            for i, layer in enumerate(params["layers"]):
+                normed = L.rms_norm(layer["ln_attn"], x)
+                q = L._split_heads(L.linear(layer["attn"]["q"], normed),
+                                   num_heads)
+                k = L._split_heads(L.linear(layer["attn"]["k"], normed),
+                                   num_kv)
+                v = L._split_heads(L.linear(layer["attn"]["v"], normed),
+                                   num_kv)
+                q = L.apply_rope(q, cos, sin, offsets)
+                k = L.apply_rope(k, cos, sin, offsets)
+                orig_k = k_caches[i][slots]        # [A, kv, T, D]
+                orig_v = v_caches[i][slots]
+                k_rows = write_rows(orig_k, k, offsets)
+                v_rows = write_rows(orig_v, v, offsets)
+                q_grouped = q.reshape(q.shape[0], num_kv, group,
+                                      chunk_len, config.head_dim)
+                scores = jnp.einsum(
+                    "akgcd,aktd->akgct", q_grouped, k_rows,
+                    preferred_element_type=jnp.float32) * scale
+                scores = jnp.where(mask, scores, -1e30)
+                weights = jax.nn.softmax(
+                    scores, axis=-1).astype(v_rows.dtype)
+                out = jnp.einsum("akgct,aktd->akgcd", weights, v_rows,
+                                 preferred_element_type=jnp.float32)
+                out = out.reshape(out.shape[0], num_heads, chunk_len,
+                                  config.head_dim).astype(x.dtype)
+                x = x + L.linear(layer["attn"]["o"], L._merge_heads(out))
+                x = x + llama_ffn(layer, config,
+                                  L.rms_norm(layer["ln_mlp"], x))
+                keep = valid[:, None, None, None]
+                k_caches[i] = k_caches[i].at[slots].set(
+                    jnp.where(keep, k_rows, orig_k))
+                v_caches[i] = v_caches[i].at[slots].set(
+                    jnp.where(keep, v_rows, orig_v))
+            x = L.rms_norm(params["ln_out"], x)
+            last_hidden = jnp.take_along_axis(
+                x, final_idx[:, None, None], axis=1)[:, 0]
+            last = jnp.einsum("ad,dv->av", last_hidden,
+                              params["lm_head"]["w"],
+                              preferred_element_type=jnp.float32)
+            firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            apply = valid & finish
+            tokens = tokens.at[slots].set(
+                jnp.where(apply, firsts, tokens[slots]))
+            lengths = lengths.at[slots].set(
+                jnp.where(apply, offsets + final_idx + 1,
+                          lengths[slots]))
+            return k_caches, v_caches, tokens, lengths
+
+        compiled = jax.jit(
+            extend, donate_argnames=("k_caches", "v_caches", "tokens",
+                                     "lengths"))
+        self._prefill_fns[key] = compiled
+        return compiled
+
+    def _advance_prefills(self) -> None:
+        """Run one prompt chunk for mid-prefill slots (batched, pow2
+        widths).  Slots closest to completion go first so in-flight
+        prompts finish (and start emitting) sooner; prefill_budget
+        rations how many rows advance per round."""
+        if not self.prefill_chunk:
+            return
+        rows = [s for s in range(self.max_slots)
+                if self._slots[s] is not None
+                and self._slots[s].prefilling]
+        if not rows:
+            return
+        chunk = self.prefill_chunk
+        rows.sort(key=lambda s: -self._slots[s].prefill_pos)
+        if self.prefill_budget is not None:
+            remaining = self.prefill_budget - self._round_prefill_tokens
+            rows = rows[:max(1, remaining // chunk)]
+        # the extend writes up to offset+chunk; never let a decode-side
+        # shrink cut below it (grow-only: max with current size)
+        need = 0
+        plans = []
+        for slot in rows:
+            request = self._slots[slot]
+            total = len(request.prompt)
+            if total - request.prefill_pos > chunk:
+                offset, finish = request.prefill_pos, False
+            else:
+                # final chunk slides BACK to end exactly at the prompt
+                # tail: the overlap recomputes identical K/V
+                # (idempotent) and offset+chunk stays <= total, so the
+                # cache never needs to grow past the prompt itself
+                offset, finish = max(0, total - chunk), True
+            plans.append((slot, request, offset, finish))
+            # the write extent is always offset+chunk (a prompt shorter
+            # than one chunk pads — the garbage tail is overwritten by
+            # decode tokens before it is ever attended)
+            need = max(need, offset + chunk)
+        self._fit_caches(max(need, self._cache_t))
+        start = time.perf_counter()
+        while plans:
+            width = min(self.max_slots, self._next_pow2(len(plans)))
+            batch, plans = plans[:width], plans[width:]
+            self._extend_group(width, batch)
+        self.stats["prefill_s"] += time.perf_counter() - start
+
+    def _extend_group(self, width: int, batch: list) -> None:
+        chunk = self.prefill_chunk
+        n = len(batch)
+        slots = [slot for slot, *_ in batch]
+        used = set(slots)
+        spare = [s for s in range(self.max_slots) if s not in used]
+        pad_slots = spare[:width - n]
+        chunk_tokens = np.zeros((width, chunk), np.int32)
+        offsets = np.zeros((width,), np.int32)
+        final_idx = np.zeros((width,), np.int32)
+        valid = np.zeros((width,), bool)
+        finish_arr = np.zeros((width,), bool)
+        for j, (slot, request, offset, finish) in enumerate(batch):
+            piece = request.prompt[offset:offset + chunk]
+            chunk_tokens[j, :len(piece)] = piece
+            offsets[j] = offset
+            final_idx[j] = len(request.prompt) - 1 - offset if finish \
+                else 0
+            valid[j] = True
+            finish_arr[j] = finish
+        self._k, self._v, self._tokens, self._lengths = \
+            self._extend_fn(width)(
+                self.params, self._k, self._v, self._tokens,
+                self._lengths, jnp.asarray(chunk_tokens),
+                jnp.asarray(offsets),
+                jnp.asarray(slots + pad_slots, jnp.int32),
+                jnp.asarray(valid), jnp.asarray(finish_arr),
+                jnp.asarray(final_idx))
+        for slot, request, offset, finish in batch:
+            request.prefill_pos = len(request.prompt) if finish \
+                else offset + chunk
+            if finish:
+                request.prefilling = False
+                request.generated = []    # first token owed (tokens_in)
+            self.stats["prefill_chunks"] += 1
+            self._round_prefill_tokens += chunk
+
     @staticmethod
     def _next_pow2(n: int) -> int:
         return 1 << max(0, (n - 1).bit_length())
@@ -375,18 +619,45 @@ class ContinuousDecoder:
 
     def _admit_pending(self) -> None:
         """Admit as many pending requests as there are free slots, in
-        bucket groups: one stacked prefill + device-side scatter + one
-        host sync per group."""
+        FIFO order.  Short prompts go through bucketed single-shot
+        prefill groups; prompts longer than the largest bucket (only
+        when prefill_chunk is set) just claim a slot here and stream in
+        via _advance_prefills.  With prefill_budget set, bucketed
+        admission stops for the round once the budget is spent —
+        arrivals defer rather than stall active decode slots."""
         free = [s for s in range(self.max_slots)
                 if self._slots[s] is None]
         if not free or not self._pending:
             return
-        take = self._pending[:len(free)]
-        del self._pending[:len(take)]
         groups: dict[int, list[DecodeRequest]] = {}
-        for request in take:
-            groups.setdefault(self._bucket_for(len(request.prompt)),
-                              []).append(request)
+        chunked: list[DecodeRequest] = []
+        taken = 0
+        for request in self._pending:
+            if taken >= len(free):
+                break
+            if self.prefill_chunk and \
+                    len(request.prompt) > self.prefill_buckets[-1]:
+                chunked.append(request)
+            else:
+                bucket = self._bucket_for(len(request.prompt))
+                if self.prefill_budget is not None and \
+                        self._round_prefill_tokens > 0 and \
+                        self._round_prefill_tokens + bucket > \
+                        self.prefill_budget:
+                    break        # FIFO: defer, don't reorder past it
+                self._round_prefill_tokens += bucket
+                groups.setdefault(bucket, []).append(request)
+            taken += 1
+        del self._pending[:taken]
+        for request in chunked:
+            slot = free.pop(0)
+            request.slot = slot
+            request.prefilling = True
+            request.prefill_pos = 0
+            self._slots[slot] = request
+            self.stats["chunk_admits"] += 1
+        if not groups:
+            return
         # grow-only here (admits scatter [:bucket]); the round planner
         # owns shrinking, with full knowledge of every active context
         self._fit_caches(max(max(groups), self._cache_t))
@@ -445,6 +716,12 @@ class ContinuousDecoder:
         request = self._slots[slot]
         self._slots[slot] = None
         self.stats["completed"] += 1
+        count = len(request.generated)
+        if count >= 2 and request.last_time > request.first_time:
+            self.itl_samples.append(
+                (request.last_time - request.first_time) / (count - 1))
+        if request.max_gap > 0:
+            self.gap_samples.append(request.max_gap)
         generated = request.generated
         if self.eos_token is not None and generated and \
                 generated[-1] == self.eos_token:
@@ -497,9 +774,17 @@ class ContinuousDecoder:
         return num_steps, max_len + num_steps + 1, budgets
 
     def pump(self) -> None:
-        """One scheduling round: admit, decode K steps, retire."""
+        """One scheduling round: admit, advance prefill chunks, decode
+        K steps, retire."""
+        self._round_prefill_tokens = 0
         self._admit_pending()
-        active = np.array([r is not None for r in self._slots])
+        self._advance_prefills()
+        self.stats["round_prefill_tokens_max"] = max(
+            self.stats["round_prefill_tokens_max"],
+            self._round_prefill_tokens)
+        # mid-prefill slots hold a slot but don't decode yet
+        active = np.array([r is not None and not r.prefilling
+                           for r in self._slots])
         if not active.any():
             # admits can retire instantly (EOS as first token, 1-token
             # budget, prompt at the seq cap) — the idle hook must still
@@ -509,6 +794,11 @@ class ContinuousDecoder:
             return
         occupied = [s for s in range(self.max_slots) if active[s]]
         num_steps, required_t, budgets = self._round_plan(occupied)
+        # never shrink the cache below a mid-prefill slot's written
+        # extent — the decode slots alone may need less
+        for request in self._slots:
+            if request is not None and request.prefilling:
+                required_t = max(required_t, request.prefill_pos)
         self._fit_caches(required_t)
         self.stats["rounds"] += 1
         self.stats["occupancy_sum"] += float(active.mean())
@@ -537,24 +827,54 @@ class ContinuousDecoder:
         # resolve deferred admits: a freshly-admitted slot's first token
         # (prefill argmax) arrives as this round's tokens_in — no
         # per-admit sync was paid for it
+        now = time.monotonic()
         for slot in occupied:
             request = self._slots[slot]
             if request is not None and not request.generated:
-                first = int(tokens_in[slot])
-                request.generated.append(first)
-                if self._finished(request, first):
-                    self._retire(slot)
+                self._deliver(slot, int(tokens_in[slot]), now)
         for k in range(emitted.shape[0]):
             for slot in occupied:
                 request = self._slots[slot]
                 if request is None or not emitted_active[k, slot]:
                     continue
-                token = int(emitted[k, slot])
-                request.generated.append(token)
-                if self._finished(request, token):
-                    self._retire(slot)
+                self._deliver(slot, int(emitted[k, slot]), now)
         if self.idle and self.on_idle is not None:
             self.on_idle()
+
+    def _deliver(self, slot: int, token: int, now: float) -> None:
+        """Append one resolved token, stamping SLO timestamps: tokens
+        land in per-sync bursts, so TTFT is submit→first burst and the
+        stall metric is the worst gap BETWEEN bursts (same-burst tokens
+        contribute no gap)."""
+        request = self._slots[slot]
+        if not request.generated:
+            request.first_time = now
+            self.ttft_samples.append(now - request.submit_time)
+        elif now > request.last_time:
+            request.max_gap = max(request.max_gap,
+                                  now - request.last_time)
+        request.generated.append(token)
+        request.last_time = now
+        if self._finished(request, token):
+            self._retire(slot)
+
+    def slo_stats(self) -> dict:
+        """Measured per-request latency SLOs (milliseconds): TTFT
+        (submit → first token burst), per-request mean inter-token
+        latency, and the p95 of each request's worst inter-burst stall
+        (what chunked prefill bounds)."""
+        def pct(samples, q):
+            return float(np.percentile(np.fromiter(samples, float),
+                                       q)) * 1000.0 if samples else None
+        return {
+            "ttft_p50_ms": pct(self.ttft_samples, 50),
+            "ttft_p95_ms": pct(self.ttft_samples, 95),
+            "itl_p50_ms": pct(self.itl_samples, 50),
+            "itl_p95_ms": pct(self.itl_samples, 95),
+            "stall_p95_ms": pct(self.gap_samples, 95),
+            "ttft_count": len(self.ttft_samples),
+            "itl_count": len(self.itl_samples),
+        }
 
     def wasted_fraction(self) -> float:
         total = self.stats["useful_steps"] + self.stats["wasted_steps"]
