@@ -174,9 +174,41 @@ def parse_pipeline_definition(data: dict,
         graph=graph, parameters=dict(parameters), elements=elements)
 
 
+def definition_to_dict(definition: PipelineDefinition) -> dict:
+    """Inverse of parse_pipeline_definition: a plain dict that
+    round-trips through parse (and through json/yaml files — the
+    reference CLI's `--dump yaml/json` export, reference
+    cli.py:219-231).  Empty optional fields are elided so the dump
+    matches a hand-written definition."""
+    elements = []
+    for element in definition.elements:
+        raw = {"name": element.name}
+        if element.input:
+            raw["input"] = list(element.input)
+        if element.output:
+            raw["output"] = list(element.output)
+        if element.parameters:
+            raw["parameters"] = dict(element.parameters)
+        if element.deploy:
+            raw["deploy"] = dict(element.deploy)
+        elements.append(raw)
+    data = {"version": definition.version, "name": definition.name,
+            "runtime": definition.runtime, "graph": list(definition.graph),
+            "elements": elements}
+    if definition.parameters:
+        data["parameters"] = dict(definition.parameters)
+    return data
+
+
 def load_pipeline_definition(pathname: str) -> PipelineDefinition:
+    """Load a definition from JSON or (by extension) YAML — the dump
+    export round-trips through either format."""
     with open(pathname) as f:
-        data = json.load(f)
+        if pathname.endswith((".yaml", ".yml")):
+            import yaml
+            data = yaml.safe_load(f)
+        else:
+            data = json.load(f)
     return parse_pipeline_definition(data, source=pathname)
 
 

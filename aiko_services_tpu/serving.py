@@ -518,7 +518,8 @@ class ContinuousDecoder:
         if not rows:
             return
         chunk = self.prefill_chunk
-        rows.sort(key=lambda s: -self._slots[s].prefill_pos)
+        rows.sort(key=lambda s: len(self._slots[s].prompt) -
+                  self._slots[s].prefill_pos)      # fewest remaining first
         if self.prefill_budget is not None:
             remaining = self.prefill_budget - self._round_prefill_tokens
             rows = rows[:max(1, remaining // chunk)]

@@ -458,3 +458,40 @@ def test_cli_element_flag_longest_prefix_wins():
                      "--pe-microphone-rate", "20"])
     assert overrides == {"PE_MicrophoneSim.rate": 10,
                          "PE_Microphone.rate": 20}
+
+
+def test_cli_pipeline_show_dump_round_trips(tmp_path):
+    """`pipeline show --dump yaml|json` exports a definition that loads
+    back identical — the reference CLI's --dump round-trip
+    (reference cli.py:219-231)."""
+    from aiko_services_tpu.pipeline import (definition_to_dict,
+                                            load_pipeline_definition)
+    definition = {
+        "version": 0, "name": "p_dump", "runtime": "python",
+        "graph": ["(PE_1 (PE_2 (a: x)))"],
+        "parameters": {"scale": 2},
+        "elements": [
+            {"name": "PE_1", "input": [{"name": "number"}],
+             "output": [{"name": "a"}],
+             "parameters": {"offset": 1},
+             "deploy": {"local": {"module": "m", "class_name": "C"}}},
+            {"name": "PE_2", "input": [{"name": "x"}],
+             "output": [{"name": "b"}]},
+        ],
+    }
+    path = tmp_path / "def.json"
+    path.write_text(json.dumps(definition))
+    for fmt, ext in (("yaml", "out.yaml"), ("json", "out.json")):
+        out = tmp_path / ext
+        result = CliRunner().invoke(
+            cli_main, ["pipeline", "show", str(path),
+                       "--dump", fmt, "--output", str(out)])
+        assert result.exit_code == 0, result.output
+        reloaded = load_pipeline_definition(str(out))
+        assert definition_to_dict(reloaded) == definition_to_dict(
+            load_pipeline_definition(str(path)))
+    # stdout mode emits parseable text
+    result = CliRunner().invoke(
+        cli_main, ["pipeline", "show", str(path), "--dump", "json"])
+    assert result.exit_code == 0
+    assert json.loads(result.output)["name"] == "p_dump"
