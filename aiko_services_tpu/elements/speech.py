@@ -534,10 +534,20 @@ def save_flat_npz(params, pathname: str) -> None:
     import numpy as np
     import jax
 
+    def to_numpy(leaf):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "V":
+            # ml_dtypes (bfloat16 etc.) round-trip through npz as raw
+            # void bytes that np.load can't cast back — store the
+            # interchange checkpoint as f32 (lossless upcast; the
+            # loader casts to the model dtype anyway)
+            arr = arr.astype(np.float32)
+        return arr
+
     flat = {}
     jax.tree_util.tree_map_with_path(
         lambda path, leaf: flat.__setitem__(_tree_path_str(path),
-                                            np.asarray(leaf)), params)
+                                            to_numpy(leaf)), params)
     np.savez(pathname, **flat)
 
 

@@ -50,7 +50,7 @@ __all__ = [
     "PROTOCOL_PIPELINE", "PipelineDefinition", "PipelineElementDefinition",
     "PipelineGraph", "PipelineElement", "Pipeline", "Stream", "Frame",
     "FrameOutput", "DEFERRED", "parse_pipeline_definition",
-    "load_pipeline_definition", "PipelineError",
+    "load_pipeline_definition", "definition_to_dict", "PipelineError",
 ]
 
 PROTOCOL_PIPELINE = ServiceProtocol("pipeline")
@@ -205,7 +205,12 @@ def load_pipeline_definition(pathname: str) -> PipelineDefinition:
     export round-trips through either format."""
     with open(pathname) as f:
         if pathname.endswith((".yaml", ".yml")):
-            import yaml
+            try:
+                import yaml
+            except ImportError as exc:      # pragma: no cover
+                raise PipelineError(
+                    f"{pathname}: .yaml definitions need pyyaml "
+                    f"(pip install pyyaml)") from exc
             data = yaml.safe_load(f)
         else:
             data = json.load(f)

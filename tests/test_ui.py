@@ -2,6 +2,7 @@
 
 import json
 
+import pytest
 from click.testing import CliRunner
 
 from aiko_services_tpu.actor import Actor
@@ -479,6 +480,7 @@ def test_cli_pipeline_show_dump_round_trips(tmp_path):
              "output": [{"name": "b"}]},
         ],
     }
+    pytest.importorskip("yaml")     # --dump yaml needs the extra
     path = tmp_path / "def.json"
     path.write_text(json.dumps(definition))
     for fmt, ext in (("yaml", "out.yaml"), ("json", "out.json")):
