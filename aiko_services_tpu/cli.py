@@ -60,6 +60,18 @@ transport_option = click.option(
 @click.group()
 def main() -> None:
     """aiko_services_tpu: TPU-native distributed service framework."""
+    # some accelerator plugins force-set jax_platforms at import,
+    # clobbering the env var; honour an explicit JAX_PLATFORMS ask
+    # (e.g. =cpu with xla_force_host_platform_device_count for a
+    # virtual mesh) the way tests/conftest.py does
+    import os
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        try:
+            import jax
+            jax.config.update("jax_platforms", requested)
+        except Exception:
+            pass          # jax optional for pure control-plane commands
 
 
 @main.command()
@@ -85,6 +97,56 @@ def _snake(name: str) -> str:
     import re
     return re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])",
                   "_", name).lower().replace("__", "_")
+
+
+def parse_mesh_spec(spec: str | None):
+    """'model=4,data=2' → a jax Mesh over the visible devices (None
+    passes through: single-device ComputeRuntime).  This is the CLI
+    seam that makes the parallelism modes user-reachable — the same
+    axis names the elements' logical-axis rules shard over (TP
+    'model', MoE 'expert', ring attention 'sequence', DP 'data')."""
+    if not spec:
+        return None
+    axes = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise click.ClickException(
+                f"--mesh: expected axis=N, got {part!r}")
+        axis, _, count = part.partition("=")
+        axis = axis.strip()
+        if not axis:
+            raise click.ClickException(
+                f"--mesh: missing axis name in {part!r}")
+        if axis in axes:
+            raise click.ClickException(
+                f"--mesh: duplicate axis {axis!r}")
+        try:
+            size = int(count)
+        except ValueError:
+            raise click.ClickException(
+                f"--mesh: axis size must be an integer, got {count!r}")
+        if size < 1:
+            raise click.ClickException(
+                f"--mesh: axis size must be >= 1, got {size}")
+        axes[axis] = size
+    from .parallel import create_mesh
+    try:
+        import math
+
+        import jax
+        # the mesh takes the first product-many devices: an axes
+        # product smaller than the machine is a valid ask (e.g.
+        # expert=4 on an 8-device host)
+        need = math.prod(axes.values())
+        return create_mesh(axes, devices=jax.devices()[:need])
+    except Exception as exc:
+        raise click.ClickException(
+            f"--mesh {spec!r}: {exc} (visible devices may be fewer "
+            f"than the axes' product; on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=N)")
 
 
 def parse_element_flags(definition, extra_args) -> dict:
@@ -147,11 +209,16 @@ def parse_element_flags(definition, extra_args) -> dict:
               help="JSON dict of stream parameters")
 @click.option("--frame", "frame_json", default=None,
               help="JSON swag for one immediate frame")
+@click.option("--mesh", "mesh_spec", default=None,
+              help="device mesh for the ComputeRuntime, e.g. "
+                   "'model=4,data=2' (TP x DP), 'expert=8' (MoE), "
+                   "'sequence=8' (ring attention).  Elements shard "
+                   "their params over it via their logical axes.")
 @transport_option
 @click.argument("element_flags", nargs=-1,
                 type=click.UNPROCESSED)
 def create(definition_pathname, name, stream_id, stream_parameters,
-           frame_json, transport, element_flags) -> None:
+           frame_json, transport, mesh_spec, element_flags) -> None:
     """Run a pipeline from DEFINITION_PATHNAME.
 
     Every element parameter is additionally a flag:
@@ -164,7 +231,7 @@ def create(definition_pathname, name, stream_id, stream_parameters,
     parameters = json.loads(stream_parameters)
     parameters |= parse_element_flags(definition, element_flags)
     runtime = _make_runtime(name or definition.name, transport)
-    ComputeRuntime(runtime, "compute")
+    ComputeRuntime(runtime, "compute", mesh=parse_mesh_spec(mesh_spec))
     pipe = Pipeline(runtime, definition, name=name,
                     definition_pathname=definition_pathname)
     pipe.create_stream(stream_id, parameters=parameters)

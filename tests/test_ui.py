@@ -497,3 +497,16 @@ def test_cli_pipeline_show_dump_round_trips(tmp_path):
         cli_main, ["pipeline", "show", str(path), "--dump", "json"])
     assert result.exit_code == 0
     assert json.loads(result.output)["name"] == "p_dump"
+
+
+def test_parse_mesh_spec_errors():
+    """--mesh rejects malformed specs with a usable message; empty/None
+    pass through as single-device."""
+    import click as click_module
+
+    from aiko_services_tpu.cli import parse_mesh_spec
+    assert parse_mesh_spec(None) is None
+    assert parse_mesh_spec("") is None
+    for bad in ("model", "model=x", "model=2,=3"):
+        with pytest.raises(click_module.ClickException):
+            parse_mesh_spec(bad)
