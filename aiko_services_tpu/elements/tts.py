@@ -68,11 +68,29 @@ class PE_NeuralTTS(PipelineElement):
         config = self.config
         gl_iters = int(gl_iters)
 
-        fn = jax.jit(lambda params, tokens: synthesize(
-            params, config, tokens, n_iter=gl_iters))
+        # mel→waveform leg: a trained neural vocoder checkpoint
+        # (parameter `vocoder_weights`) replaces Griffin-Lim; absent,
+        # the weight-free fallback keeps working
+        vocoder_weights, _ = self.get_parameter("vocoder_weights", "")
+        vocoder_preset, _ = self.get_parameter("vocoder_preset", "test")
+        self.vocoder = None
+        vocoder_config = None
+        if vocoder_weights:
+            from ..models.vocoder import (VOCODER_PRESETS, vocoder_axes,
+                                          vocoder_init)
+            from .speech import load_flat_npz
+            vocoder_config = VOCODER_PRESETS[str(vocoder_preset)]
+            vparams = vocoder_init(jax.random.PRNGKey(0), vocoder_config)
+            vparams = load_flat_npz(vparams, str(vocoder_weights))
+            self.vocoder = self.compute.place_params(
+                vparams, vocoder_axes(vocoder_config))
+
+        fn = jax.jit(lambda params, vocoder, tokens: synthesize(
+            params, config, tokens, n_iter=gl_iters,
+            vocoder=vocoder, vocoder_config=vocoder_config))
 
         def run_bucket(bucket, token_batch):
-            return fn(self.params, token_batch)
+            return fn(self.params, self.vocoder, token_batch)
 
         def collate(bucket, payloads):
             batch = np.zeros((len(payloads), bucket), dtype="int32")

@@ -159,18 +159,29 @@ def tts_forward(params, config: TTSConfig, tokens, durations=None):
     return L.linear(params["mel_head"], frames), total
 
 
-def synthesize(params, config: TTSConfig, tokens, n_iter: int = 32):
+def synthesize(params, config: TTSConfig, tokens, n_iter: int = 32,
+               vocoder=None, vocoder_config=None):
     """tokens → (waveform [B, samples], voiced sample counts [B]) via
-    predicted durations → mel → linear → Griffin-Lim.  One jittable
-    program: batched synthesis runs on device end-to-end; callers trim
-    each row to its sample count (the static tail past the predicted
-    length synthesizes silence-garbage)."""
+    predicted durations → mel → waveform.  One jittable program:
+    batched synthesis runs on device end-to-end; callers trim each row
+    to its sample count (the static tail past the predicted length
+    synthesizes silence-garbage).
+
+    The mel→waveform leg is the trained neural vocoder when `vocoder`
+    params are given (models/vocoder.py — the Coqui-VITS-grade leg the
+    reference wraps, speech_elements.py:96-131), else weight-free
+    Griffin-Lim phase recovery (`n_iter` rounds)."""
     from ..ops.audio import WHISPER_HOP, griffin_lim, mel_to_linear
 
     mel, total_frames = tts_forward(params, config, tokens)
-    magnitude = mel_to_linear(mel.astype(jnp.float32),
-                              num_mels=config.n_mels)
-    audio = griffin_lim(magnitude, n_iter=n_iter)
+    if vocoder is not None:
+        from .vocoder import vocoder_forward
+        audio = vocoder_forward(vocoder, vocoder_config,
+                                mel.astype(jnp.float32))
+    else:
+        magnitude = mel_to_linear(mel.astype(jnp.float32),
+                                  num_mels=config.n_mels)
+        audio = griffin_lim(magnitude, n_iter=n_iter)
     samples = jnp.clip(jnp.ceil(total_frames), 0,
                        config.max_frames).astype(jnp.int32) * WHISPER_HOP
     return audio, samples

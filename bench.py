@@ -283,7 +283,7 @@ def bench_chip_asr(config, params, batch: int):
         mfu = (flops / elapsed / peak) if (peak and flops) else None
         streams = chip_batch * CHUNK_SECONDS / elapsed
         if best is None or streams > best[0]:
-            best = (streams, elapsed, mfu, chip_batch, codes)
+            best = (streams, elapsed, mfu, chip_batch, codes, compiled)
     if best is None:
         raise RuntimeError("no chip ASR rung completed")
 
@@ -291,7 +291,7 @@ def bench_chip_asr(config, params, batch: int):
     # milliseconds go?  encoder (MXU-bound), cross-KV projection, and
     # the autoregressive decode tail (bandwidth-bound: every token
     # re-reads the decoder weights AND the full cross-KV)
-    streams, elapsed, mfu, chip_batch, codes = best
+    streams, elapsed, mfu, chip_batch, codes, best_compiled = best
     phases = {}
     try:
         enc_compiled = compile_with_retry(enc_only, params, codes)
@@ -312,6 +312,68 @@ def bench_chip_asr(config, params, batch: int):
         del enc_compiled, kv_compiled
     except Exception as exc:
         print(f"chip asr phase split failed: {exc!r}", file=sys.stderr)
+
+    # decode-tail bytes-per-step model (r4 verdict item 3 — the same
+    # arithmetic the llama section carries): every greedy token re-reads
+    # the decoder weight set and the full cross-KV.  At spec HBM
+    # bandwidth that is the tail's floor; reported next to the measured
+    # tail so bandwidth-bound is a checkable claim, not a shrug.
+    membw = device_peak_membw()
+    if membw:
+        itemsize = jnp.dtype(config.dtype).itemsize
+        dec_weight_bytes = int(sum(
+            int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+            if any(k in str(path[0]) for k in
+                   ("dec_blocks", "ln_dec", "tok_embed"))))
+        kv_itemsize = 1 if KV_QUANT else itemsize
+        cross_kv_bytes = (chip_batch * config.dec_layers * 2 *
+                          config.n_audio_ctx * config.dim * kv_itemsize)
+        self_kv_bytes = (chip_batch * config.dec_layers * 2 *
+                         config.n_text_ctx * config.dim * itemsize)
+        step_bytes = dec_weight_bytes + cross_kv_bytes + self_kv_bytes
+        tail_roofline_ms = MAX_TOKENS * step_bytes / membw * 1000.0
+        phases |= {
+            "chip_tail_step_gb": round(step_bytes / 1e9, 3),
+            "chip_decode_tail_roofline_ms": round(tail_roofline_ms, 1),
+        }
+        if "chip_decode_tail_ms" in phases:
+            phases["chip_tail_hbm_bw_util"] = round(
+                tail_roofline_ms / max(phases["chip_decode_tail_ms"],
+                                       1e-9), 3)
+
+    # int8 cross-KV A/B at the winning batch (r4 verdict item 3: the
+    # lever shipped but its effect was in no artifact): throughput
+    # delta + greedy-token parity vs the shipping bf16 program
+    try:
+        alt = not KV_QUANT
+
+        def fused_alt(params, pcm):
+            return greedy_decode(params, config, frontend(pcm),
+                                 max_tokens=MAX_TOKENS, kv_quant=alt)
+
+        alt_compiled = compile_with_retry(fused_alt, params, codes)
+        alt_elapsed = measure_compiled(alt_compiled, params, codes,
+                                       chain=4)
+        base_tokens, base_lengths = [
+            np.asarray(x)
+            for x in best_compiled(params, codes)[:2]]
+        alt_tokens, alt_lengths = [
+            np.asarray(x) for x in alt_compiled(params, codes)[:2]]
+        valid = np.arange(base_tokens.shape[1])[None, :] < \
+            np.minimum(base_lengths, alt_lengths)[:, None]
+        match = float((base_tokens == alt_tokens)[valid].mean()) \
+            if valid.any() else 1.0
+        phases |= {
+            "chip_kv_quant_round_ms": round(alt_elapsed * 1000.0, 1),
+            "chip_kv_quant_is_int8": bool(alt),
+            "chip_kv_quant_token_match": round(match, 4),
+            "chip_kv_quant_delta": round(
+                (alt_elapsed - elapsed) / elapsed, 3),
+        }
+        del alt_compiled
+    except Exception as exc:
+        print(f"chip kv_quant A/B failed: {exc!r}", file=sys.stderr)
     return streams, elapsed, mfu, chip_batch, phases
 
 
@@ -827,6 +889,11 @@ def bench_llama(window: float):
     for key in decoder.stats:
         decoder.stats[key] = 0 if isinstance(decoder.stats[key], int) \
             else 0.0
+    # SLO sample deques too: warmup TTFTs include compile time and
+    # would contaminate the measured percentiles
+    decoder.ttft_samples.clear()
+    decoder.itl_samples.clear()
+    decoder.gap_samples.clear()
     generated[0] = 0
 
     start = time.perf_counter()
@@ -838,6 +905,48 @@ def bench_llama(window: float):
     elapsed = time.perf_counter() - start
 
     tokens_per_sec = generated[0] / elapsed if elapsed > 0 else 0.0
+    # pure-device chained step: the SAME compiled step the serving loop
+    # runs, chained K rounds with one final sync, on fresh buffers at
+    # the serving shape — separates device compute from the tunnel's
+    # per-round dispatch+sync so the artifact carries both (r4 verdict
+    # item 2: the roofline claim must be checkable from the artifact
+    # alone)
+    device_step_ms = None
+    try:
+        t_cache = decoder._cache_t
+        shape = (LLAMA_SLOTS, config.num_kv_heads, t_cache,
+                 config.head_dim)
+        k_probe = [jnp.zeros(shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        v_probe = [jnp.zeros(shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        tokens_probe = jnp.ones((LLAMA_SLOTS,), jnp.int32)
+        lengths_probe = jnp.zeros((LLAMA_SLOTS,), jnp.int32)
+        active_probe = jnp.ones((LLAMA_SLOTS,), bool)
+        budgets_probe = jnp.full((LLAMA_SLOTS,), 1 << 30, jnp.int32)
+
+        def chain_rounds(rounds):
+            nonlocal k_probe, v_probe, tokens_probe, lengths_probe
+            out = None
+            for _ in range(rounds):
+                out = decoder._step(
+                    params, tokens_probe, lengths_probe, active_probe,
+                    budgets_probe, k_probe, v_probe,
+                    num_steps=LLAMA_STEPS_PER_SYNC, eos=-1)
+                (_, _, _, tokens_probe, lengths_probe, k_probe,
+                 v_probe) = out
+            np.asarray(out[0][-1])          # one sync for the chain
+        chain_rounds(1)                      # warm (compile cache hit)
+        chains = 4
+        probe_start = time.perf_counter()
+        chain_rounds(chains)
+        device_step_ms = (time.perf_counter() - probe_start) * 1000.0 \
+            / (chains * LLAMA_STEPS_PER_SYNC)
+        del k_probe, v_probe
+    except Exception as exc:
+        print(f"llama device-step probe failed: {exc!r}",
+              file=sys.stderr)
+    slo = decoder.slo_stats()
     # admits dispatch async and resolve on the round sync (deferred
     # admit): prefill_s is host-blocking admit time only; the prefill
     # DEVICE time now rides inside decode_s
@@ -876,7 +985,26 @@ def bench_llama(window: float):
         "llama_config": f"{LLAMA_PRESET} bf16, {LLAMA_SLOTS} slots, "
                         f"{LLAMA_STEPS_PER_SYNC} steps/sync, "
                         f"deferred admit",
-    } | ({} if membw is None else {
+    } | ({} if device_step_ms is None else {
+        # device compute per step (chained, one sync) vs the serving
+        # step above (which carries one tunnel dispatch+sync per
+        # steps_per_sync round) — the difference is the wire tax
+        "llama_device_step_ms": round(device_step_ms, 3),
+        "llama_dispatch_overhead_ms": round(
+            max(0.0, decode_s * 1000.0 / steps - device_step_ms), 3),
+    }) | ({} if slo["ttft_p50_ms"] is None else {
+        # measured per-request latency SLOs (serving.slo_stats):
+        # TTFT submit→first burst; ITL per-request mean; stall = worst
+        # inter-burst gap (what chunked prefill bounds)
+        "llama_ttft_p50_ms": round(slo["ttft_p50_ms"], 1),
+        "llama_ttft_p95_ms": round(slo["ttft_p95_ms"], 1),
+        "llama_itl_p50_ms": round(slo["itl_p50_ms"], 2)
+        if slo["itl_p50_ms"] is not None else None,
+        "llama_itl_p95_ms": round(slo["itl_p95_ms"], 2)
+        if slo["itl_p95_ms"] is not None else None,
+        "llama_stall_p95_ms": round(slo["stall_p95_ms"], 1)
+        if slo["stall_p95_ms"] is not None else None,
+    }) | ({} if membw is None else {
         "llama_roofline_step_ms": round(
             decoder.stats["bytes_moved"] / steps / membw * 1000.0, 2),
     }) | ({} if mfu is None else {"llama_mfu": round(mfu, 4)}) \
@@ -899,7 +1027,158 @@ LAT_CHUNK_S = float(os.environ.get("AIKO_BENCH_LAT_CHUNK", "0.5"))
 LAT_TOKENS = 8                    # ~tokens utterable in half a second
 LAT_BATCH = int(os.environ.get("AIKO_BENCH_LAT_BATCH", "48"))
 LAT_DEADLINE_MS = 140.0
-LAT_RUNGS = (200, 280, 360)     # ascending; stops at first failure
+LAT_POOL = 64                     # device-resident distinct chunks
+# device-resident measured rungs (ascending, stops at first failure)
+LAT_DEV_RUNGS = tuple(int(x) for x in os.environ.get(
+    "AIKO_BENCH_LAT_DEV_RUNGS", "200,400,600,800").split(","))
+# wire rungs: adaptive around the 200-stream target (descend to find
+# the true operating point when 200 fails, ascend when it passes)
+LAT_WIRE_DESCEND = (120, 80, 40)
+LAT_WIRE_ASCEND = (280, 360)
+LAT_WINDOW = float(os.environ.get("AIKO_BENCH_LAT_WINDOW", "10"))
+
+
+def _measured_latency_loop(compiled, params, pool, n_streams: int,
+                           window: float, process: str,
+                           tunnel_floor: float, frames: int):
+    """The REAL closed loop, measured end to end (round-4 verdict item
+    1): an arrival process (uniform phases or Poisson) submits into the
+    actual BatchingScheduler (deadline-aware admission LIVE, service
+    EWMA fed back), which dispatches the compiled fused program over
+    DEVICE-RESIDENT payloads (a [pool, samples] buffer gathered by
+    index on device — only the [batch] index vector crosses the wire);
+    a sync worker thread (the production pipelined-results pattern)
+    collects batches and stamps per-frame latencies enqueue→result.
+
+    Every reported number is a per-frame timestamp difference; nothing
+    is a queue formula.  Deadlines are arrival + budget + the measured
+    tunnel dispatch floor: the floor is a bench-machine artifact
+    host-attached TPUs do not pay, and charging it against the 140 ms
+    slack would collapse admission into a batch-of-1 storm (the same
+    accounting as the ex-floor report field).
+
+    Returns a dict of measured fields, or None when the rung could not
+    sustain the arrival rate."""
+    import threading
+    from collections import deque as _deque
+
+    from aiko_services_tpu.ops.batching import (BatchingScheduler,
+                                                ShapeBuckets)
+
+    rng = np.random.default_rng(17)
+    latencies: list = []
+    in_flight: _deque = _deque()
+    completed = [0]
+    stop = [False]
+
+    def process_batch(bucket, items):
+        idx = np.fromiter((item.payload for item in items), np.int32,
+                          len(items))
+        if len(idx) < LAT_BATCH:
+            # static shape: pad with repeats — wasted lanes, same
+            # compiled program
+            idx = np.concatenate([idx, np.zeros(LAT_BATCH - len(idx),
+                                                np.int32)])
+        out = compiled(params, pool, jnp.asarray(idx))
+        in_flight.append((items, out, time.monotonic(), bucket))
+        return None                        # sync worker owns delivery
+
+    scheduler = BatchingScheduler(
+        process_batch, ShapeBuckets([frames]), max_batch=LAT_BATCH,
+        max_wait=0.08,
+        dispatch_gate=lambda: len(in_flight) < DEPTH)
+
+    def syncer():
+        while not stop[0] or in_flight:
+            if not in_flight:
+                time.sleep(0.0005)
+                continue
+            items, out, dispatched, bucket = in_flight.popleft()
+            np.asarray(jax.tree_util.tree_leaves(out)[0])
+            now = time.monotonic()
+            scheduler.observe_service_time(bucket, now - dispatched)
+            for item in items:
+                latencies.append(now - item.enqueue_time)
+            completed[0] += len(items)
+
+    worker = threading.Thread(target=syncer, daemon=True)
+    worker.start()
+    budget = LATENCY_BUDGET + tunnel_floor
+    bailed = False
+    start = time.monotonic()
+    deadline = start + window
+    submitted = 0
+    if process == "poisson":
+        next_arrival = start + float(rng.exponential(
+            LAT_CHUNK_S / n_streams))
+    else:
+        phases = [start + i * LAT_CHUNK_S / n_streams
+                  for i in range(n_streams)]
+        import heapq as _heapq
+        _heapq.heapify(phases)
+    try:
+        while True:
+            now = time.monotonic()
+            if process == "poisson":
+                while next_arrival <= now and now < deadline:
+                    scheduler.submit(
+                        f"p{submitted}", int(rng.integers(0, LAT_POOL)),
+                        frames, lambda *_: None,
+                        deadline=next_arrival + budget)
+                    submitted += 1
+                    next_arrival += float(rng.exponential(
+                        LAT_CHUNK_S / n_streams))
+            else:
+                while phases and phases[0] <= now:
+                    when = _heapq.heappop(phases)
+                    scheduler.submit(
+                        f"u{submitted}", int(rng.integers(0, LAT_POOL)),
+                        frames, lambda *_: None, deadline=when + budget)
+                    submitted += 1
+                    if when + LAT_CHUNK_S < deadline:
+                        _heapq.heappush(phases, when + LAT_CHUNK_S)
+            scheduler.drain()
+            if now >= deadline and scheduler.pending() == 0:
+                break
+            # falling behind by > 6 full batches of queued work on top
+            # of the in-flight depth = not sustaining; bail early
+            if scheduler.pending() > 6 * LAT_BATCH:
+                bailed = True
+                break
+            time.sleep(0.0005)
+        scheduler.drain(force=True)
+        drain_start = time.monotonic()
+        while completed[0] < submitted and \
+                time.monotonic() - drain_start < 30.0:
+            time.sleep(0.002)
+    finally:
+        stop[0] = True
+        worker.join(timeout=60.0)
+    drain_time = time.monotonic() - drain_start
+    sustained = not bailed and completed[0] >= submitted and \
+        drain_time <= 2.0 and scheduler.pending() == 0
+    ordered = sorted(latencies) or [float("inf")]
+    p50 = ordered[len(ordered) // 2]
+    p95 = ordered[int(0.95 * (len(ordered) - 1))]
+    print(f"measured[{process}] n={n_streams}: submitted={submitted} "
+          f"done={completed[0]} p50={p50*1000:.0f}ms "
+          f"p95={p95*1000:.0f}ms mean_batch="
+          f"{scheduler.mean_batch_size():.1f} "
+          f"deadline_fires={scheduler.stats['deadline_dispatches']} "
+          f"drain={drain_time:.1f}s sustained={sustained}",
+          file=sys.stderr)
+    if not sustained:
+        return None
+    return {
+        "streams": n_streams,
+        "p50_ms": round(p50 * 1000.0, 1),
+        "p95_ms": round(p95 * 1000.0, 1),
+        "p50_ex_floor_ms": round((p50 - tunnel_floor) * 1000.0, 1),
+        "p95_ex_floor_ms": round((p95 - tunnel_floor) * 1000.0, 1),
+        "frames": completed[0],
+        "mean_batch": round(scheduler.mean_batch_size(), 1),
+        "deadline_dispatches": scheduler.stats["deadline_dispatches"],
+    }
 
 
 def bench_latency():
@@ -914,65 +1193,114 @@ def bench_latency():
                                  dtype=jnp.bfloat16)
     params = whisper_init(jax.random.PRNGKey(0), config)
 
-    def fused(params, pcm):
+    def fused(params, pool, idx):
+        pcm = pool[idx]                       # device-side gather
         audio = mulaw_decode(pcm)
         mel = log_mel_spectrogram(audio, num_mels=config.n_mels)
         return greedy_decode(params, config, mel.astype(config.dtype),
                              max_tokens=LAT_TOKENS, kv_quant=KV_QUANT)
 
-    codes = jax.random.randint(
-        jax.random.PRNGKey(3), (LAT_BATCH, frames * WHISPER_HOP), 0,
-        256, jnp.int32).astype(jnp.uint8)
-    compiled = compile_with_retry(fused, params, codes)
+    pool = jax.random.randint(
+        jax.random.PRNGKey(3), (LAT_POOL, frames * WHISPER_HOP), 0,
+        256, jnp.int32).astype(jnp.uint8)     # resident on device
+    idx0 = jnp.arange(LAT_BATCH, dtype=jnp.int32) % LAT_POOL
+    compiled = compile_with_retry(fused, params, pool, idx0)
     # chain=1 includes the tunnel's fixed dispatch+sync cost; chained
     # amortizes it out (= device compute); a trivial-program round
     # trip MEASURES that floor so the artifact shows the arithmetic
-    compute_round = measure_compiled(compiled, params, codes, chain=1)
-    compute_chained = measure_compiled(compiled, params, codes, chain=8)
+    compute_round = measure_compiled(compiled, params, pool, idx0,
+                                     chain=1)
+    compute_chained = measure_compiled(compiled, params, pool, idx0,
+                                       chain=8)
     trivial = compile_with_retry(lambda x: (x + 1,), jnp.zeros(8))
     tunnel_floor = measure_compiled(trivial, jnp.zeros(8), chain=1)
-    del compiled, codes, params
     print(f"latency calib: {compute_round*1000:.1f} ms/round "
           f"(chained {compute_chained*1000:.1f}, tunnel floor "
           f"{tunnel_floor*1000:.1f}) @ batch {LAT_BATCH}, "
           f"chunk {LAT_CHUNK_S}s", file=sys.stderr)
 
-    # device-resident configuration (modeled arrival queue, measured
-    # rounds): uniform arrivals wait round/2 for batch formation, then
-    # one round of service.  The chained round is the honest device
-    # compute (the tunnel's fixed dispatch floor, measured above, is a
-    # bench-machine artifact host-attached production TPUs do not pay
-    # — reported separately, not silently discarded).
-    dev_streams = LAT_BATCH * LAT_CHUNK_S / compute_chained
-    dev_p50_ms = 1.5 * compute_chained * 1000.0
-    dev_met = dev_p50_ms <= LATENCY_BUDGET * 1000.0 and \
-        dev_streams >= 200
+    # device-resident configuration, MEASURED (replaces r4's modeled
+    # round/2 queue): real arrivals → live deadline-aware scheduler →
+    # compiled program over device-resident payloads → per-frame
+    # timestamps.  Ascending rungs; Poisson arrivals re-measured at the
+    # best uniform rung (burstier queue, same capacity).
+    best_uniform = None
+    for rung in LAT_DEV_RUNGS:
+        fields = _measured_latency_loop(compiled, params, pool, rung,
+                                        LAT_WINDOW, "uniform",
+                                        tunnel_floor, frames)
+        if fields is None:
+            break
+        best_uniform = fields
+    poisson = None
+    if best_uniform is not None:
+        poisson = _measured_latency_loop(
+            compiled, params, pool, best_uniform["streams"], LAT_WINDOW,
+            "poisson", tunnel_floor, frames)
+    del compiled, pool, params
 
     result = {
         "lat_chunk_s": LAT_CHUNK_S,
         "lat_batch": LAT_BATCH,
         "lat_compute_round_ms": round(compute_chained * 1000.0, 1),
         "lat_tunnel_floor_ms": round(tunnel_floor * 1000.0, 1),
-        "lat_dev_streams": round(dev_streams, 1),
-        "lat_dev_p50_ms": round(dev_p50_ms, 1),
-        "lat_dev_label": f"device-resident {LAT_CHUNK_S}s chunks, "
-                         f"batch {LAT_BATCH}, modeled round/2 queue, "
-                         f"tunnel dispatch floor excluded (measured "
-                         f"separately)",
-        "lat_dev_budget_met": bool(dev_met),
+    }
+    dev_met = False
+    if best_uniform is not None:
+        dev_met = (best_uniform["p50_ex_floor_ms"] <=
+                   LATENCY_BUDGET * 1000.0 and
+                   best_uniform["streams"] >= 200)
+        result |= {
+            "lat_dev_streams": best_uniform["streams"],
+            "lat_dev_p50_ms": best_uniform["p50_ms"],
+            "lat_dev_p95_ms": best_uniform["p95_ms"],
+            "lat_dev_p50_ex_floor_ms": best_uniform["p50_ex_floor_ms"],
+            "lat_dev_p95_ex_floor_ms": best_uniform["p95_ex_floor_ms"],
+            "lat_dev_frames": best_uniform["frames"],
+            "lat_dev_mean_batch": best_uniform["mean_batch"],
+            "lat_dev_deadline_dispatches":
+                best_uniform["deadline_dispatches"],
+            "lat_dev_label": f"device-resident {LAT_CHUNK_S}s chunks, "
+                             f"MEASURED closed loop (uniform arrivals, "
+                             f"live deadline-aware scheduler, per-frame"
+                             f" timestamps); budget decided on p50 with"
+                             f" the measured tunnel dispatch floor "
+                             f"subtracted (reported both ways)",
+        }
+        if poisson is not None:
+            result |= {
+                "lat_dev_poisson_p50_ms": poisson["p50_ms"],
+                "lat_dev_poisson_p95_ms": poisson["p95_ms"],
+                "lat_dev_poisson_p50_ex_floor_ms":
+                    poisson["p50_ex_floor_ms"],
+            }
+    result["lat_dev_budget_met"] = bool(dev_met)
+    # wire-cost arithmetic: bytes one chunk ships per wire mode, and
+    # the tunnel bandwidth at which the wire path would saturate the
+    # device-resident capacity (item: quantify environmental vs
+    # recoverable)
+    chunk_bytes_mulaw = frames * WHISPER_HOP          # uint8 codes
+    dev_capacity = LAT_BATCH / compute_chained        # chunks/s
+    result |= {
+        "lat_wire_bytes_per_chunk_mulaw": chunk_bytes_mulaw,
+        "lat_wire_bytes_per_chunk_int16": 2 * chunk_bytes_mulaw,
+        "lat_wire_breakeven_MBps": round(
+            dev_capacity * chunk_bytes_mulaw / 1e6, 1),
     }
 
     # wire configuration: the full pipeline, real-time arrivals.
-    # Ascending ladder from the 200-stream target; stop at the first
-    # failed rung (a failing wire rung costs its whole drain)
+    # Adaptive ladder around the 200-stream target: when 200 fails,
+    # DESCEND to find the wire path's true operating point (how many
+    # streams it CAN sustain within budget on this machine — r4 only
+    # recorded the failing rung); when it passes, ascend.
     bench = PipelineBench(LAT_BATCH, "audio", max_wait=0.08,
                           chunk_seconds=LAT_CHUNK_S,
                           max_tokens=LAT_TOKENS,
                           deadline_ms=LAT_DEADLINE_MS)
     bench.warmup(LAT_BATCH)
-    wire_fields = {}
     program = bench.compute.programs["whisper_asr.PE_WhisperASR"]
-    for n in LAT_RUNGS:
+
+    def run_wire_rung(n):
         # per-rung decomposition must not blend samples from warmup or
         # earlier rungs — clear the rolling collections and snapshot
         # cumulative counters
@@ -987,7 +1315,7 @@ def bench_latency():
         queue_p50 = waits[len(waits) // 2]
         service = sorted(s for _, s in program.recent_service) or [0.0]
         service_p50 = service[len(service) // 2]
-        fields = {
+        return {
             "lat_wire_streams": n,
             "lat_wire_sustained": bool(ok),
             "lat_wire_p50_ms": round(p50 * 1000.0, 1),
@@ -1004,10 +1332,33 @@ def bench_latency():
             "lat_wire_budget_met": bool(
                 ok and p50 <= LATENCY_BUDGET and n >= 200),
         }
-        if not fields["lat_wire_budget_met"]:
-            wire_fields = wire_fields or fields    # keep best/first
-            break
-        wire_fields = fields                       # passing rung
+
+    def within_budget(fields):
+        return fields["lat_wire_sustained"] and \
+            fields["lat_wire_p50_ms"] <= LATENCY_BUDGET * 1000.0
+
+    first = run_wire_rung(200)
+    wire_fields = first
+    if within_budget(first):
+        for n in LAT_WIRE_ASCEND:
+            fields = run_wire_rung(n)
+            if not within_budget(fields):
+                break
+            wire_fields = fields
+    else:
+        # record the target-rung failure, then find the real capacity
+        result |= {"lat_wire200_p50_ms": first["lat_wire_p50_ms"],
+                   "lat_wire200_p95_ms": first["lat_wire_p95_ms"],
+                   "lat_wire200_sustained":
+                       first["lat_wire_sustained"]}
+        for n in LAT_WIRE_DESCEND:
+            fields = run_wire_rung(n)
+            wire_fields = fields
+            if within_budget(fields):
+                break
+        wire_fields["lat_wire_max_within_budget"] = \
+            wire_fields["lat_wire_streams"] \
+            if within_budget(wire_fields) else 0
     del bench
     result |= wire_fields
     met_wire = result.get("lat_wire_budget_met", False)
@@ -1016,6 +1367,14 @@ def bench_latency():
         "wire" if met_wire else ("device-resident" if dev_met
                                  else "none"))
     return result
+
+
+def _detect_wire_bytes(wire: str) -> int:
+    """Bytes one detect frame ships over the host→device wire."""
+    if wire == "dct8":
+        from aiko_services_tpu.ops.image_wire import dct8_wire_bytes
+        return dct8_wire_bytes(DETECT_IMAGE, DETECT_IMAGE)
+    return DETECT_IMAGE * DETECT_IMAGE * 3          # raw uint8
 
 
 def _hbm_in_use() -> str:
@@ -1209,6 +1568,15 @@ def main() -> None:
     }) | ({} if detect_device_fps is None else {
         "detect_fps_device": round(detect_device_fps, 1),
         "detect_device_batch": detect_device_batch,
+        # wire-cost arithmetic (r4 verdict item 6): bytes one camera
+        # frame ships per wire mode, and the tunnel bandwidth at which
+        # the pipeline leg would saturate the device — pins how much of
+        # the pipeline/device gap is environmental
+        "detect_wire_bytes_dct8": _detect_wire_bytes("dct8"),
+        "detect_wire_bytes_raw": DETECT_IMAGE * DETECT_IMAGE * 3,
+        "detect_breakeven_MBps": round(
+            detect_device_fps * _detect_wire_bytes(DETECT_WIRE) / 1e6,
+            1),
     }) | ({} if detect_mfu is None else {
         "detect_mfu": round(detect_mfu, 4),
     }) | {k: v for k, v in latency.items()
