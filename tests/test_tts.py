@@ -384,10 +384,13 @@ def test_vocoder_forward_shape_and_jit():
 
 
 @pytest.mark.skipif(not os.environ.get("AIKO_HEAVY_TESTS"),
-                    reason="~10 min single-core: vocoder training; "
-                           "run with AIKO_HEAVY_TESTS=1 (measured "
-                           "2026-07-31 on TPU v5e: vocoder 23.9 dB vs "
-                           "GL-16 31.6 / GL-32 22.7)")
+                    reason="vocoder training: minutes on an "
+                           "accelerator, ~1 h on this 1-core CPU "
+                           "(conftest forces the CPU backend) — run "
+                           "with AIKO_HEAVY_TESTS=1, or standalone "
+                           "outside pytest on the device.  Measured "
+                           "2026-07-31 on TPU v5e: vocoder 23.88 dB "
+                           "vs GL-16 31.58 / GL-32 22.72")
 def test_vocoder_vs_griffin_lim_held_out_mcd():
     """The round-5 vocoder step-up (VERDICT r4 item 8), measured by
     copy-synthesis on HELD-OUT text (ground-truth mel in, waveform
