@@ -26,6 +26,7 @@
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import deque
 from typing import Callable
@@ -39,6 +40,91 @@ from .models.llama import LlamaConfig, llama_ffn
 from .utils import get_logger
 
 __all__ = ["ContinuousDecoder", "DecodeRequest"]
+
+# decode attention inner loop for the "select" KV mode: "two_pass"
+# (scores einsum + softmax + weights einsum), "online" (flash-style
+# single sweep over time blocks with running max/sum — measured a
+# wash, -1%), or "vpu" (broadcast-multiply reductions — measured 70%
+# SLOWER; kept as the recorded dead end).  The "block" KV mode (the
+# default) hardcodes the two-pass einsums — ATTENTION_IMPL has no
+# effect there; tools/ab_decode_attention.py pins KV mode per case so
+# the labels stay meaningful.
+ATTENTION_IMPL = os.environ.get("AIKO_DECODE_ATTENTION", "two_pass")
+# KV write strategy inside the decode scan:
+#   "select" — masked full-cache select per step (r4 design);
+#   "block"  — new tokens land in a small [S, H, num_steps, D] side
+#              buffer at the SCAN index (uniform across slots, so XLA
+#              updates in place) and merge into the main cache once per
+#              round.  The main cache is READ-ONLY inside the scan.
+# Measured motivation: step time vs cache size has a 37.9 us/T slope
+# where the read-only floor is 10.2 us/T — the functional full-cache
+# select makes XLA touch the KV ~4x per step (read for the select,
+# write the full result, read again for attention, x K and V).  The
+# side buffer removes every full-cache write from the hot loop:
+# measured 14.6 -> 11.4 ms/step at the 1b/256-slot/cache-256 serving
+# shape (slope 37.9 -> 16.1 us/T), identical tokens vs the oracle
+# across the whole serving suite.  "select" remains available; it
+# measures slightly better only below ~cache 180 (the merge+side
+# fixed cost), where steps are cheap anyway.
+KV_WRITE = os.environ.get("AIKO_DECODE_KV", "block")
+_ONLINE_BLOCK = 256         # time-block per online-softmax sweep step
+
+
+def _online_decode_attention(q_grouped, k_cache, v_cache, lengths,
+                             scale):
+    """Single-pass GQA decode attention: lax.scan over time blocks
+    with a running (max, sum, accumulator) — the flash-attention
+    recurrence expressed in plain XLA, so K and V stream through HBM
+    exactly once instead of once per einsum pass.
+
+    q_grouped: [S, Hkv, G, 1, D]; caches [S, Hkv, T, D]; lengths [S].
+    Returns [S, Hkv, G, 1, D] f32."""
+    slots_n, num_kv, group, num_q, head_dim = q_grouped.shape
+    t_total = k_cache.shape[2]
+    block = min(_ONLINE_BLOCK, t_total)
+    num_blocks = -(-t_total // block)
+    pad = num_blocks * block - t_total
+    if pad:
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # [blocks, S, Hkv, block, D]: scan carries one block per step
+    k_blocks = jnp.moveaxis(
+        k_cache.reshape(slots_n, num_kv, num_blocks, block, head_dim),
+        2, 0)
+    v_blocks = jnp.moveaxis(
+        v_cache.reshape(slots_n, num_kv, num_blocks, block, head_dim),
+        2, 0)
+    positions = jnp.arange(block)
+
+    def body(carry, inputs):
+        running_max, running_sum, acc = carry
+        index, k_blk, v_blk = inputs
+        t0 = index * block
+        valid = ((t0 + positions)[None, :] <=
+                 lengths[:, None])[:, None, None, None]   # [S,1,1,1,B]
+        scores = jnp.einsum("skgqd,skbd->skgqb", q_grouped, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid, scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_max = jnp.maximum(running_max, blk_max)
+        # rescale the old accumulator into the new max's frame
+        correction = jnp.exp(running_max - new_max)
+        probs = jnp.exp(scores - new_max)
+        new_sum = running_sum * correction + \
+            jnp.sum(probs, axis=-1, keepdims=True)
+        acc = acc * correction + jnp.einsum(
+            "skgqb,skbd->skgqd", probs.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        return (new_max, new_sum, acc), None
+
+    init = (jnp.full((slots_n, num_kv, group, num_q, 1), -jnp.inf,
+                     jnp.float32),
+            jnp.zeros((slots_n, num_kv, group, num_q, 1), jnp.float32),
+            jnp.zeros((slots_n, num_kv, group, num_q, head_dim),
+                      jnp.float32))
+    (final_max, final_sum, acc), _ = jax.lax.scan(
+        body, init, (jnp.arange(num_blocks), k_blocks, v_blocks))
+    return acc / jnp.maximum(final_sum, 1e-30)
 
 
 @dataclasses.dataclass
@@ -108,20 +194,90 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
     # explicit f32 upcast of the cache would double the HBM bytes of
     # the read, which is the dominant cost of the step.
     slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
-    valid = (jnp.arange(k_cache.shape[2])[None] <=
-             lengths[:, None])[:, None, None, None]    # [S,1,1,1,T]
     group = num_heads // num_kv
     q_grouped = q.reshape(slots_n, num_kv, group, num_q, head_dim)
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
-    scores = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_cache,
-                        preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(valid, scores, -1e30)
-    weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("skgqt,sktd->skgqd", weights, v_cache,
-                     preferred_element_type=jnp.float32)
+    if ATTENTION_IMPL == "online":
+        out = _online_decode_attention(q_grouped, k_cache, v_cache,
+                                       lengths, scale)
+    elif ATTENTION_IMPL == "vpu":
+        # broadcast-multiply + reduce instead of MXU matmuls: the
+        # per-(slot, kv-head) matmul is M=group (tiny) — issue-rate
+        # bound on the MXU; the VPU variant streams the same bytes as
+        # fused elementwise reductions
+        valid = (jnp.arange(k_cache.shape[2])[None] <=
+                 lengths[:, None])[:, None, None]        # [S,1,1,T]
+        q_sq = q_grouped[:, :, :, 0]                     # [S,kv,G,D]
+        scores = jnp.sum(
+            q_sq[:, :, :, None, :].astype(jnp.float32) *
+            k_cache[:, :, None, :, :].astype(jnp.float32),
+            axis=-1) * scale                             # [S,kv,G,T]
+        scores = jnp.where(valid, scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.sum(
+            weights[..., None] *
+            v_cache[:, :, None, :, :].astype(jnp.float32),
+            axis=3)[:, :, :, None, :]                    # [S,kv,G,1,D]
+    else:
+        valid = (jnp.arange(k_cache.shape[2])[None] <=
+                 lengths[:, None])[:, None, None, None]  # [S,1,1,1,T]
+        scores = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_cache,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid, scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+        out = jnp.einsum("skgqt,sktd->skgqd", weights, v_cache,
+                         preferred_element_type=jnp.float32)
     out = out.reshape(slots_n, num_heads, num_q, head_dim).astype(x.dtype)
     return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
             k_cache, v_cache)
+
+
+def _slot_attention_block(layer, config: LlamaConfig, x, cos, sin,
+                          k_cache, v_cache, k_side, v_side,
+                          entry_lengths, lengths, step_index):
+    """Block-KV decode attention: the main cache is read-only (tokens
+    [0, entry_lengths) per slot); this round's tokens live in the side
+    buffers at scan indices [0, step_index].  The new token's K/V is
+    written to side[:, :, step_index] — a slot-uniform index, so XLA
+    keeps the update in place instead of rewriting the whole cache."""
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    q = L._split_heads(L.linear(layer["attn"]["q"], x), num_heads)
+    k = L._split_heads(L.linear(layer["attn"]["k"], x), num_kv)
+    v = L._split_heads(L.linear(layer["attn"]["v"], x), num_kv)
+    q = L.apply_rope(q, cos, sin, lengths)
+    k = L.apply_rope(k, cos, sin, lengths)
+    k_side = jax.lax.dynamic_update_slice_in_dim(k_side, k, step_index,
+                                                 axis=2)
+    v_side = jax.lax.dynamic_update_slice_in_dim(v_side, v, step_index,
+                                                 axis=2)
+
+    slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
+    group = num_heads // num_kv
+    q_grouped = q.reshape(slots_n, num_kv, group, num_q, head_dim)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    main_valid = (jnp.arange(k_cache.shape[2])[None] <
+                  entry_lengths[:, None])[:, None, None, None]
+    side_positions = jnp.arange(k_side.shape[2])
+    side_valid = ((side_positions[None] <= step_index) &
+                  (side_positions[None] <
+                   (lengths - entry_lengths + 1)[:, None])
+                  )[:, None, None, None]
+    scores_main = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_cache,
+                             preferred_element_type=jnp.float32) * scale
+    scores_side = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_side,
+                             preferred_element_type=jnp.float32) * scale
+    scores = jnp.concatenate(
+        [jnp.where(main_valid, scores_main, -1e30),
+         jnp.where(side_valid, scores_side, -1e30)], axis=-1)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    main_t = k_cache.shape[2]
+    out = jnp.einsum("skgqt,sktd->skgqd", weights[..., :main_t],
+                     v_cache, preferred_element_type=jnp.float32) + \
+        jnp.einsum("skgqt,sktd->skgqd", weights[..., main_t:], v_side,
+                   preferred_element_type=jnp.float32)
+    out = out.reshape(slots_n, num_heads, num_q, head_dim).astype(x.dtype)
+    return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
+            k_side, v_side)
 
 
 def _build_step(config: LlamaConfig):
@@ -133,17 +289,14 @@ def _build_step(config: LlamaConfig):
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
 
-    def one_token(params, tokens, lengths, active, k_caches, v_caches):
+    def run_layers(params, tokens, attend):
+        """Shared per-token transformer pass: `attend(i, layer,
+        normed)` supplies each layer's attention output (and owns the
+        cache-write strategy)."""
         x = L.embedding(params["embed"],
                         tokens[:, None]).astype(config.dtype)
-        new_k, new_v = [], []
         for i, layer in enumerate(params["layers"]):
-            attn_out, k_c, v_c = _slot_attention(
-                layer, config, L.rms_norm(layer["ln_attn"], x),
-                cos, sin, k_caches[i], v_caches[i], lengths, active)
-            new_k.append(k_c)
-            new_v.append(v_c)
-            x = x + attn_out
+            x = x + attend(i, layer, L.rms_norm(layer["ln_attn"], x))
             normed = L.rms_norm(layer["ln_mlp"], x)
             # dense SwiGLU or MoE per the config — MoE llama serves
             # through the same continuous-batching step
@@ -155,7 +308,20 @@ def _build_step(config: LlamaConfig):
         # to bf16 first can flip near-ties against the f32 oracle
         logits = jnp.einsum("std,dv->stv", x, params["lm_head"]["w"],
                             preferred_element_type=jnp.float32)
-        next_tokens = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def one_token(params, tokens, lengths, active, k_caches, v_caches):
+        new_k, new_v = [], []
+
+        def attend(i, layer, normed):
+            attn_out, k_c, v_c = _slot_attention(
+                layer, config, normed, cos, sin, k_caches[i],
+                v_caches[i], lengths, active)
+            new_k.append(k_c)
+            new_v.append(v_c)
+            return attn_out
+
+        next_tokens = run_layers(params, tokens, attend)
         return next_tokens, new_k, new_v
 
     def step_k(params, tokens, lengths, active, budgets, k_caches,
@@ -190,7 +356,78 @@ def _build_step(config: LlamaConfig):
         return (emitted, emitted_active, tokens_in, tokens, lengths,
                 k_caches, v_caches)
 
-    return jax.jit(step_k,
+    def step_k_block(params, tokens, lengths, active, budgets,
+                     k_caches, v_caches, num_steps, eos):
+        """Block-KV variant of step_k: the main caches stay READ-ONLY
+        through the scan (closed over, never carried), this round's
+        K/V land in [S, H, num_steps, D] side buffers at the scan
+        index, and one per-slot merge runs after the scan.  Removes
+        the per-step full-cache writes that made each step touch the
+        KV ~4x (measured slope 37.9 us/T vs a 10.2 read-only floor)."""
+        entry_lengths = lengths
+        entry_active = active
+        slots_n = tokens.shape[0]
+        side_shape = (slots_n, config.num_kv_heads, num_steps,
+                      config.head_dim)
+        k_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        v_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+
+        def body(carry, step_index):
+            tokens, lengths, active, budgets, k_sides, v_sides = carry
+            new_k, new_v = [], []
+
+            def attend(i, layer, normed):
+                attn_out, k_s, v_s = _slot_attention_block(
+                    layer, config, normed, cos, sin, k_caches[i],
+                    v_caches[i], k_sides[i], v_sides[i],
+                    entry_lengths, lengths, step_index)
+                new_k.append(k_s)
+                new_v.append(v_s)
+                return attn_out
+
+            next_tokens = run_layers(params, tokens, attend)
+            next_tokens = jnp.where(active, next_tokens, tokens)
+            lengths = jnp.where(active, lengths + 1, lengths)
+            budgets = jnp.where(active, budgets - 1, budgets)
+            still = active & (budgets > 0) & (next_tokens != eos)
+            return ((next_tokens, lengths, still, budgets, new_k,
+                     new_v), (next_tokens, active))
+
+        tokens_in = tokens
+        (tokens, lengths, active, budgets, k_sides, v_sides), \
+            (emitted, emitted_active) = jax.lax.scan(
+                body, (tokens, lengths, active, budgets, k_sides,
+                       v_sides), jnp.arange(num_steps))
+
+        # one merge per round: each slot's side tokens scatter into the
+        # main cache at its round-entry offset.  Rows past a slot's
+        # actual take are garbage landing at positions beyond its
+        # length — dead cells, overwritten before they are ever
+        # attended (same invariant as the admit scatter's padding).
+        # Slots INACTIVE at round entry must not merge at all: a
+        # mid-prefill slot's stale length points INTO the prompt its
+        # extend chunks are writing (the same corruption the select
+        # mode's write_mask guards against).
+        merge_at = jnp.minimum(entry_lengths,
+                               k_caches[0].shape[2] - num_steps)
+        keep = entry_active[:, None, None, None]
+
+        def merge(cache, side):
+            updated = jax.vmap(
+                lambda row, srow, off: jax.lax.dynamic_update_slice(
+                    row, srow, (0, off, 0)))(cache, side, merge_at)
+            return jnp.where(keep, updated, cache)
+
+        new_k_caches = [merge(k_caches[i], k_sides[i])
+                        for i in range(config.num_layers)]
+        new_v_caches = [merge(v_caches[i], v_sides[i])
+                        for i in range(config.num_layers)]
+        return (emitted, emitted_active, tokens_in, tokens, lengths,
+                new_k_caches, new_v_caches)
+
+    return jax.jit(step_k_block if KV_WRITE == "block" else step_k,
                    static_argnames=("num_steps", "eos"),
                    donate_argnames=("k_caches", "v_caches"))
 
@@ -594,11 +831,16 @@ class ContinuousDecoder:
 
     def _fit_caches(self, required_t: int) -> None:
         """Resize the cache time axis to the t_block multiple covering
-        `required_t` (clamped to max_seq).  A grow pads with zeros, a
-        shrink slices — one whole-cache copy, amortized over the many
-        rounds run at the new size.  No-op when already sized."""
-        new_t = min(self.max_seq,
-                    -(-required_t // self.t_block) * self.t_block)
+        `required_t` (clamped to max_seq — plus steps_per_sync scratch
+        headroom in block-KV mode, so a round-end side-buffer merge
+        near the seq cap never clamps into a misaligned overwrite;
+        the headroom cells are never attended).  A grow pads with
+        zeros, a shrink slices — one whole-cache copy, amortized over
+        the many rounds run at the new size.  No-op when already
+        sized."""
+        cap = self.max_seq + (self.steps_per_sync
+                              if KV_WRITE == "block" else 0)
+        new_t = min(cap, -(-required_t // self.t_block) * self.t_block)
         if new_t == self._cache_t:
             return
         key = (self._cache_t, new_t)
@@ -816,9 +1058,11 @@ class ContinuousDecoder:
             self._k, self._v, num_steps=num_steps,
             eos=-1 if self.eos_token is None else int(self.eos_token))
         self.stats["steps"] += num_steps
-        emitted = np.asarray(emitted)            # [K, S] host sync
-        emitted_active = np.asarray(emitted_active)
-        tokens_in = np.asarray(tokens_in)
+        # ONE host transfer for all three sync arrays: separate
+        # np.asarray calls pay one tunnel round trip each (~115 ms on
+        # a tunneled bench chip, 3x per round)
+        emitted, emitted_active, tokens_in = jax.device_get(
+            (emitted, emitted_active, tokens_in))
         self.stats["decode_s"] += time.perf_counter() - decode_start
         useful = int(emitted_active[:, occupied].sum())
         self.stats["useful_steps"] += useful

@@ -529,12 +529,15 @@ class PipelineBench:
         self._latencies: list[float] = []
         self._posted = 0
         self._completed = 0
-        self._streams = 0
 
     def _ensure_streams(self, n: int) -> None:
-        for i in range(self._streams, n):
-            self.pipeline.create_stream(f"s{i}", lease_time=0)
-        self._streams = max(self._streams, n)
+        # membership check, not a high-water counter: a transient
+        # tunnel failure destroys a stream (per-stream failure
+        # isolation), and every later rung would silently post into
+        # the void — the constant-192-lost-frames ladder collapse
+        for i in range(n):
+            if f"s{i}" not in self.pipeline.streams:
+                self.pipeline.create_stream(f"s{i}", lease_time=0)
 
     def _post(self, stream_id: str) -> None:
         self._post_times[stream_id].append(time.perf_counter())
@@ -604,9 +607,32 @@ class PipelineBench:
                 lambda: time.perf_counter() >= deadline, timeout=window + 30)
             drain_started = time.perf_counter()
             # hard drain between rungs so backlog never bleeds into the
-            # next measurement
-            drained = self.engine.run_until(
-                lambda: self._completed >= self._posted, timeout=180.0)
+            # next measurement — judged on THIS RUNG'S deltas (frames
+            # lost in an earlier rung must not poison this one), and
+            # frames KILLED by a transient tunnel failure never
+            # complete: stop waiting when completions make no progress
+            # instead of burning the full timeout every rung
+            def rung_drained():
+                return (self._completed - completed_before >=
+                        self._posted - posted_before)
+
+            progress = [self._completed, time.perf_counter()]
+
+            def drained_or_stalled():
+                if rung_drained():
+                    return True
+                if self._completed > progress[0]:
+                    progress[0] = self._completed
+                    progress[1] = time.perf_counter()
+                return time.perf_counter() - progress[1] > 20.0
+
+            self.engine.run_until(drained_or_stalled, timeout=180.0)
+            drained = rung_drained()
+            if not drained:
+                print(f"rung n={n_streams}: "
+                      f"{(self._posted - posted_before) - (self._completed - completed_before)}"
+                      f" frames lost (transient element failures)",
+                      file=sys.stderr)
         finally:
             self.engine.remove_timer_handler(timer)
 
@@ -1004,12 +1030,94 @@ def bench_llama(window: float):
         if slo["itl_p95_ms"] is not None else None,
         "llama_stall_p95_ms": round(slo["stall_p95_ms"], 1)
         if slo["stall_p95_ms"] is not None else None,
+        "llama_slo_note": "closed-loop saturation (2x "
+                          "oversubscription): ttft measures queue "
+                          "depth; itl null = whole generation lands "
+                          "in one 64-step sync burst — see "
+                          "llama_int_* for the interactive config",
     }) | ({} if membw is None else {
         "llama_roofline_step_ms": round(
             decoder.stats["bytes_moved"] / steps / membw * 1000.0, 2),
     }) | ({} if mfu is None else {"llama_mfu": round(mfu, 4)}) \
         | ({} if bw_util is None else {"llama_hbm_bw_util":
                                        round(bw_util, 3)})
+
+
+def bench_llama_interactive(window: float = 12.0):
+    """Interactive-config llama SLOs: the saturation bench above keeps a
+    2× closed-loop backlog and syncs 64 steps at once, so TTFT measures
+    queue depth and ITL is a single burst (unobservable by design).
+    This section measures the INTERACTIVE operating point instead:
+    fewer slots, 8 steps/sync, Poisson arrivals at ~60% of measured
+    capacity — real TTFT and inter-token latency percentiles from the
+    serving engine's own per-request timestamps."""
+    import dataclasses as _dc
+
+    from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+    from aiko_services_tpu.serving import ContinuousDecoder
+
+    slots, sps, max_new = 64, 8, 64
+    base = LLAMA_PRESETS[LLAMA_PRESET]
+    config = _dc.replace(base, dtype=jnp.bfloat16, max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    decoder = ContinuousDecoder(params, config, max_slots=slots,
+                                max_seq=1024, prefill_buckets=(128,),
+                                steps_per_sync=sps, name="bench_int")
+    rng = np.random.default_rng(23)
+
+    def submit_one(index):
+        prompt = rng.integers(
+            1, config.vocab, size=int(rng.integers(16, 120))).tolist()
+        decoder.submit(f"i{index}", prompt, max_new, lambda *_: None)
+
+    # warmup: trickle submissions so EVERY pow2 admit width (1, 2, 4,
+    # ... slots) compiles before the measured window — a width first
+    # seen mid-measurement would land its compile stall straight into
+    # the TTFT/stall percentiles
+    count_warm = 0
+    for width in [1, 1, 2, 4, 8, 16, 32][:slots.bit_length()] + [slots]:
+        for _ in range(width):
+            submit_one(count_warm)
+            count_warm += 1
+        decoder.pump()
+    while not decoder.idle:
+        decoder.pump()
+    decoder.ttft_samples.clear()
+    decoder.itl_samples.clear()
+    decoder.gap_samples.clear()
+
+    # ~60% load keeps queues short so TTFT measures admission+prefill,
+    # not backlog.  Prior: a round of `sps` steps costs ~sps*6ms device
+    # + ~115ms tunnel sync on this machine → ~20ms/step effective at
+    # sps=8 (measured 50 req/s ran at ~104% load and queued)
+    rate = 0.6 * slots / (max_new * 0.020)
+    start = time.monotonic()
+    deadline = start + window
+    next_arrival = start
+    count = count_warm
+    while time.monotonic() < deadline or not decoder.idle:
+        now = time.monotonic()
+        while next_arrival <= now and now < deadline:
+            submit_one(count)
+            count += 1
+            next_arrival += float(rng.exponential(1.0 / rate))
+        decoder.pump()
+    slo = decoder.slo_stats()
+    if slo["ttft_p50_ms"] is None:
+        return {}
+    fields = {
+        "llama_int_config": f"{LLAMA_PRESET} bf16, {slots} slots, "
+                            f"{sps} steps/sync, poisson "
+                            f"{rate:.0f} req/s",
+        "llama_int_ttft_p50_ms": round(slo["ttft_p50_ms"], 1),
+        "llama_int_ttft_p95_ms": round(slo["ttft_p95_ms"], 1),
+    }
+    for key, field in (("itl_p50_ms", "llama_int_itl_p50_ms"),
+                       ("itl_p95_ms", "llama_int_itl_p95_ms"),
+                       ("stall_p95_ms", "llama_int_stall_p95_ms")):
+        if slo[key] is not None:
+            fields[field] = round(slo[key], 2)
+    return fields
 
 
 # -- low-latency operating point ---------------------------------------------
@@ -1403,6 +1511,14 @@ def main() -> None:
     except Exception as exc:
         llama = {}
         print(f"llama bench failed: {exc!r}", file=sys.stderr)
+    try:
+        llama |= bench_llama_interactive()
+        print(f"llama interactive SLOs: "
+              f"{ {k: v for k, v in llama.items() if '_int_' in k} }",
+              file=sys.stderr)
+    except Exception as exc:
+        print(f"llama interactive bench failed: {exc!r}",
+              file=sys.stderr)
     import gc
     gc.collect()
     jax.clear_caches()

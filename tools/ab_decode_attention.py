@@ -1,0 +1,85 @@
+# A/B the decode-attention inner loop IN-PROGRAM (serving._build_step,
+# the exact compiled step the ContinuousDecoder runs): two_pass
+# (score/weight einsums) vs online (flash-style single sweep) vs vpu
+# (broadcast-multiply reductions).  Microbenchmark wins do not survive
+# program context (measured on the int8-KV lever: +35% isolated, -24%
+# fused), so the only number that counts is the chained full-step time
+# at the serving shape.
+#
+#   python tools/ab_decode_attention.py [preset] [slots] [cache_t]
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def measure(impl: str, preset: str, slots: int, cache_t: int,
+            num_steps: int = 64, chains: int = 4,
+            kv_write: str = "select") -> float:
+    from aiko_services_tpu import serving
+    from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+
+    # ATTENTION_IMPL only affects the "select" step (the block-KV scan
+    # hardcodes the two-pass einsums) — force the KV mode so the
+    # labels mean what they say
+    serving.KV_WRITE = kv_write
+    serving.ATTENTION_IMPL = impl
+    config = dataclasses.replace(LLAMA_PRESETS[preset],
+                                 dtype=jnp.bfloat16, max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    step = serving._build_step(config)
+    shape = (slots, config.num_kv_heads, cache_t, config.head_dim)
+    k = [jnp.zeros(shape, config.dtype)
+         for _ in range(config.num_layers)]
+    v = [jnp.zeros(shape, config.dtype)
+         for _ in range(config.num_layers)]
+    tokens = jnp.ones((slots,), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    active = jnp.ones((slots,), bool)
+    budgets = jnp.full((slots,), 1 << 30, jnp.int32)
+
+    def chain(rounds):
+        nonlocal tokens, lengths, k, v
+        out = None
+        for _ in range(rounds):
+            out = step(params, tokens, lengths, active, budgets, k, v,
+                       num_steps=num_steps, eos=-1)
+            _, _, _, tokens, lengths, k, v = out
+        np.asarray(out[0][-1])            # one sync for the chain
+    chain(1)                               # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        chain(chains)
+        best = min(best, (time.perf_counter() - start) /
+                   (chains * num_steps))
+    return best * 1000.0
+
+
+def main() -> None:
+    preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
+    slots = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    cache_t = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+    cases = [("two_pass", "select"), ("online", "select"),
+             ("vpu", "select"), ("two_pass", "block")]
+    for impl, kv_write in cases:
+        label = f"{impl}/{kv_write}"
+        try:
+            ms = measure(impl, preset, slots, cache_t,
+                         kv_write=kv_write)
+            print(f"{label:17s}: {ms:.3f} ms/step "
+                  f"({preset}, {slots} slots, cache {cache_t})")
+        except Exception as exc:
+            print(f"{label:17s}: FAILED {exc!r}")
+        jax.clear_caches()
+
+
+if __name__ == "__main__":
+    main()
