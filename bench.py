@@ -1012,12 +1012,21 @@ def bench_llama(window: float):
                         f"{LLAMA_STEPS_PER_SYNC} steps/sync, "
                         f"deferred admit",
     } | ({} if device_step_ms is None else {
-        # device compute per step (chained, one sync) vs the serving
-        # step above (which carries one tunnel dispatch+sync per
-        # steps_per_sync round) — the difference is the wire tax
+        # device compute per DECODE step (chained, one sync) vs the
+        # serving round above.  The difference is NOT all wire tax:
+        # deferred-admit prefills execute inside the round (at this
+        # workload every slot re-prefills each round, ~79 TFLOP of
+        # near-roofline prefill per admit wave) plus ~0.1-0.15 s of
+        # tunnel launch+sync per round — decomposition measured
+        # 2026-07-31: round ≈ admit ~0.4 s + decode 0.73 s + wire
+        # ~0.15 s at 256 slots
         "llama_device_step_ms": round(device_step_ms, 3),
-        "llama_dispatch_overhead_ms": round(
+        "llama_overhead_ms_per_step": round(
             max(0.0, decode_s * 1000.0 / steps - device_step_ms), 3),
+        "llama_overhead_note": "overhead = deferred-admit prefill "
+                               "compute riding the round + tunnel "
+                               "launch/sync; see llama_prefill_frac "
+                               "for host-side admit time only",
     }) | ({} if slo["ttft_p50_ms"] is None else {
         # measured per-request latency SLOs (serving.slo_stats):
         # TTFT submit→first burst; ITL per-request mean; stall = worst

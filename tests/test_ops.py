@@ -331,3 +331,24 @@ def test_slaney_mel_scale_breakpoints():
     for hz in (200.0, 999.0, 1000.0, 4000.0, 7999.0):
         back = float(_mel_to_hz(jnp.array(_hz_to_mel(hz))))
         assert back == pytest.approx(hz, rel=1e-5)
+
+
+def test_cross_decode_attention_matches_reference():
+    """The (recorded-dead-end) pallas cross-decode kernel must stay
+    numerically correct vs plain attention — it documents a measured
+    negative result and may be retried with better packing later."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiko_services_tpu.ops.attention import cross_decode_attention
+    from aiko_services_tpu.parallel.ring_attention import \
+        attention_reference
+
+    b, h, t, d = 3, 4, 50, 64          # t deliberately non-128-aligned
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d))
+    out = cross_decode_attention(q, k, v)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
