@@ -205,14 +205,20 @@ class PE_WhisperASR(PipelineElement):
         compression_threshold, _ = self.get_parameter(
             "compression_ratio_threshold", 2.4)
         self.compression_threshold = float(compression_threshold)
-        # int8 cross-attention KV (opt-in): halves the cross-KV's HBM
-        # FOOTPRINT (a capacity lever for bigger batches); transcript
-        # parity holds on the golden model.  NOT a throughput win in
-        # the fused program — XLA re-materializes the dequantized KV
-        # per decode step (measured ~24% slower at batch 256), so
-        # enable it for memory, not speed.
+        # int8 cross-attention KV (opt-in).  Two modes
+        # (layers.quantize_kv): true/"position" halves the cross-KV's
+        # HBM FOOTPRINT only (the per-position dequant multiply
+        # re-materializes per decode step — measured ~24% SLOWER at
+        # batch 256); "tensor" uses one scale per BATCH ELEMENT so the
+        # dequant is a bare convert fused into the attention dot —
+        # halves the decode tail's dominant READ as well (measured
+        # −14% round; see the bench's chip kv-quant A/B).
         kv_quant, _ = self.get_parameter("kv_quant", False)
-        self.kv_quant = parse_bool(kv_quant, False)
+        kv_mode = str(kv_quant).lower()
+        if kv_mode in ("tensor", "position"):
+            self.kv_quant = kv_mode
+        else:
+            self.kv_quant = parse_bool(kv_quant, False)
 
         compute_name, _ = self.get_parameter("compute", "compute")
         self.compute = self.runtime.service_by_name(compute_name)

@@ -210,15 +210,41 @@ def precompute_kv(params, kv_input, num_kv_heads: int):
     return k, v
 
 
-def quantize_kv(tensor):
-    """Per-position symmetric int8 quantization of a K or V tensor
-    [..., T, D] (scale over the last axis).  Halves the HBM FOOTPRINT
-    of a precomputed KV cache (sub-1% error, golden-transcript parity
-    tested) — a capacity lever.  Measured caveat: in an isolated
-    cross-attention scan the int8 read is ~35% faster, but inside the
-    full whisper decode program XLA re-materializes the dequantized
-    bf16 KV per step and throughput LOSES ~24%; treat it as memory
-    compression, not acceleration.  Returns {"q": int8, "s": scale}."""
+def quantize_kv(tensor, mode: str = "position"):
+    """Symmetric int8 quantization of a K or V tensor [..., T, D].
+    Halves the HBM footprint of a precomputed KV cache — and, in
+    "tensor" mode, halves the decode tail's dominant read.
+
+    mode="position": scale over the last axis (per-position, bf16
+    scales).  Finer-grained, but the dequant is a broadcast MULTIPLY —
+    measured in-program, XLA re-materializes the dequantized bf16 KV
+    every scan step and throughput LOSES ~24%.  Memory lever only.
+
+    mode="tensor": ONE f32 scale per leading-axis element (per batch
+    item for a [B, H, T, D] cache — NOT one global scalar: a single
+    loud co-batched stream would coarsen every other stream's
+    quantization and make transcripts depend on batch composition).
+    The scale is constant along the head/position/feature axes, so
+    the dequant is a bare int8→bf16 convert as the dot operand (mha
+    folds the scale into the softmax scale / output as a per-batch
+    broadcast), which XLA fuses instead of materializing — measured
+    r5 at the whisper decode shape: 38% faster per step than the
+    bf16 read in isolation (tools/diag_attn_patterns.py: 1334 vs
+    2156 us/rep), −14% whole-round in the fused program (a global
+    scalar measured −17% but couples co-batched streams).  Coarser
+    scale than "position", so slightly larger error.
+
+    Returns {"q": int8, "s": scale} — dequantize_kv handles both
+    (the scale broadcasts)."""
+    if mode == "tensor":
+        axes = tuple(range(1, tensor.ndim))
+        scale = (jnp.max(jnp.abs(tensor), axis=axes, keepdims=True)
+                 .astype(jnp.float32) / 127.0 + 1e-12)
+        q = jnp.clip(jnp.round(tensor.astype(jnp.float32) / scale),
+                     -127, 127).astype(jnp.int8)
+        return {"q": q, "s": scale}
+    if mode != "position":
+        raise ValueError(f"unknown quantize_kv mode {mode!r}")
     scale = (jnp.max(jnp.abs(tensor), axis=-1, keepdims=True)
              .astype(jnp.float32) / 127.0 + 1e-12).astype(jnp.bfloat16)
     q = jnp.clip(jnp.round(tensor.astype(jnp.float32) /
@@ -247,10 +273,26 @@ def mha(params, x, kv_input=None, mask=None, cache=None,
     Returns (output, new_cache)."""
     num_kv_heads = num_kv_heads or num_heads
     q = _split_heads(linear(params["q"], x), num_heads)
+    # mode="tensor"-quantized KV: keep the int8 buffer as the dot
+    # operand (a bare convert fuses; a per-POSITION scale multiply
+    # materializes a bf16 copy per decode step — measured −24%) and
+    # fold the per-batch scales into the score scale / output.  A
+    # scale qualifies for folding iff it is constant along every axis
+    # but the batch one (scalar, or [B,1,...,1]).
+    def _foldable(s):
+        return jnp.ndim(s) == 0 or all(d == 1 for d in s.shape[1:])
+
+    k_scale = v_scale = None
     if precomputed_kv is not None:
         k, v = precomputed_kv
-        k = dequantize_kv(k, x.dtype)
-        v = dequantize_kv(v, x.dtype)
+        if isinstance(k, dict) and _foldable(k["s"]):
+            # scale shapes [B,1,1,1] broadcast against scores
+            # [B,H,Tq,Tk] and output [B,H,Tq,D] directly
+            k_scale, v_scale = k["s"], v["s"]
+            k, v = k["q"].astype(x.dtype), v["q"].astype(x.dtype)
+        else:
+            k = dequantize_kv(k, x.dtype)
+            v = dequantize_kv(v, x.dtype)
     else:
         k, v = precompute_kv(params, x if kv_input is None else kv_input,
                              num_kv_heads)
@@ -269,8 +311,8 @@ def mha(params, x, kv_input=None, mask=None, cache=None,
         k = jnp.repeat(k, repeat, axis=1)
         v = jnp.repeat(v, repeat, axis=1)
 
-    if fused and mask is None and cache is None and \
-            q.shape[2] == k.shape[2]:
+    if fused and mask is None and cache is None and k_scale is None \
+            and q.shape[2] == k.shape[2]:
         # mask-free self/cross attention: fused flash path (pallas on TPU
         # when shapes tile, XLA otherwise)
         from ..ops.attention import attention
@@ -278,13 +320,18 @@ def mha(params, x, kv_input=None, mask=None, cache=None,
         return linear(params["o"], _merge_heads(out)), cache
 
     scale = 1.0 / math.sqrt(q.shape[-1])
+    if k_scale is not None:
+        scale = scale * k_scale
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", weights, v,
-                     preferred_element_type=jnp.float32).astype(x.dtype)
+                     preferred_element_type=jnp.float32)
+    if v_scale is not None:
+        out = out * v_scale
+    out = out.astype(x.dtype)
     return linear(params["o"], _merge_heads(out)), cache
 
 

@@ -259,18 +259,24 @@ def _decoder_block(block, x, cross_kv, num_heads, self_cache, mask):
 
 
 def precompute_cross_kv(params, config: WhisperConfig, audio,
-                        quantize: bool = False):
+                        quantize=False):
     """Project every decoder block's cross-attention K/V over the audio
     features ONCE per utterance — the decode loop then only projects Q
     (recomputing these per token was pure wasted MXU work).
 
-    quantize=True stores them int8 with per-position scales
-    (layers.quantize_kv) — half the HBM footprint; see quantize_kv's
-    measured throughput caveat before enabling it for speed."""
+    quantize: False (bf16), True/"position" (int8, per-position
+    scales — memory lever only: the dequant multiply re-materializes
+    per decode step, measured −24%), or "tensor" (int8, one scale per
+    BATCH ELEMENT — the dequant is a bare convert that fuses into the
+    attention dot; mha folds the per-batch scale into the softmax
+    scale.  Half the decode tail's dominant read, measured −14%
+    round).  See layers.quantize_kv for the measured numbers."""
     kv = [L.precompute_kv(block["cross"], audio, config.num_heads)
           for block in params["dec_blocks"]]
     if quantize:
-        kv = [(L.quantize_kv(k), L.quantize_kv(v)) for k, v in kv]
+        mode = quantize if isinstance(quantize, str) else "position"
+        kv = [(L.quantize_kv(k, mode), L.quantize_kv(v, mode))
+              for k, v in kv]
     return kv
 
 
@@ -316,7 +322,7 @@ def decode_step(params, config: WhisperConfig, tokens, cross_kv, caches,
 
 def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
                   sot_sequence=None, suppress_timestamps: bool = False,
-                  kv_quant: bool = False):
+                  kv_quant=False):
     """Batched greedy decoding as one compiled program.
 
     mel: [B, T_frames, n_mels] → (tokens [B, max_tokens], lengths [B]).
@@ -330,7 +336,7 @@ def greedy_decode(params, config: WhisperConfig, mel, max_tokens: int = 64,
 def greedy_decode_scored(params, config: WhisperConfig, mel,
                          max_tokens: int = 64, sot_sequence=None,
                          suppress_timestamps: bool = False,
-                         kv_quant: bool = False):
+                         kv_quant=False):
     """Batched greedy decoding with per-sequence quality scores.
 
     mel: [B, T_frames, n_mels] →
@@ -351,7 +357,7 @@ def greedy_decode_scored(params, config: WhisperConfig, mel,
 def greedy_decode_from_audio(params, config: WhisperConfig, audio,
                              max_tokens: int = 64, sot_sequence=None,
                              suppress_timestamps: bool = False,
-                             kv_quant: bool = False):
+                             kv_quant=False):
     """greedy_decode_scored from already-encoded audio features
     [B, n_audio_ctx, dim] — the pipeline-parallel stage boundary: an
     encoder stage on one device group hands features to a decode stage

@@ -205,3 +205,76 @@ def test_element_timestamps_output_segments(make_runtime, engine):
                                   {"timestamps": True,
                                    "logprob_threshold": -1e9}), engine)
     assert "segments" in swag and isinstance(swag["segments"], list)
+
+
+def test_kv_quant_tensor_parity():
+    """Int8 cross-KV mode="tensor" (one scale per BATCH ELEMENT folded
+    into the softmax scale, dequant is a bare convert that fuses into
+    the attention dot — the r5 throughput lever, measured −14% round
+    time at the bench geometry) must track the bf16 program's tokens
+    closely.  Exact parity does NOT hold: a greedy argmax near-tie can
+    flip under the ±0.4% quantization error and rewrite the suffix
+    (divergence cascade), so the gate is a match-rate floor — the
+    same property the bench A/B reports at batch 256 (0.82-0.87)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    config = dataclasses.replace(WHISPER_PRESETS["test"],
+                                 n_audio_ctx=32, n_text_ctx=24,
+                                 dtype=jnp.bfloat16)
+    params = whisper_init(jax.random.PRNGKey(0), config)
+    mel = jax.random.normal(jax.random.PRNGKey(3),
+                            (8, 64, config.n_mels), jnp.bfloat16)
+    tokens, lengths, scores = {}, {}, {}
+    for mode in (False, "tensor", "position"):
+        out = greedy_decode_scored(params, config, mel, max_tokens=12,
+                                   kv_quant=mode)
+        tokens[mode] = np.asarray(out[0])
+        lengths[mode] = np.asarray(out[1])
+        scores[mode] = np.asarray(out[2])
+    for mode in ("tensor", "position"):
+        # match only within decoded lengths (same mask as the bench
+        # A/B): post-EOT padding always agrees and would inflate the
+        # rate the gate exists to check
+        valid = np.arange(tokens[False].shape[1])[None, :] < \
+            np.minimum(lengths[False], lengths[mode])[:, None]
+        # token floor: observed 0.73-1.00 across configs/seeds (the
+        # flip point cascades), so the floor is deliberately loose...
+        match = (tokens[mode] == tokens[False])[valid].mean() \
+            if valid.any() else 1.0
+        assert match >= 0.7, f"{mode} int8 diverged too far: {match}"
+        # ...and the stable gate is QUALITY: a near-tie flip picks an
+        # almost-equally-likely token, so the mean log-probability of
+        # the emitted sequence must stay close even where tokens
+        # differ
+        gap = np.abs(scores[mode] - scores[False]).max()
+        assert gap < 0.15, f"{mode} int8 degraded avg_logprob by {gap}"
+
+
+def test_quantize_kv_tensor_mode_roundtrip():
+    """mode="tensor" returns one f32 scale per leading-axis element
+    (per batch item — a loud co-batched stream must not coarsen its
+    neighbours' quantization) and reconstructs within int8 precision;
+    unknown modes raise."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiko_services_tpu.models import layers as L
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 5, 16),
+                          jnp.bfloat16)
+    # make item 0 loud: its scale must not leak into items 1-2
+    x = x.at[0].multiply(100.0)
+    q = L.quantize_kv(x, mode="tensor")
+    assert q["s"].shape == (3, 1, 1) and q["s"].dtype == jnp.float32
+    scales = np.asarray(q["s"]).ravel()
+    assert scales[0] > 50 * scales[1]
+    recon = np.asarray(L.dequantize_kv(q, jnp.float32))
+    x32 = np.asarray(x, dtype=np.float32)
+    for i in range(3):
+        assert np.max(np.abs(recon[i] - x32[i])) <= \
+            scales[i] * 0.51 + 1e-6
+    with pytest.raises(ValueError):
+        L.quantize_kv(x, mode="nope")

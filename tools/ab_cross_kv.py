@@ -1,0 +1,82 @@
+# In-program A/B of the cross-KV modes at the bench's chip geometry
+# (whisper-small bf16, batch 256, 5 s chunks, 24 tokens): bf16 vs
+# int8 per-position (r4's memory lever, measured −24%) vs int8
+# per-tensor (r5: scalar scale folded into the softmax scale so the
+# dequant is a pure convert — 38% faster in ISOLATION, and the verify
+# notes demand the in-program number before believing it).
+#
+# Prints round ms + device-resident streams per mode and greedy-token
+# parity vs the bf16 program.
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from aiko_services_tpu.models import whisper_init  # noqa: E402
+from aiko_services_tpu.models.whisper import (  # noqa: E402
+    WHISPER_PRESETS, encode, greedy_decode_from_audio)
+from aiko_services_tpu.ops.audio import (  # noqa: E402
+    WHISPER_HOP, log_mel_spectrogram, mulaw_decode)
+
+BATCH = 256
+MAX_TOKENS = 24
+
+
+from diag_membw import timed_chain as timed  # noqa: E402  shared harness
+
+
+def main():
+    config = dataclasses.replace(
+        WHISPER_PRESETS["small"], n_audio_ctx=250,
+        n_text_ctx=MAX_TOKENS + 8, dtype=jnp.bfloat16)
+    params = whisper_init(jax.random.PRNGKey(0), config)
+    samples = config.n_audio_ctx * 2 * WHISPER_HOP
+    codes = jax.random.randint(jax.random.PRNGKey(2), (BATCH, samples),
+                               0, 256, jnp.int32).astype(jnp.uint8)
+
+    def fused(mode):
+        def f(params, pcm):
+            audio = mulaw_decode(pcm)
+            mel = log_mel_spectrogram(audio, num_mels=config.n_mels)
+            return greedy_decode_from_audio(
+                params, config,
+                encode(params, config, mel.astype(config.dtype)),
+                max_tokens=MAX_TOKENS, kv_quant=mode)
+        return f
+
+    results = {}
+    for mode in (False, "position", "tensor"):
+        compiled = jax.jit(fused(mode)).lower(params, codes).compile()
+        seconds = timed(compiled, params, codes)
+        out = compiled(params, codes)
+        tokens, lengths = np.asarray(out[0]), np.asarray(out[1])
+        results[mode] = (seconds, tokens, lengths)
+        streams = BATCH * 5.0 / seconds
+        print(f"mode {str(mode):8s}: round {seconds * 1e3:7.1f} ms -> "
+              f"{streams:6.0f} streams", flush=True)
+
+    # mask by decoded lengths, matching the bench A/B exactly —
+    # post-EOS padding would otherwise inflate the match rate
+    _, base, base_len = results[False]
+    for mode in ("position", "tensor"):
+        seconds, tokens, lengths = results[mode]
+        valid = np.arange(base.shape[1])[None, :] < \
+            np.minimum(base_len, lengths)[:, None]
+        match = (tokens == base)[valid].mean() if valid.any() else 1.0
+        delta = seconds / results[False][0] - 1.0
+        print(f"mode {mode:8s}: token match {match:.4f}, "
+              f"round delta {delta:+.1%}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
