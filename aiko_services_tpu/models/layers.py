@@ -26,7 +26,8 @@ __all__ = [
     "embedding_init", "embedding", "embedding_axes",
     "conv1d_init", "conv1d", "conv1d_axes",
     "mha_init", "mha", "mha_axes", "precompute_kv", "init_kv_cache",
-    "update_kv_cache",
+    "update_kv_cache", "quantize_linear", "quantize_linear_tree",
+    "linear_logits",
     "sinusoid_position_encoding", "gelu", "rope_frequencies", "apply_rope",
 ]
 
@@ -44,8 +45,17 @@ def linear_init(key, in_dim: int, out_dim: int, bias: bool = True,
 
 
 def linear(params, x):
-    y = jnp.einsum("...i,io->...o", x, params["w"],
-                   preferred_element_type=jnp.float32)
+    if "w8" in params:
+        # weight-only int8 (quantize_linear): the int8->activation-dtype
+        # convert is the dot operand (fuses — no materialized copy) and
+        # the per-output-channel scale lands exactly on the f32
+        # accumulator: y = (x @ W8) * s + b is exact algebra, not an
+        # approximation of the dequantized matmul
+        y = jnp.einsum("...i,io->...o", x, params["w8"].astype(x.dtype),
+                       preferred_element_type=jnp.float32) * params["s"]
+    else:
+        y = jnp.einsum("...i,io->...o", x, params["w"],
+                       preferred_element_type=jnp.float32)
     if "b" in params:
         y = y + params["b"]
     return y.astype(x.dtype)
@@ -56,6 +66,68 @@ def linear_axes(in_axis: str, out_axis: str, bias: bool = True):
     if bias:
         axes["b"] = (out_axis,)
     return axes
+
+
+def linear_logits(params, x):
+    """Vocab/classifier projection kept in f32 — no activation-dtype
+    downcast, because rounding logits to bf16 before an argmax can
+    flip near-ties against an f32 oracle.  Consumes plain {"w"} or
+    quantized {"w8", "s"} linears: besides linear(), this is the ONLY
+    place the weight-quantized format is interpreted, so format
+    changes stay in this module."""
+    if "w8" in params:
+        logits = jnp.einsum("...d,dv->...v", x,
+                            params["w8"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits * params["s"]
+    return jnp.einsum("...d,dv->...v", x, params["w"],
+                      preferred_element_type=jnp.float32)
+
+
+def quantize_linear(params):
+    """Weight-only int8 for a linear: one f32 scale per OUTPUT channel
+    (max|w| over the input axis), so y = (x @ W8) * s + b reproduces
+    the bf16 matmul up to int8 rounding of the weights — activations
+    stay full precision (W8A16).
+
+    Measured r5 at the llama 1b/256-slot serving shape
+    (tools/ab_w8.py): device step 11.32 → 11.02 ms (−2.6%) and a
+    closed-loop wash — the weight-byte halving does NOT buy the ~3 ms
+    its share of a bandwidth-bound step would predict, so the step is
+    scheduling-bound there (or XLA hoists the converted weights out
+    of the decode scan; undiagnosed).  Treat W8 as a MEMORY lever: it
+    frees 1.24 GB of the 1b weight set for more KV slots.  Returns
+    {"w8": int8 [in,out], "s": f32 [out]} (+"b" passthrough), which
+    linear() consumes transparently."""
+    w = params["w"]
+    scale = (jnp.max(jnp.abs(w), axis=0).astype(jnp.float32) / 127.0
+             + 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    out = {"w8": q, "s": scale}
+    if "b" in params:
+        out["b"] = params["b"]
+    return out
+
+
+def quantize_linear_tree(params, exclude=("router",)):
+    """Recursively replace every linear param dict ({"w": 2-D, ["b"]})
+    in a pytree with its quantize_linear form.  Leaves everything else
+    untouched: conv1d ("w" is 3-D), embeddings ("table"), norms
+    (scale/bias), bare arrays.  Keys in `exclude` are skipped whole —
+    the default skips MoE routers, where int8 rounding could flip
+    top-k expert selection for negligible byte savings."""
+    def walk(node):
+        if isinstance(node, dict):
+            if "w" in node and getattr(node["w"], "ndim", 0) == 2 \
+                    and set(node) <= {"w", "b"}:
+                return quantize_linear(node)
+            return {key: (value if key in exclude else walk(value))
+                    for key, value in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(value) for value in node)
+        return node
+    return walk(params)
 
 
 # -- norms -------------------------------------------------------------------

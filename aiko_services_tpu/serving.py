@@ -306,8 +306,7 @@ def _build_step(config: LlamaConfig):
         # would double the step's largest weight read), f32
         # accumulation KEPT f32 into the argmax — rounding the logits
         # to bf16 first can flip near-ties against the f32 oracle
-        logits = jnp.einsum("std,dv->stv", x, params["lm_head"]["w"],
-                            preferred_element_type=jnp.float32)
+        logits = L.linear_logits(params["lm_head"], x)
         return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
 
     def one_token(params, tokens, lengths, active, k_caches, v_caches):
@@ -446,8 +445,21 @@ class ContinuousDecoder:
                  prefill_buckets=(32, 128), steps_per_sync: int = 4,
                  t_block: int = 256, prefill_chunk: int | None = None,
                  prefill_budget: int | None = None,
+                 weight_quant: bool = False,
                  name: str = "decoder"):
         self.config = config
+        # weight-only int8 (W8A16): every linear's weight tree-rewritten
+        # to {w8, s} once here — linear()/linear_logits consume it
+        # transparently across prefill, chunked extends, and the
+        # decode scan.  Measured r5 (tools/ab_w8.py, 1b/256 slots):
+        # device step −2.6%, closed loop a wash — a MEMORY lever
+        # (1.24 GB of weights freed for more KV slots), not a speed
+        # lever; see layers.quantize_linear for the numbers.  Greedy
+        # outputs are NOT bit-identical to bf16 (int8 rounding), and
+        # MoE routers are excluded (top-k flips).
+        if weight_quant:
+            params = L.quantize_linear_tree(params)
+        self.weight_quant = bool(weight_quant)
         self.params = params
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq_len
@@ -610,9 +622,7 @@ class ContinuousDecoder:
             # gigabytes at serving widths
             last_hidden = jnp.take_along_axis(
                 hidden, idx[:, None, None], axis=1)[:, 0]
-            last = jnp.einsum("ad,dv->av", last_hidden,
-                              params["lm_head"]["w"],
-                              preferred_element_type=jnp.float32)
+            last = L.linear_logits(params["lm_head"], last_hidden)
             firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
             mask = valid[:, None, None, None]
             for i, cache in enumerate(caches):
@@ -724,9 +734,7 @@ class ContinuousDecoder:
             x = L.rms_norm(params["ln_out"], x)
             last_hidden = jnp.take_along_axis(
                 x, final_idx[:, None, None], axis=1)[:, 0]
-            last = jnp.einsum("ad,dv->av", last_hidden,
-                              params["lm_head"]["w"],
-                              preferred_element_type=jnp.float32)
+            last = L.linear_logits(params["lm_head"], last_hidden)
             firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
             apply = valid & finish
             tokens = tokens.at[slots].set(

@@ -1,0 +1,170 @@
+# In-program A/B of weight-only int8 serving (W8A16,
+# layers.quantize_linear_tree) at the bench's llama geometry: 1b bf16,
+# 256 slots, closed loop.  Decode serving streams the full weight set
+# every step (2.47 GB of the ~4.6 GB step read), so halving weight
+# bytes is the largest single lever left after the r5 block-KV scan —
+# IF the int8 convert fuses in the real program the way the isolated
+# probes (tools/diag_attn_patterns.py mha1q) and the cross-KV fold
+# (tools/ab_cross_kv.py) measured.
+#
+# Prints tok/s + pure-device chained step time per mode, plus greedy
+# token parity on a fixed prompt set.
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from aiko_services_tpu.models.llama import (  # noqa: E402
+    LLAMA_PRESETS, llama_init)
+from aiko_services_tpu.serving import ContinuousDecoder  # noqa: E402
+
+SLOTS = 256
+WINDOW = float(os.environ.get("AB_W8_WINDOW", "20"))
+
+
+def build(params, config, weight_quant):
+    return ContinuousDecoder(params, config, max_slots=SLOTS,
+                             max_seq=1024, prefill_buckets=(128,),
+                             steps_per_sync=64,
+                             weight_quant=weight_quant,
+                             name=f"w8_{int(weight_quant)}")
+
+
+def closed_loop(decoder, rng):
+    generated = [0]
+    submitted = [0]
+    deadline = [time.perf_counter() + 3600.0]
+
+    def submit_one():
+        prompt = rng.integers(
+            1, decoder.config.vocab,
+            size=int(rng.integers(16, 120))).tolist()
+        request_id = f"r{submitted[0]}"
+        submitted[0] += 1
+        decoder.submit(request_id, prompt, 64,
+                       lambda rid, tokens: on_done(tokens))
+
+    def on_done(tokens):
+        generated[0] += len(tokens)
+        if time.perf_counter() < deadline[0]:
+            submit_one()
+
+    for _ in range(2 * SLOTS):          # warmup: compile + fill
+        submit_one()
+    decoder.pump()
+    # same post-warmup reset protocol as bench.bench_llama (the
+    # canonical closed-loop methodology this tool mirrors): compile
+    # time must not contaminate stats or SLO percentiles
+    for key in decoder.stats:
+        decoder.stats[key] = 0 if isinstance(decoder.stats[key], int)             else 0.0
+    decoder.ttft_samples.clear()
+    decoder.itl_samples.clear()
+    decoder.gap_samples.clear()
+    generated[0] = 0
+    start = time.perf_counter()
+    deadline[0] = start + WINDOW
+    while time.perf_counter() < deadline[0] or not decoder.idle:
+        decoder.pump()
+        if decoder.idle and time.perf_counter() >= deadline[0]:
+            break
+    elapsed = time.perf_counter() - start
+    return generated[0] / elapsed
+
+
+def device_step(decoder, steps_per_sync=64, chains=4):
+    """Chained pure-device step time, same method as the bench's
+    llama_device_step_ms probe (fresh buffers at the serving shape,
+    one sync for the whole chain)."""
+    config = decoder.config
+    try:
+        t_cache = decoder._cache_t
+        shape = (SLOTS, config.num_kv_heads, t_cache, config.head_dim)
+        k_probe = [jnp.zeros(shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        v_probe = [jnp.zeros(shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        tokens = jnp.ones((SLOTS,), jnp.int32)
+        lengths = jnp.zeros((SLOTS,), jnp.int32)
+        active = jnp.ones((SLOTS,), bool)
+        budgets = jnp.full((SLOTS,), 1 << 30, jnp.int32)
+
+        def chain(rounds):
+            nonlocal k_probe, v_probe, tokens, lengths
+            out = None
+            for _ in range(rounds):
+                out = decoder._step(decoder.params, tokens, lengths,
+                                    active, budgets, k_probe, v_probe,
+                                    num_steps=steps_per_sync, eos=-1)
+                _, _, _, tokens, lengths, k_probe, v_probe = out
+            np.asarray(out[0][-1])
+        chain(1)
+        start = time.perf_counter()
+        chain(chains)
+        return (time.perf_counter() - start) * 1000.0 / \
+            (chains * steps_per_sync)
+    except Exception as exc:
+        print(f"device-step probe failed: {exc!r}", file=sys.stderr)
+        return None
+
+
+def parity(params, config, n=32):
+    """Greedy outputs for n fixed prompts under both modes."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, config.vocab,
+                            size=int(rng.integers(8, 100))).tolist()
+               for _ in range(n)]
+    outs = {}
+    for wq in (False, True):
+        decoder = build(params, config, wq)
+        done = {}
+        for i, prompt in enumerate(prompts):
+            decoder.submit(f"p{i}", prompt, 32,
+                           lambda rid, toks, i=i: done.setdefault(i,
+                                                                  toks))
+        for _ in range(600):
+            if len(done) == n:
+                break
+            decoder.pump()
+        assert len(done) == n, f"only {len(done)}/{n} completed"
+        outs[wq] = done
+        del decoder
+    total = match = 0
+    for i in range(n):
+        a, b = outs[False][i], outs[True][i]
+        k = min(len(a), len(b))
+        match += sum(x == y for x, y in zip(a[:k], b[:k]))
+        total += k
+    return match / max(total, 1)
+
+
+def main():
+    base = LLAMA_PRESETS[os.environ.get("AB_W8_PRESET", "1b")]
+    config = dataclasses.replace(base, dtype=jnp.bfloat16,
+                                 max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+
+    for wq in (False, True):
+        decoder = build(params, config, wq)
+        tps = closed_loop(decoder, np.random.default_rng(11))
+        step_ms = device_step(decoder)
+        print(f"weight_quant={wq}: {tps:,.0f} tok/s"
+              + (f", device step {step_ms:.2f} ms"
+                 if step_ms is not None else ""), flush=True)
+        del decoder
+
+    print(f"token parity (32 fixed prompts, 32 tokens): "
+          f"{parity(params, config):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
