@@ -39,7 +39,43 @@ from .models import layers as L
 from .models.llama import LlamaConfig, llama_ffn
 from .utils import get_logger
 
-__all__ = ["ContinuousDecoder", "DecodeRequest"]
+__all__ = ["ContinuousDecoder", "DecodeRequest", "measure_device_step"]
+
+
+def measure_device_step(decoder, steps_per_sync: int = 64,
+                        chains: int = 4) -> float:
+    """Chained pure-device decode-step milliseconds for `decoder`'s
+    compiled step at its serving shape: fresh zero caches, `chains`
+    back-to-back rounds, ONE host sync at the end — separates device
+    compute from the tunnel's ~0.1 s per-round dispatch+sync.  The
+    single methodology behind the bench's llama_device_step_ms and
+    tools/ab_w8.py, so the two cannot drift."""
+    config = decoder.config
+    slots = decoder.max_slots
+    shape = (slots, config.num_kv_heads, decoder._cache_t,
+             config.head_dim)
+    k_probe = [jnp.zeros(shape, config.dtype)
+               for _ in range(config.num_layers)]
+    v_probe = [jnp.zeros(shape, config.dtype)
+               for _ in range(config.num_layers)]
+    tokens = jnp.ones((slots,), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    active = jnp.ones((slots,), bool)
+    budgets = jnp.full((slots,), 1 << 30, jnp.int32)
+
+    def chain(rounds):
+        nonlocal k_probe, v_probe, tokens, lengths
+        out = None
+        for _ in range(rounds):
+            out = decoder._step(decoder.params, tokens, lengths,
+                                active, budgets, k_probe, v_probe,
+                                num_steps=steps_per_sync, eos=-1)
+            _, _, _, tokens, lengths, k_probe, v_probe = out
+        np.asarray(out[0][-1])          # one sync for the chain
+    chain(1)                             # warm (compile cache hit)
+    start = time.perf_counter()
+    chain(chains)
+    return (time.perf_counter() - start) * 1000.0 /         (chains * steps_per_sync)
 
 # decode attention inner loop for the "select" KV mode: "two_pass"
 # (scores einsum + softmax + weights einsum), "online" (flash-style
@@ -170,9 +206,7 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
     per layer per step (scatter output feeding a dot can't fuse),
     tripling the attention bytes."""
     num_heads, num_kv = config.num_heads, config.num_kv_heads
-    q = L._split_heads(L.linear(layer["attn"]["q"], x), num_heads)
-    k = L._split_heads(L.linear(layer["attn"]["k"], x), num_kv)
-    v = L._split_heads(L.linear(layer["attn"]["v"], x), num_kv)
+    q, k, v = _project_qkv(layer, config, x)
     q = L.apply_rope(q, cos, sin, lengths)
     k = L.apply_rope(k, cos, sin, lengths)
 
@@ -241,9 +275,7 @@ def _slot_attention_block(layer, config: LlamaConfig, x, cos, sin,
     written to side[:, :, step_index] — a slot-uniform index, so XLA
     keeps the update in place instead of rewriting the whole cache."""
     num_heads, num_kv = config.num_heads, config.num_kv_heads
-    q = L._split_heads(L.linear(layer["attn"]["q"], x), num_heads)
-    k = L._split_heads(L.linear(layer["attn"]["k"], x), num_kv)
-    v = L._split_heads(L.linear(layer["attn"]["v"], x), num_kv)
+    q, k, v = _project_qkv(layer, config, x)
     q = L.apply_rope(q, cos, sin, lengths)
     k = L.apply_rope(k, cos, sin, lengths)
     k_side = jax.lax.dynamic_update_slice_in_dim(k_side, k, step_index,
@@ -278,6 +310,67 @@ def _slot_attention_block(layer, config: LlamaConfig, x, cos, sin,
     out = out.reshape(slots_n, num_heads, num_q, head_dim).astype(x.dtype)
     return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
             k_side, v_side)
+
+
+def _fuse_decode_projections(params):
+    """Opt-in serving transform: concatenate each layer's q/k/v weight
+    matrices into one [dim, (Hq+2Hkv)*D] matmul and gate/up into one
+    [dim, 2*ffn].  The decode step's activations are [S, 1, dim], so
+    its ~14 projections per layer are tiny-M matmuls whose cost is
+    issue/scheduling, not FLOPs — the W8 wash (see quantize_linear)
+    showed weight BYTES aren't the binding constraint, so this halves
+    the op COUNT instead.  Measured r5 at the 1b/256-slot shape
+    (tools/ab_w8.py AB_MODE=fuse): device step 11.27 → 11.68 ms,
+    +3.6% — a DEAD END on this toolchain (XLA already schedules the
+    separate matmuls; the fused output's split costs more than the
+    saved issues).  Kept opt-in as the recorded negative result, like
+    serving's other measured dead ends.
+
+    Tree shape after the transform: attn gains a "qkv" copy while
+    q/k/v REMAIN (the prefill/extend attention goes through
+    layers.mha, which needs them; _param_bytes excludes the duplicate
+    so traffic stats stay honest); gate/up are REPLACED by "gate_up"
+    outright, because every FFN path routes through llama_ffn →
+    _swiglu, which prefers the fused form.  Biases are asserted
+    absent — silently dropping one would corrupt outputs.  Outputs
+    are not bit-identical to the unfused step (different f32
+    accumulation tiling), so this stays opt-in and A/B-gated."""
+    new_layers = []
+    for layer in params["layers"]:
+        layer = dict(layer)
+        attn = dict(layer["attn"])
+        assert all("b" not in attn[k] for k in ("q", "k", "v")), \
+            "fuse_projections drops linear biases; refusing"
+        attn["qkv"] = {"w": jnp.concatenate(
+            [attn["q"]["w"], attn["k"]["w"], attn["v"]["w"]], axis=1)}
+        layer["attn"] = attn
+        if "gate" in layer:
+            assert "b" not in layer["gate"] and "b" not in layer["up"]
+            layer["gate_up"] = {"w": jnp.concatenate(
+                [layer["gate"]["w"], layer["up"]["w"]], axis=1)}
+            del layer["gate"], layer["up"]
+        new_layers.append(layer)
+    return {**params, "layers": new_layers}
+
+
+def _project_qkv(layer, config: LlamaConfig, x):
+    """q/k/v for the decode step: one fused matmul when the layer
+    carries the _fuse_decode_projections form, else the canonical
+    three."""
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    attn = layer["attn"]
+    if "qkv" in attn:
+        qkv = L.linear(attn["qkv"], x)
+        q_dim = num_heads * config.head_dim
+        kv_dim = num_kv * config.head_dim
+        q = L._split_heads(qkv[..., :q_dim], num_heads)
+        k = L._split_heads(qkv[..., q_dim:q_dim + kv_dim], num_kv)
+        v = L._split_heads(qkv[..., q_dim + kv_dim:], num_kv)
+    else:
+        q = L._split_heads(L.linear(attn["q"], x), num_heads)
+        k = L._split_heads(L.linear(attn["k"], x), num_kv)
+        v = L._split_heads(L.linear(attn["v"], x), num_kv)
+    return q, k, v
 
 
 def _build_step(config: LlamaConfig):
@@ -446,6 +539,7 @@ class ContinuousDecoder:
                  t_block: int = 256, prefill_chunk: int | None = None,
                  prefill_budget: int | None = None,
                  weight_quant: bool = False,
+                 fuse_projections: bool = False,
                  name: str = "decoder"):
         self.config = config
         # weight-only int8 (W8A16): every linear's weight tree-rewritten
@@ -457,9 +551,12 @@ class ContinuousDecoder:
         # lever; see layers.quantize_linear for the numbers.  Greedy
         # outputs are NOT bit-identical to bf16 (int8 rounding), and
         # MoE routers are excluded (top-k flips).
+        if fuse_projections:
+            params = _fuse_decode_projections(params)
         if weight_quant:
             params = L.quantize_linear_tree(params)
         self.weight_quant = bool(weight_quant)
+        self.fuse_projections = bool(fuse_projections)
         self.params = params
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq_len
@@ -525,10 +622,14 @@ class ContinuousDecoder:
         # streams the full weight set (embed excluded — it's a gather
         # of S rows) plus the capped KV read
         itemsize = jnp.dtype(config.dtype).itemsize
+        # fused qkv copies (fuse_projections) duplicate q/k/v byte-for
+        # -byte — exclude them so bytes_moved counts what one step
+        # actually streams, not both forms
         self._param_bytes = int(sum(
             int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
             for path, leaf in jax.tree_util.tree_leaves_with_path(params)
-            if "embed" not in str(path[0])))
+            if "embed" not in str(path[0]) and
+            not any("qkv" in str(part) for part in path)))
         self._kv_bytes_per_t = (2 * config.num_layers * max_slots *
                                 config.num_kv_heads * config.head_dim *
                                 itemsize)

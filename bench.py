@@ -962,36 +962,9 @@ def bench_llama(window: float):
     # alone)
     device_step_ms = None
     try:
-        t_cache = decoder._cache_t
-        shape = (LLAMA_SLOTS, config.num_kv_heads, t_cache,
-                 config.head_dim)
-        k_probe = [jnp.zeros(shape, config.dtype)
-                   for _ in range(config.num_layers)]
-        v_probe = [jnp.zeros(shape, config.dtype)
-                   for _ in range(config.num_layers)]
-        tokens_probe = jnp.ones((LLAMA_SLOTS,), jnp.int32)
-        lengths_probe = jnp.zeros((LLAMA_SLOTS,), jnp.int32)
-        active_probe = jnp.ones((LLAMA_SLOTS,), bool)
-        budgets_probe = jnp.full((LLAMA_SLOTS,), 1 << 30, jnp.int32)
-
-        def chain_rounds(rounds):
-            nonlocal k_probe, v_probe, tokens_probe, lengths_probe
-            out = None
-            for _ in range(rounds):
-                out = decoder._step(
-                    params, tokens_probe, lengths_probe, active_probe,
-                    budgets_probe, k_probe, v_probe,
-                    num_steps=LLAMA_STEPS_PER_SYNC, eos=-1)
-                (_, _, _, tokens_probe, lengths_probe, k_probe,
-                 v_probe) = out
-            np.asarray(out[0][-1])          # one sync for the chain
-        chain_rounds(1)                      # warm (compile cache hit)
-        chains = 4
-        probe_start = time.perf_counter()
-        chain_rounds(chains)
-        device_step_ms = (time.perf_counter() - probe_start) * 1000.0 \
-            / (chains * LLAMA_STEPS_PER_SYNC)
-        del k_probe, v_probe
+        from aiko_services_tpu.serving import measure_device_step
+        device_step_ms = measure_device_step(decoder,
+                                             LLAMA_STEPS_PER_SYNC)
     except Exception as exc:
         print(f"llama device-step probe failed: {exc!r}",
               file=sys.stderr)

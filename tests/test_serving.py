@@ -648,3 +648,27 @@ def test_quantize_linear_roundtrip_and_tree():
     assert "table" in out["embed"]
     assert "scale" in out["norm"]
     assert "w" in out["router"] and "w8" not in out["router"]
+
+
+def test_fused_projections_match_oracle(params):
+    """fuse_projections=True (one qkv matmul + one gate_up matmul per
+    layer, serving._fuse_decode_projections) must serve the oracle's
+    tokens: the fused matmul contracts the same [dim] axis per output
+    column, so on the test geometry the greedy outputs match the
+    unfused engine exactly (larger geometries may differ in f32
+    accumulation tiling — the mode stays opt-in and A/B-gated)."""
+    decoder = ContinuousDecoder(params, CONFIG, max_slots=4,
+                                prefill_buckets=(16,), steps_per_sync=4,
+                                fuse_projections=True)
+    done = {}
+    prompts = {f"r{i}": [i + 2, (i * 13) % 50 + 1, 9] for i in range(5)}
+    for rid, prompt in prompts.items():
+        decoder.submit(rid, prompt, 10,
+                       lambda rid, t: done.update({rid: t}))
+    for _ in range(80):
+        decoder.pump()
+        if len(done) == len(prompts):
+            break
+    assert len(done) == len(prompts)
+    for rid, prompt in prompts.items():
+        assert done[rid] == oracle(params, prompt, 10), rid

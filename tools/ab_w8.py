@@ -26,18 +26,24 @@ sys.path.insert(0, ".")
 
 from aiko_services_tpu.models.llama import (  # noqa: E402
     LLAMA_PRESETS, llama_init)
-from aiko_services_tpu.serving import ContinuousDecoder  # noqa: E402
+from aiko_services_tpu.serving import (  # noqa: E402
+    ContinuousDecoder, measure_device_step)
 
 SLOTS = 256
 WINDOW = float(os.environ.get("AB_W8_WINDOW", "20"))
+# AB_MODE selects the serving variant under test vs the plain decoder:
+#   w8   — weight-only int8 (weight_quant=True); measured r5: a wash
+#   fuse — fused qkv + gate_up projections (fuse_projections=True)
+MODE = os.environ.get("AB_MODE", "w8")
+MODE_KWARG = {"w8": "weight_quant", "fuse": "fuse_projections"}[MODE]
 
 
-def build(params, config, weight_quant):
+def build(params, config, enabled):
     return ContinuousDecoder(params, config, max_slots=SLOTS,
                              max_seq=1024, prefill_buckets=(128,),
                              steps_per_sync=64,
-                             weight_quant=weight_quant,
-                             name=f"w8_{int(weight_quant)}")
+                             **{MODE_KWARG: enabled},
+                             name=f"{MODE}_{int(enabled)}")
 
 
 def closed_loop(decoder, rng):
@@ -75,46 +81,8 @@ def closed_loop(decoder, rng):
     deadline[0] = start + WINDOW
     while time.perf_counter() < deadline[0] or not decoder.idle:
         decoder.pump()
-        if decoder.idle and time.perf_counter() >= deadline[0]:
-            break
     elapsed = time.perf_counter() - start
     return generated[0] / elapsed
-
-
-def device_step(decoder, steps_per_sync=64, chains=4):
-    """Chained pure-device step time, same method as the bench's
-    llama_device_step_ms probe (fresh buffers at the serving shape,
-    one sync for the whole chain)."""
-    config = decoder.config
-    try:
-        t_cache = decoder._cache_t
-        shape = (SLOTS, config.num_kv_heads, t_cache, config.head_dim)
-        k_probe = [jnp.zeros(shape, config.dtype)
-                   for _ in range(config.num_layers)]
-        v_probe = [jnp.zeros(shape, config.dtype)
-                   for _ in range(config.num_layers)]
-        tokens = jnp.ones((SLOTS,), jnp.int32)
-        lengths = jnp.zeros((SLOTS,), jnp.int32)
-        active = jnp.ones((SLOTS,), bool)
-        budgets = jnp.full((SLOTS,), 1 << 30, jnp.int32)
-
-        def chain(rounds):
-            nonlocal k_probe, v_probe, tokens, lengths
-            out = None
-            for _ in range(rounds):
-                out = decoder._step(decoder.params, tokens, lengths,
-                                    active, budgets, k_probe, v_probe,
-                                    num_steps=steps_per_sync, eos=-1)
-                _, _, _, tokens, lengths, k_probe, v_probe = out
-            np.asarray(out[0][-1])
-        chain(1)
-        start = time.perf_counter()
-        chain(chains)
-        return (time.perf_counter() - start) * 1000.0 / \
-            (chains * steps_per_sync)
-    except Exception as exc:
-        print(f"device-step probe failed: {exc!r}", file=sys.stderr)
-        return None
 
 
 def parity(params, config, n=32):
@@ -156,8 +124,8 @@ def main():
     for wq in (False, True):
         decoder = build(params, config, wq)
         tps = closed_loop(decoder, np.random.default_rng(11))
-        step_ms = device_step(decoder)
-        print(f"weight_quant={wq}: {tps:,.0f} tok/s"
+        step_ms = measure_device_step(decoder)
+        print(f"{MODE_KWARG}={wq}: {tps:,.0f} tok/s"
               + (f", device step {step_ms:.2f} ms"
                  if step_ms is not None else ""), flush=True)
         del decoder
