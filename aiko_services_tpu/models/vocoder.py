@@ -58,10 +58,14 @@ class VocoderConfig:
 
 VOCODER_PRESETS = {
     # matches the test/base TTS presets' 80-mel output.  The "test"
-    # geometry is the measured sweet spot on the synthetic corpus
-    # (held-out MCD 24.4 at 6k steps): half-size channels plateaued at
-    # 30.9 and double-size overfit to 29.3 — scale past this needs
-    # more training data, not more parameters.
+    # geometry is the measured sweet spot on the synthetic corpus:
+    # half-size channels plateaued (MCD 30.9) and double-size overfit
+    # (29.3) — and the r5 data-scaling experiment
+    # (tools/train_vocoder_scale.py) CONFIRMED data was the binding
+    # constraint: widening the corpus 8 → 29 utterances at this same
+    # geometry cut held-out MCD 23.88 → 21.10 dB, past
+    # Griffin-Lim-32's 22.72, while larger geometries still overfit
+    # (26.8 / 28.8).
     "test": VocoderConfig(channels=(96, 48, 24), basis=64),
     "base": VocoderConfig(),
 }

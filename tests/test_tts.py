@@ -296,29 +296,49 @@ def test_tts_held_out_mcd():
 
 # -- neural vocoder: learned mel->waveform vs Griffin-Lim ----------------
 
-def train_vocoder(exclude: list):
+def train_vocoder(exclude: list, vocoder_config=None, texts=None,
+                  steps: int = 9000, window: int = 96):
     """Overfit the tiny oscillator-bank vocoder (models/vocoder.py) on
     the synthetic corpus MINUS the held-out text: (ground-truth
     log-mel, waveform) pairs, loss = mel re-analysis L2 — the
     differentiable stft path, directly the MCD-measured quantity.
     Oscillator frequencies train at their own (much higher) learning
-    rate so the bank locks onto the corpus tones."""
+    rate so the bank locks onto the corpus tones.
+
+    Corpus: every 1-3-word tone sequence whose adjacencies don't leak
+    the held-out pair (r5 data-scaling result,
+    tools/train_vocoder_scale.py: widening 8 → 29 utterances at the
+    SAME geometry cut held-out MCD 23.88 → 21.10 dB — past
+    Griffin-Lim-32's 22.72 — while bigger geometries still overfit,
+    confirming the preset note that data, not parameters, was the
+    binding constraint)."""
+    import itertools
+
     import optax
 
     from aiko_services_tpu.models.vocoder import (VOCODER_PRESETS,
                                                   vocoder_forward,
                                                   vocoder_init)
 
-    vocoder_config = VOCODER_PRESETS["test"]
+    vocoder_config = vocoder_config or VOCODER_PRESETS["test"]
     mel_fn = jax.jit(log_mel_spectrogram)
-    texts = [["alpha"], ["bravo"], ["charlie"],
-             ["alpha", "bravo"], ["bravo", "charlie"],
-             ["charlie", "alpha"], ["alpha", "charlie"],
-             ["bravo", "alpha"], ["charlie", "bravo"]]
-    texts = [t for t in texts if t != exclude]
+    if texts is None:
+        texts = [["alpha"], ["bravo"], ["charlie"],
+                 ["alpha", "bravo"], ["bravo", "charlie"],
+                 ["charlie", "alpha"], ["alpha", "charlie"],
+                 ["bravo", "alpha"], ["charlie", "bravo"]]
+        texts = [t for t in texts if t != exclude]
+
+        def leaks(seq):
+            return any(list(seq[i:i + len(exclude)]) == exclude
+                       for i in range(len(seq) - len(exclude) + 1))
+
+        for seq in itertools.product(sorted(asr_golden.WORDS),
+                                     repeat=3):
+            if not leaks(seq):
+                texts.append(list(seq))
     hop = vocoder_config.hop
-    window = 64        # covers the longest utterance (61 frames);
-    #                    training at max_frames just burns CPU on pad
+    # window must cover the longest utterance (3 words = 90 frames)
     mel_rows, wave_rows, frame_counts = [], [], []
     for words in texts:
         wave = np.asarray(asr_golden.utterance(words), np.float32)
@@ -341,7 +361,8 @@ def train_vocoder(exclude: list):
 
     params = vocoder_init(jax.random.PRNGKey(0), vocoder_config)
     optim = optax.multi_transform(
-        {"net": optax.adam(optax.exponential_decay(3e-3, 1500, 0.5)),
+        {"net": optax.adam(optax.exponential_decay(3e-3, steps // 4,
+                                                   0.5)),
          "freqs": optax.adam(2.0)},
         jax.tree_util.tree_map_with_path(
             lambda path, _: "freqs" if "freqs" in str(path[0])
@@ -364,7 +385,7 @@ def train_vocoder(exclude: list):
         return optax.apply_updates(p, updates), s, loss
 
     loss = None
-    for _ in range(6000):
+    for _ in range(steps):
         params, opt_state, loss = step(params, opt_state)
     assert float(loss) < 0.02, f"vocoder failed to fit: {float(loss)}"
     return params, vocoder_config
@@ -384,27 +405,26 @@ def test_vocoder_forward_shape_and_jit():
 
 
 @pytest.mark.skipif(not os.environ.get("AIKO_HEAVY_TESTS"),
-                    reason="vocoder training: minutes on an "
-                           "accelerator, ~1 h on this 1-core CPU "
+                    reason="vocoder training: ~2 min on an "
+                           "accelerator, hours on this 1-core CPU "
                            "(conftest forces the CPU backend) — run "
                            "with AIKO_HEAVY_TESTS=1, or standalone "
                            "outside pytest on the device.  Measured "
-                           "2026-07-31 on TPU v5e: vocoder 23.88 dB "
-                           "vs GL-16 31.58 / GL-32 22.72")
+                           "2026-07-31 on TPU v5e (wide corpus): "
+                           "vocoder 21.10 dB vs GL-16 31.58 / "
+                           "GL-32 22.72")
 def test_vocoder_vs_griffin_lim_held_out_mcd():
     """The round-5 vocoder step-up (VERDICT r4 item 8), measured by
     copy-synthesis on HELD-OUT text (ground-truth mel in, waveform
     re-analysis MCD out — the standard vocoder evaluation, isolating
-    the mel→waveform leg from acoustic-model error):
+    the mel→waveform leg from acoustic-model error).
 
-      * the trained vocoder must BEAT Griffin-Lim at 16 iterations —
-        already ≥16× the vocoder's single-pass cost;
-      * Griffin-Lim at 32+ iterations measures slightly better on this
-        tonal corpus (measured delta ~1.2 dB: 23.9 vs 22.7) — recorded
-        as the accepted limitation: pure tones are Griffin-Lim's best
-        case (phase recovery is easy), and it pays 32 stft+istft
-        rounds for the edge.  Griffin-Lim therefore stays the default
-        and the vocoder is the opt-in low-latency leg."""
+    With the r5 wide training corpus the vocoder must beat
+    Griffin-Lim at BOTH 16 and 32 iterations (measured on TPU v5e:
+    21.10 dB vs 31.58 / 22.72) — GL-32 pays 32 stft+istft rounds,
+    ≥32× the vocoder's single-pass cost, and still loses.
+    Griffin-Lim remains the weight-free fallback; the vocoder is the
+    quality AND latency leg once trained weights exist."""
     from aiko_services_tpu.models.vocoder import vocoder_forward
     from aiko_services_tpu.ops.audio import (griffin_lim,
                                              mel_cepstral_distortion,
@@ -435,11 +455,11 @@ def test_vocoder_vs_griffin_lim_held_out_mcd():
           f"GL-16 {mcd_gl[16]:.2f} dB, GL-32 {mcd_gl[32]:.2f} dB")
     assert mcd_vocoder < mcd_gl[16], \
         f"vocoder {mcd_vocoder:.2f} >= GL-16 {mcd_gl[16]:.2f}"
-    # regression bound at measured-good (24.4) plus margin; and the
-    # accepted-limitation delta vs GL-32 must stay small
-    assert mcd_vocoder < 28.0, f"vocoder regressed: {mcd_vocoder:.2f}"
-    assert mcd_vocoder < 1.35 * mcd_gl[32], \
-        f"vocoder {mcd_vocoder:.2f} not within 1.35x of GL-32"
+    # r5 wide-corpus result: the vocoder beats even GL-32 (measured
+    # 21.10 vs 22.72 on TPU; margin absorbs backend numerics)
+    assert mcd_vocoder < mcd_gl[32] + 0.5, \
+        f"vocoder {mcd_vocoder:.2f} lost to GL-32 {mcd_gl[32]:.2f}"
+    assert mcd_vocoder < 25.0, f"vocoder regressed: {mcd_vocoder:.2f}"
 
 
 def test_synthesize_with_vocoder_end_to_end(tts_params):
