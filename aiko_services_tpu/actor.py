@@ -18,7 +18,8 @@ import inspect
 
 from .service import Service, ServiceFilter, ServiceProtocol
 from .share import ECProducer, ServicesCache
-from .utils import generate, get_logger, parse
+from .transport import wire
+from .utils import get_logger, parse
 
 __all__ = ["ActorMessage", "Actor", "get_remote_proxy", "get_public_methods",
            "ActorDiscovery", "PROTOCOL_ACTOR"]
@@ -92,7 +93,12 @@ class Actor(Service):
     # -- inbound -----------------------------------------------------------
     def _topic_in_handler(self, _topic, payload) -> None:
         try:
-            command, params = parse(payload)
+            if wire.is_envelope(payload):
+                # binary wire envelope: tensors arrive as zero-copy
+                # views, scalars keep sexpr (string) semantics
+                command, params = wire.decode_envelope(payload)
+            else:
+                command, params = parse(payload)
         except Exception:
             self.logger.warning("%s: unparseable payload %r",
                                 self.name, payload)
@@ -162,15 +168,24 @@ class _RemoteProxy:
         return f"RemoteProxy({self._topic_in})"
 
 
-def get_remote_proxy(runtime, topic_in: str, protocol_class):
+def get_remote_proxy(runtime, topic_in: str, protocol_class,
+                     codec_hints=None):
     """Build a proxy object: calling proxy.method(a, b) publishes
-    "(method a b)" to `topic_in` (fire-and-forget, like the reference)."""
+    "(method a b)" to `topic_in` (fire-and-forget, like the reference).
+
+    When the runtime's transport is binary-capable and an argument holds
+    ndarray/bytes values, the call ships as a binary wire envelope
+    instead of text — tensors cross without a text round-trip.
+    codec_hints ({dict_key: codec}) opts named arrays into a lossy wire
+    codec (see transport/wire.py)."""
     proxy = _RemoteProxy(runtime, topic_in)
     for method_name in get_public_methods(protocol_class):
         def remote_call(*args, _name=method_name, **kwargs):
             if kwargs:
                 raise TypeError("remote calls are positional-only")
-            runtime.publish(topic_in, generate(_name, list(args)))
+            runtime.publish(topic_in, wire.encode_rpc(
+                _name, list(args), transport=runtime.message,
+                codec_hints=codec_hints))
         setattr(proxy, method_name, remote_call)
     return proxy
 

@@ -214,9 +214,22 @@ class PE_WhisperASR(PipelineElement):
         # halves the decode tail's dominant READ as well (measured
         # −14% round; see the bench's chip kv-quant A/B).
         kv_quant, _ = self.get_parameter("kv_quant", False)
-        kv_mode = str(kv_quant).lower()
-        if kv_mode in ("tensor", "position"):
-            self.kv_quant = kv_mode
+        if isinstance(kv_quant, str):
+            # wire-delivered parameters arrive as (possibly padded)
+            # strings; an unrecognized mode must fail loudly, not
+            # silently coerce to bf16 (ADVICE r5)
+            kv_mode = kv_quant.strip().lower()
+            if kv_mode in ("tensor", "position"):
+                self.kv_quant = kv_mode
+            elif kv_mode in ("true", "t", "yes", "on", "1"):
+                self.kv_quant = True
+            elif kv_mode in ("false", "f", "no", "off", "0", ""):
+                self.kv_quant = False
+            else:
+                raise ValueError(
+                    f"ASR element {self.name}: unrecognized kv_quant "
+                    f"mode {kv_quant!r} (expected tensor | position | "
+                    f"a boolean)")
         else:
             self.kv_quant = parse_bool(kv_quant, False)
 
@@ -369,7 +382,13 @@ class PE_WhisperASR(PipelineElement):
                     for i, audio in enumerate(payloads):
                         audio = np.asarray(audio)
                         t = min(audio.shape[0], batch.shape[1])
-                        batch[i, :t] = mulaw_encode(audio[:t])
+                        if audio.dtype == np.uint8:
+                            # already µ-law codes (an ingest element or
+                            # the binary wire path encoded once): pure
+                            # copy, no per-frame transcode
+                            batch[i, :t] = audio[:t]
+                        else:
+                            batch[i, :t] = mulaw_encode(audio[:t])
                     return jnp.asarray(batch)
                 batch = np.zeros((rows(len(payloads)),
                                   bucket * WHISPER_HOP), dtype="int16")

@@ -357,7 +357,11 @@ def mha(params, x, kv_input=None, mask=None, cache=None,
     k_scale = v_scale = None
     if precomputed_kv is not None:
         k, v = precomputed_kv
-        if isinstance(k, dict) and _foldable(k["s"]):
+        # fold only when BOTH k and v are quantized dicts with foldable
+        # scales — a mixed pair (or a per-position v scale) must take
+        # the dequantize path, not crash or mis-scale (ADVICE r5)
+        if isinstance(k, dict) and isinstance(v, dict) and \
+                _foldable(k["s"]) and _foldable(v["s"]):
             # scale shapes [B,1,1,1] broadcast against scores
             # [B,H,Tq,Tk] and output [B,H,Tq,D] directly
             k_scale, v_scale = k["s"], v["s"]

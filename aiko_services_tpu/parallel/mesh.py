@@ -77,17 +77,30 @@ def create_mesh(axes: dict | MeshSpec | None = None, devices=None):
         axes = axes.resolve(len(devices))
     elif isinstance(axes, dict):
         axes = MeshSpec(dict(axes)).resolve(len(devices))
-    # Auto axis types: shardings propagate from annotations
-    # (with_sharding_constraint) rather than the explicit-sharding type
-    # system — the classic pjit programming model
-    auto = (jax.sharding.AxisType.Auto,) * len(axes)
-    return jax.make_mesh(tuple(axes.values()), tuple(axes.keys()),
-                         devices=devices, axis_types=auto)
+    return _make_mesh(tuple(axes.values()), tuple(axes.keys()), devices)
+
+
+def _make_mesh(shape: tuple, names: tuple, devices):
+    """Version-tolerant mesh construction.  Auto axis types: shardings
+    propagate from annotations (with_sharding_constraint) rather than
+    the explicit-sharding type system — the classic pjit programming
+    model.  Older jax (< AxisType) defaults to exactly that, so the
+    argument is simply omitted there."""
+    import jax
+
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is not None:
+        return jax.make_mesh(shape, names, devices=devices,
+                             axis_types=(axis_type.Auto,) * len(names))
+    if hasattr(jax, "make_mesh"):
+        return jax.make_mesh(shape, names, devices=devices)
+    import numpy as np
+    return jax.sharding.Mesh(
+        np.asarray(devices).reshape(shape), names)
 
 
 def single_device_mesh(axis: str = AXIS_DATA):
     """1×1 mesh: lets single-chip code paths share the sharded code path."""
     import jax
 
-    return jax.make_mesh((1,), (axis,), devices=jax.devices()[:1],
-                         axis_types=(jax.sharding.AxisType.Auto,))
+    return _make_mesh((1,), (axis,), jax.devices()[:1])

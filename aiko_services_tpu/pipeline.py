@@ -44,6 +44,7 @@ from .actor import Actor, get_remote_proxy
 from .lease import Lease
 from .service import ServiceFilter, ServiceProtocol
 from .share import ServicesCache
+from .transport import wire
 from .utils import Graph, GraphError, get_logger, load_class, load_module
 
 __all__ = [
@@ -304,6 +305,9 @@ class Frame:
     deferred_at: int | None = None      # topo index parked at (batching)
     deferred_since: float = 0.0
     reply_to: tuple | None = None       # (topic, hop_id): remote serving
+    reply_skip: dict | None = None      # original remote inputs: values
+                                        # still identical at reply time
+                                        # are not echoed back
 
     @property
     def stream_id(self) -> str:
@@ -405,12 +409,20 @@ class PipelineElement(Actor):
 
 class _RemoteElementPlaceholder:
     """Stands in for a remote element until discovery finds it
-    (reference: PipelineElementRemoteAbsent, pipeline.py:340-352)."""
+    (reference: PipelineElementRemoteAbsent, pipeline.py:340-352).
+
+    Also holds the hop's coalescing state: frames bound for this
+    destination buffer here and flush as ONE envelope when the consumer
+    is behind (outstanding replies > 0), amortizing per-message wire
+    overhead across the burst."""
 
     def __init__(self, definition: PipelineElementDefinition):
         self.definition = definition
         self.proxy = None
         self.topic_path = None
+        self.buffer: list = []          # (entry, one_way) pending sends
+        self.outstanding = 0            # request/response hops in flight
+        self.flush_scheduled = False
 
     @property
     def found(self) -> bool:
@@ -437,7 +449,9 @@ class Pipeline(PipelineElement):
                  services_cache: ServicesCache | None = None,
                  stream_lease_time: float = STREAM_LEASE_TIME,
                  auto_create_streams: bool = False,
-                 remote_timeout: float = 30.0):
+                 remote_timeout: float = 30.0,
+                 coalesce_frames: int = 16,
+                 remote_wire_codecs: dict | None = None):
         self._element_classes = element_classes or {}
         self.graph = PipelineGraph.from_definition(definition)
         self.graph.validate(definition)
@@ -465,6 +479,13 @@ class Pipeline(PipelineElement):
         self.remote_timeout = remote_timeout
         self._pending_remote: dict = {}
         self._hop_counter = itertools.count(1)
+        # remote-hop wire tuning: coalesce_frames bounds how many frames
+        # one envelope may carry (1 disables); codec hints opt named
+        # swag keys into lossy wire codecs (transport/wire.py)
+        self.coalesce_frames = max(1, int(coalesce_frames))
+        self._remote_wire_codecs = dict(remote_wire_codecs or {})
+        self._reply_buffer: dict[str, list] = {}
+        self._reply_flush_scheduled = False
         self._create_elements()
         self._precompute_schedule()
         self.ec_producer.update("element_count", len(self.graph))
@@ -533,7 +554,8 @@ class Pipeline(PipelineElement):
             if command == "add" and not placeholder.found:
                 placeholder.topic_path = fields.topic_path
                 placeholder.proxy = get_remote_proxy(
-                    self.runtime, f"{fields.topic_path}/in", Pipeline)
+                    self.runtime, f"{fields.topic_path}/in", Pipeline,
+                    codec_hints=self._remote_wire_codecs)
                 self.logger.info("pipeline %s: remote element %s found at %s",
                                  self.name, node_name, fields.topic_path)
             elif command == "remove" and \
@@ -602,6 +624,7 @@ class Pipeline(PipelineElement):
     # -- frame engine (reference hot loop: pipeline.py:623-715) -------------
     def process_frame(self, frame_or_stream_id, swag: dict | None = None,
                       _reply_to: tuple | None = None,
+                      _reply_skip: dict | None = None,
                       **_kwargs) -> FrameOutput:
         """Dual interface: called with (Frame, **inputs) when nested as an
         element, or with (stream_id, swag) via the actor mailbox.
@@ -634,7 +657,8 @@ class Pipeline(PipelineElement):
                                         frame_or_stream_id)
                     return FrameOutput(False, diagnostic="unknown stream")
             frame = Frame(stream=stream, frame_id=stream.next_frame_id(),
-                          swag=dict(swag or {}), reply_to=_reply_to)
+                          swag=dict(swag or {}), reply_to=_reply_to,
+                          reply_skip=_reply_skip)
         if stream.lease is not None:
             stream.lease.extend()
 
@@ -769,54 +793,175 @@ class Pipeline(PipelineElement):
           within remote_timeout.
 
         The serving pipeline should run with auto_create_streams=True so
-        frames for upstream-created streams are accepted.  Values cross the
-        wire as S-expression text: tensors must pass through PE_DataEncode
-        before the boundary and PE_DataDecode after it (the device data
-        plane bypasses this entirely for co-located elements)."""
+        frames for upstream-created streams are accepted.  On a
+        binary-capable transport, tensor values cross inside the binary
+        wire envelope (transport/wire.py) — zero text round-trip, with
+        optional per-key codecs (remote_wire_codecs) — and bursts of
+        frames bound for the same destination coalesce into one
+        envelope.  On text-only transports the legacy S-expression path
+        applies: tensors must pass through PE_DataEncode before the
+        boundary and PE_DataDecode after it (the device data plane
+        bypasses this entirely for co-located elements)."""
         if not placeholder.found:
             return False, None
         element_def = self._element_defs[node_name]
         if not element_def.output:
-            placeholder.proxy.process_frame(frame.stream_id, inputs)
+            self._queue_remote(placeholder,
+                               [frame.stream_id, inputs], one_way=True)
             return True, {}
         hop_id = f"{self.name}.{next(self._hop_counter)}"
         lease = Lease(self.runtime.event, self.remote_timeout, hop_id,
                       lease_expired_handler=self._remote_hop_expired)
-        self._pending_remote[hop_id] = (frame, node_name, lease)
-        placeholder.proxy.process_frame_remote(
-            frame.stream_id, inputs, self.topic_in, hop_id)
+        # keep the sent inputs: the serving side elides identity
+        # passthroughs from its reply (no point echoing the payload),
+        # so the resume re-merges them from here when declared
+        self._pending_remote[hop_id] = (frame, node_name, lease, inputs)
+        self._queue_remote(
+            placeholder,
+            [frame.stream_id, inputs, self.topic_in, hop_id],
+            one_way=False)
         return True, DEFERRED
+
+    # -- remote-hop coalescing ----------------------------------------------
+    # Per-destination send buffer: an idle link (no outstanding replies)
+    # flushes immediately, so a lone frame pays no added latency; while
+    # the consumer is behind, frames accumulate and flush as ONE
+    # envelope when the buffer fills, a reply arrives (ack-clocked), or
+    # the next event-engine turn begins — per-message publish/parse/
+    # mailbox overhead amortizes across the burst.  Coalescing requires
+    # the binary envelope, so text-only transports keep per-frame sends.
+
+    def _queue_remote(self, placeholder, entry, one_way: bool) -> None:
+        if self.coalesce_frames <= 1 or \
+                not wire.supports_binary(self.runtime.message):
+            self._send_remote([(entry, one_way)], placeholder)
+            return
+        placeholder.buffer.append((entry, one_way))
+        if len(placeholder.buffer) >= self.coalesce_frames:
+            self._flush_remote(placeholder)
+            return
+        if not one_way and placeholder.outstanding == 0:
+            self._flush_remote(placeholder)
+            return
+        if one_way and not placeholder.flush_scheduled:
+            # idle link (no coalescing window open): ship this frame
+            # now — a lone fire-and-forget frame pays no added latency
+            self._send_remote([placeholder.buffer.pop()], placeholder)
+            # fall through: open a one-turn window so the REST of a
+            # burst coalesces
+        if not placeholder.flush_scheduled:
+            placeholder.flush_scheduled = True
+            self.runtime.event.add_oneshot_handler(
+                lambda: self._flush_remote(placeholder), 0.0)
+
+    def _flush_remote(self, placeholder) -> None:
+        placeholder.flush_scheduled = False
+        if not placeholder.buffer:
+            return
+        entries, placeholder.buffer = placeholder.buffer, []
+        self._send_remote(entries, placeholder)
+
+    def _send_remote(self, entries, placeholder) -> None:
+        if not placeholder.found:
+            # discovery raced away mid-buffer: fail the hops cleanly
+            # (never sent, so outstanding was never incremented)
+            for entry, one_way in entries:
+                if not one_way:
+                    pending = self._pending_remote.pop(entry[3], None)
+                    if pending is not None:
+                        frame, node_name, lease, _ = pending
+                        lease.terminate()
+                        self.resume_frame(frame, node_name, RuntimeError(
+                            f"remote element {node_name} left before "
+                            f"send"))
+            return
+        one_way = [entry for entry, ow in entries if ow]
+        request = [entry for entry, ow in entries if not ow]
+        if one_way:
+            if len(one_way) == 1:
+                placeholder.proxy.process_frame(*one_way[0])
+            else:
+                placeholder.proxy.process_frames(one_way)
+        if request:
+            placeholder.outstanding += len(request)
+            if len(request) == 1:
+                placeholder.proxy.process_frame_remote(*request[0])
+            else:
+                placeholder.proxy.process_frames_remote(request)
+
+    def _hop_settled(self, node_name) -> None:
+        """A reply (or expiry) retired one hop: the link has capacity —
+        flush anything the coalescer buffered meanwhile."""
+        placeholder = self._remote.get(node_name)
+        if placeholder is None:
+            return
+        placeholder.outstanding = max(0, placeholder.outstanding - 1)
+        if placeholder.buffer:
+            self._flush_remote(placeholder)
 
     def _remote_hop_expired(self, hop_id) -> None:
         pending = self._pending_remote.pop(str(hop_id), None)
         if pending is None:
             return
-        frame, node_name, _lease = pending
+        frame, node_name, _lease, _inputs = pending
+        self._hop_settled(node_name)
         self.resume_frame(frame, node_name, TimeoutError(
             f"remote element {node_name}: no reply within "
             f"{self.remote_timeout}s"))
 
-    def resume_remote_frame(self, hop_id, ok, outputs=None):
-        """Reply entry (invoked over the wire by the serving pipeline)."""
+    def resume_remote_frame(self, hop_id, ok, outputs=None, elided=None):
+        """Reply entry (invoked over the wire by the serving pipeline).
+        `elided` names identity-passthrough outputs the serving side
+        did not echo: they are restored from the inputs this hop sent —
+        only those, so a genuinely dropped output still fails loudly."""
         pending = self._pending_remote.pop(str(hop_id), None)
         if pending is None:
             self.logger.warning("pipeline %s: stale remote reply %r",
                                 self.name, hop_id)
             return
-        frame, node_name, lease = pending
+        frame, node_name, lease, sent_inputs = pending
         lease.terminate()
+        self._hop_settled(node_name)
         if str(ok) not in ("true", "True"):
             self.resume_frame(frame, node_name, RuntimeError(
                 f"remote element {node_name} failed: {outputs!r}"))
             return
-        self.resume_frame(frame, node_name, dict(outputs or {}))
+        outputs = dict(outputs or {})
+        sent_inputs = sent_inputs or {}
+        for key in elided or []:
+            if key in sent_inputs:
+                outputs.setdefault(key, sent_inputs[key])
+        self.resume_frame(frame, node_name, outputs)
+
+    def resume_remote_frames(self, entries):
+        """Coalesced reply entry: one envelope, many hop replies."""
+        for entry in entries or []:
+            if isinstance(entry, (list, tuple)) and len(entry) >= 2:
+                self.resume_remote_frame(*entry[:4])
 
     def process_frame_remote(self, stream_id, inputs, reply_topic, hop_id):
         """Serving entry: walk a frame for a remote caller and reply with
         the final swag when it completes (including through DEFERRED
         elements)."""
-        self.process_frame(stream_id, dict(inputs or {}),
-                           _reply_to=(str(reply_topic), str(hop_id)))
+        inputs = dict(inputs or {})
+        self.process_frame(stream_id, inputs,
+                           _reply_to=(str(reply_topic), str(hop_id)),
+                           _reply_skip=inputs)
+
+    def process_frames(self, entries):
+        """Coalesced one-way entry: one envelope, many (stream_id,
+        inputs) frames — the per-message wire overhead amortizes across
+        the burst (ISSUE 2 chunk coalescing)."""
+        for entry in entries or []:
+            if isinstance(entry, (list, tuple)) and len(entry) >= 2:
+                self.process_frame(entry[0], dict(entry[1] or {}))
+
+    def process_frames_remote(self, entries):
+        """Coalesced request/response entry: one envelope, many
+        (stream_id, inputs, reply_topic, hop_id) frames."""
+        for entry in entries or []:
+            if isinstance(entry, (list, tuple)) and len(entry) >= 4:
+                self.process_frame_remote(*entry[:4])
 
     def _fail_frame(self, frame, node_name, diagnostic) -> None:
         self.logger.error("pipeline %s stream %s frame %s: element %s "
@@ -828,14 +973,56 @@ class Pipeline(PipelineElement):
         self.destroy_stream(frame.stream_id)
 
     def _send_remote_reply(self, frame, ok: bool, outputs: dict) -> None:
-        from .utils import generate
+        import numpy as _np
         topic, hop_id = frame.reply_to
-        # only wire-expressible values cross back: tensors must be
-        # PE_DataEncode'd (to str) by the serving graph before its end
-        wire = {k: v for k, v in outputs.items()
+        elided: list = []
+        if frame.reply_skip:
+            # don't echo untouched binary inputs back over the wire
+            # (the whole audio/image payload would ride every reply).
+            # Elide ONLY read-only payload types (ndarray/bytes — wire
+            # decode hands out read-only views, so the element cannot
+            # have mutated them in place); the elided key list crosses
+            # in the reply so the caller restores EXACTLY these from
+            # its sent inputs and nothing else fails silently.
+            elided = [k for k, v in outputs.items()
+                      if frame.reply_skip.get(k) is v
+                      and isinstance(v, (_np.ndarray, bytes))]
+            outputs = {k: v for k, v in outputs.items()
+                       if k not in elided}
+        if wire.supports_binary(self.runtime.message):
+            # binary envelope reply: tensors cross back out-of-band
+            # (zero text round-trip); replies to one caller coalesce
+            # per engine turn
+            payload = {k: v for k, v in outputs.items()
+                       if isinstance(v, (str, int, float, bool, bytes,
+                                         list, tuple, dict))
+                       or wire.contains_binary(v)}
+            self._reply_buffer.setdefault(topic, []).append(
+                [hop_id, bool(ok), payload, elided])
+            if not self._reply_flush_scheduled:
+                self._reply_flush_scheduled = True
+                self.runtime.event.add_oneshot_handler(
+                    self._flush_replies, 0.0)
+            return
+        from .utils import generate
+        # text fallback: only wire-expressible values cross back —
+        # tensors must be PE_DataEncode'd (to str) by the serving graph
+        safe = {k: v for k, v in outputs.items()
                 if isinstance(v, (str, int, float, bool))}
         self.runtime.publish(topic, generate(
-            "resume_remote_frame", [hop_id, ok, wire]))
+            "resume_remote_frame", [hop_id, ok, safe, elided]))
+
+    def _flush_replies(self) -> None:
+        self._reply_flush_scheduled = False
+        buffered, self._reply_buffer = self._reply_buffer, {}
+        for topic, entries in buffered.items():
+            if len(entries) == 1:
+                payload = wire.encode_envelope("resume_remote_frame",
+                                               entries[0])
+            else:
+                payload = wire.encode_envelope("resume_remote_frames",
+                                               [entries])
+            self.runtime.publish(topic, payload)
 
     def stop(self) -> None:
         for stream_id in list(self.streams):

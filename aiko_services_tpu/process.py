@@ -25,6 +25,7 @@ from .connection import Connection, ConnectionState
 from .event import EventEngine
 from .transport.memory import MemoryMessage
 from .transport.message import topic_matches
+from .transport.wire import is_envelope as wire_is_envelope
 from .utils import (
     generate, get_hostname, get_namespace, get_username, get_logger, parse,
 )
@@ -102,6 +103,8 @@ class ProcessRuntime:
             self.topic_state, STATE_ABSENT, True)
         for topic, _ in self._message_handlers:
             self.message.subscribe(topic)
+        for topic in self._binary_topics:
+            self._mark_data_plane(topic)
         self.message.connect()
         self.connection.update(ConnectionState.TRANSPORT)
         # liveness: retained presence marker cleared by our LWT on death
@@ -141,7 +144,8 @@ class ProcessRuntime:
     def _on_message_queue(self, _name, item, _put_time) -> None:
         topic, payload = item
         if isinstance(payload, bytes) and \
-                not self._is_binary_topic(topic):
+                not self._is_binary_topic(topic) and \
+                not wire_is_envelope(payload):
             try:
                 payload = payload.decode("utf-8")
             except UnicodeDecodeError:
@@ -159,6 +163,15 @@ class ProcessRuntime:
     def _is_binary_topic(self, topic: str) -> bool:
         return any(topic_matches(p, topic) for p in self._binary_topics)
 
+    def _mark_data_plane(self, topic: str) -> None:
+        """Binary topics carry tensor/media streams: give them the
+        transport's data-plane treatment (bounded per-client queues
+        with a drop policy on the memory broker) so a slow consumer
+        sheds stale frames instead of growing without bound."""
+        mark = getattr(self.message, "mark_data_plane", None)
+        if mark is not None:
+            mark(topic)
+
     def add_message_handler(self, handler, topic: str,
                             binary: bool = False) -> None:
         self._message_handlers.append((topic, handler))
@@ -168,6 +181,8 @@ class ProcessRuntime:
             self._exact_handlers.setdefault(topic, []).append(handler)
         if binary:
             self._binary_topics.add(topic)
+            if self.message is not None:
+                self._mark_data_plane(topic)
         if self.message is not None:
             self.message.subscribe(topic)
 

@@ -75,7 +75,8 @@ def measure_device_step(decoder, steps_per_sync: int = 64,
     chain(1)                             # warm (compile cache hit)
     start = time.perf_counter()
     chain(chains)
-    return (time.perf_counter() - start) * 1000.0 /         (chains * steps_per_sync)
+    return (time.perf_counter() - start) * 1000.0 / \
+        (chains * steps_per_sync)
 
 # decode attention inner loop for the "select" KV mode: "two_pass"
 # (scores einsum + softmax + weights einsum), "online" (flash-style
@@ -339,13 +340,18 @@ def _fuse_decode_projections(params):
     for layer in params["layers"]:
         layer = dict(layer)
         attn = dict(layer["attn"])
-        assert all("b" not in attn[k] for k in ("q", "k", "v")), \
-            "fuse_projections drops linear biases; refusing"
+        # hard errors, not asserts: python -O strips asserts and a
+        # silently-dropped bias corrupts every output (ADVICE r5)
+        if any("b" in attn[k] for k in ("q", "k", "v")):
+            raise ValueError(
+                "fuse_projections drops linear biases; refusing")
         attn["qkv"] = {"w": jnp.concatenate(
             [attn["q"]["w"], attn["k"]["w"], attn["v"]["w"]], axis=1)}
         layer["attn"] = attn
         if "gate" in layer:
-            assert "b" not in layer["gate"] and "b" not in layer["up"]
+            if "b" in layer["gate"] or "b" in layer["up"]:
+                raise ValueError(
+                    "fuse_projections drops FFN biases; refusing")
             layer["gate_up"] = {"w": jnp.concatenate(
                 [layer["gate"]["w"], layer["up"]["w"]], axis=1)}
             del layer["gate"], layer["up"]

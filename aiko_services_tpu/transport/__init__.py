@@ -1,3 +1,7 @@
 from .message import Message, topic_matches                 # noqa: F401
 from .memory import MemoryBroker, MemoryMessage, default_broker  # noqa: F401
 from .mqtt import MQTT_AVAILABLE, MQTTMessage               # noqa: F401
+from .wire import (                                         # noqa: F401
+    WIRE_CODECS, WireError, contains_binary, decode_envelope,
+    encode_envelope, encode_rpc, is_envelope, supports_binary,
+)

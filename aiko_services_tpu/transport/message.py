@@ -53,7 +53,13 @@ def topic_matches(pattern: str, topic: str) -> bool:
 
 
 class Message:
-    """Abstract pub/sub transport."""
+    """Abstract pub/sub transport.
+
+    BINARY: True when the implementation carries bytes payloads end to
+    end (the binary wire envelope, transport/wire.py, requires it);
+    False means callers must fall back to S-expression text."""
+
+    BINARY = False
 
     def __init__(self, on_message: Callable[[str, object], None] | None = None,
                  subscriptions=()):

@@ -27,6 +27,7 @@ import threading
 from collections import deque
 
 from .message import Message
+from .wire import is_envelope
 from ..utils import get_logger
 
 __all__ = ["MQTT_AVAILABLE", "MQTTMessage"]
@@ -69,6 +70,8 @@ class MQTTMessage(Message):
     connect/reconnect/disconnect, loop_start/loop_stop, subscribe/
     unsubscribe, publish, will_set, and the on_connect/on_disconnect/
     on_message callback slots."""
+
+    BINARY = True       # MQTT payloads are bytes; envelopes pass through
 
     def __init__(self, on_message=None, subscriptions=(),
                  host="localhost", port=1883, username=None, password=None,
@@ -140,10 +143,11 @@ class MQTTMessage(Message):
     def _on_paho_message(self, client, userdata, message):
         if self.on_message is not None:
             payload = message.payload
-            try:
-                payload = payload.decode("utf-8")
-            except UnicodeDecodeError:
-                pass    # binary topic: hand bytes through
+            if not is_envelope(payload):
+                try:
+                    payload = payload.decode("utf-8")
+                except UnicodeDecodeError:
+                    pass    # binary topic: hand bytes through
             self.on_message(message.topic, payload)
 
     # -- reconnect machinery (non-paho clients only) -----------------------

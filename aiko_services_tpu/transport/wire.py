@@ -1,0 +1,323 @@
+# Binary wire envelope: the zero-copy data-plane payload encoding.
+#
+# The control plane speaks S-expression text (utils/sexpr.py) — right for
+# commands, wrong for tensors: BENCH_r05 measured the full wire pipeline
+# at 40 real-time ASR streams with ~1 s of pure wire overhead per frame,
+# most of it spent round-tripping ndarray payloads through text.  This
+# module adds a length-prefixed binary envelope:
+#
+#   AIKW | version u8 | header_len u32 | header sexpr (utf-8)
+#        | buffer_count u32 | (buffer_len u64, raw bytes) * count
+#
+# The header is an ordinary RPC S-expression "(command param...)" in which
+# every ndarray / bytes value has been replaced by a marker list
+# ["__aikb__", index, kind, dtype, dims, codec, meta]; the raw bytes ride
+# out-of-band after the header.  Decoding reconstructs each ndarray as a
+# read-only np.frombuffer VIEW over the received payload — tensors never
+# round-trip through text and are never copied on the receive path.
+# Encoding pays exactly one copy (the final b"".join); contiguous array
+# bytes are taken as memoryviews, not .tobytes() copies.
+#
+# Codec tags plug the existing wire codecs in (opt-in, per-key):
+#   "mulaw" — ops/audio.py µ-law companding: float audio ships as uint8
+#             codes (half of int16, quarter of f32);
+#   "i8"    — generic absmax int8: any float array ships quantized with
+#             one f32 scale in the tag (mel features, activations);
+#   "dct8"  — ops/image_wire.py blockwise DCT: uint8 camera frames ship
+#             as truncated int8 coefficients (4x fewer bytes at keep=16).
+# A consumer that wants the DEVICE to expand a codec (the fused-frontend
+# pattern) should ship pre-encoded codes as a plain uint8/int8 array
+# instead — the envelope moves them untouched.
+#
+# Everything that is not an ndarray/bytes keeps S-expression semantics:
+# scalars arrive as strings, exactly like the text path, so existing RPC
+# consumers need no changes.  The sexpr path remains the fallback for
+# non-binary-capable transports and for control-plane messages
+# (encode_rpc below picks per payload).
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..utils.sexpr import generate, generate_sexpr, parse_sexpr
+
+__all__ = [
+    "MAGIC", "WIRE_VERSION", "WireError", "is_envelope", "contains_binary",
+    "encode_envelope", "decode_envelope", "encode_rpc", "supports_binary",
+    "WIRE_CODECS",
+]
+
+MAGIC = b"AIKW"
+WIRE_VERSION = 1
+_MARKER = "__aikb__"
+_HEAD = struct.Struct("<BI")            # version, header_len
+_COUNT = struct.Struct("<I")
+_BUFLEN = struct.Struct("<Q")
+
+
+class WireError(ValueError):
+    """Raised when a payload is not a well-formed binary envelope."""
+
+
+def supports_binary(transport) -> bool:
+    """True when `transport` can carry bytes payloads end to end
+    (Message implementations declare it with a BINARY class attr)."""
+    return bool(getattr(transport, "BINARY", False))
+
+
+def is_envelope(payload) -> bool:
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return bytes(payload[:4]) == MAGIC
+    return False
+
+
+def contains_binary(obj) -> bool:
+    """True when obj (recursively) holds an ndarray or bytes value —
+    the test for whether the sexpr text path could even express it."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return True
+    if not isinstance(obj, (str, int, float, bool, type(None))) \
+            and _is_arraylike(obj):
+        return True
+    if isinstance(obj, dict):
+        return any(contains_binary(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(contains_binary(v) for v in obj)
+    return False
+
+
+def _is_arraylike(obj) -> bool:
+    if isinstance(obj, np.ndarray):
+        return True
+    # jax.Array (and anything numpy-convertible that isn't a scalar)
+    return hasattr(obj, "shape") and hasattr(obj, "dtype")
+
+
+# -- codecs ------------------------------------------------------------------
+# Each codec: encode(np.ndarray) -> (coded np.ndarray, meta list[str]);
+#             decode(np.ndarray, meta) -> np.ndarray (the original value,
+#             up to the codec's documented loss).
+
+def _mulaw_encode(array):
+    from ..ops.audio import mulaw_encode
+    return mulaw_encode(array), [str(array.dtype)]
+
+
+def _mulaw_decode(codes, meta):
+    # numpy inverse of ops.audio.mulaw_decode (host-side: the transport
+    # must not touch the accelerator)
+    from ..ops.audio import MULAW_MU
+    x = codes.astype(np.float32) * (1.0 / 127.5) - 1.0
+    audio = np.sign(x) * np.expm1(
+        np.abs(x) * np.log1p(MULAW_MU)) * (1.0 / MULAW_MU)
+    return audio.astype(meta[0] if meta else np.float32)
+
+
+def _i8_encode(array):
+    # scale from FINITE values only: one inf/NaN glitch sample must not
+    # poison the whole tensor (inf scale -> all-NaN decode); non-finite
+    # entries saturate (inf) or zero (NaN) instead
+    x = array.astype(np.float32)
+    finite = x[np.isfinite(x)]
+    scale = float(np.max(np.abs(finite))) / 127.0 if finite.size else 0.0
+    scale = scale if scale and np.isfinite(scale) else 1.0
+    bound = 127.0 * scale
+    x = np.nan_to_num(x, nan=0.0, posinf=bound, neginf=-bound)
+    q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+    return q, [str(array.dtype), repr(scale)]
+
+
+def _i8_decode(q, meta):
+    dtype, scale = meta[0], float(meta[1])
+    return (q.astype(np.float32) * scale).astype(dtype)
+
+
+def _dct8_encode(array):
+    from ..ops.image_wire import dct8_encode
+    h, w, _ = array.shape
+    return dct8_encode(array), [str(array.dtype), str(h), str(w)]
+
+
+def _dct8_decode(codes, meta):
+    # numpy inverse of ops.image_wire.dct8_decode (same math, host-side)
+    from ..ops.image_wire import _DCT, _QUANT, _ZIGZAG
+    dtype, height, width = meta[0], int(meta[1]), int(meta[2])
+    hb, wb, channels, keep = codes.shape
+    flat = np.zeros((hb, wb, channels, 64), np.float32)
+    flat[..., _ZIGZAG[:keep]] = codes.astype(np.float32)
+    coeffs = flat.reshape(hb, wb, channels, 8, 8) * _QUANT
+    blocks = np.einsum("ik,whckl,jl->whcij", _DCT.T, coeffs, _DCT.T,
+                       optimize=True)
+    image = (blocks + 128.0).transpose(0, 3, 1, 4, 2).reshape(
+        height, width, channels)
+    return np.clip(np.round(image), 0, 255).astype(dtype)
+
+
+WIRE_CODECS = {
+    "mulaw": (_mulaw_encode, _mulaw_decode),
+    "i8": (_i8_encode, _i8_decode),
+    "dct8": (_dct8_encode, _dct8_decode),
+}
+
+
+# -- encode ------------------------------------------------------------------
+
+def _extract(obj, buffers, key=None, codec_hints=None):
+    """Walk obj, replacing ndarray/bytes values with marker lists and
+    appending their raw bytes (as memoryviews — no copy until the final
+    join) to `buffers`."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        index = len(buffers)
+        buffers.append(memoryview(obj).cast("B"))
+        return [_MARKER, str(index), "bytes", "", [], "", []]
+    if _is_arraylike(obj) and not isinstance(obj, (str, int, float, bool)):
+        array = np.asarray(obj)
+        codec = (codec_hints or {}).get(key, "")
+        meta: list = []
+        if codec:
+            if codec not in WIRE_CODECS:
+                raise WireError(f"unknown wire codec {codec!r}")
+            array, meta = WIRE_CODECS[codec][0](array)
+        if not array.flags.c_contiguous:
+            array = np.ascontiguousarray(array)
+        index = len(buffers)
+        try:
+            buffers.append(memoryview(array).cast("B"))
+        except (ValueError, TypeError):
+            # extension dtypes (bfloat16, fp8) lack the buffer
+            # protocol: reinterpret the same memory as uint8
+            buffers.append(memoryview(
+                array.reshape(-1).view(np.uint8)).cast("B"))
+        return [_MARKER, str(index), "nd", str(array.dtype),
+                [str(d) for d in array.shape], codec, meta]
+    if isinstance(obj, dict):
+        return {k: _extract(v, buffers, key=k, codec_hints=codec_hints)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_extract(v, buffers, key=key, codec_hints=codec_hints)
+                for v in obj]
+    return obj
+
+
+def encode_envelope(command: str, parameters=(), codec_hints=None) -> bytes:
+    """RPC (command, params) -> one binary envelope payload.
+
+    codec_hints: {dict_key: codec_name} — arrays stored under a hinted
+    dict key ship through that codec (lossy, opt-in)."""
+    buffers: list[memoryview] = []
+    extracted = [_extract(p, buffers, codec_hints=codec_hints)
+                 for p in parameters]
+    header = generate(command, extracted).encode("utf-8")
+    parts = [MAGIC, _HEAD.pack(WIRE_VERSION, len(header)), header,
+             _COUNT.pack(len(buffers))]
+    for view in buffers:
+        parts.append(_BUFLEN.pack(view.nbytes))
+        parts.append(view)
+    return b"".join(parts)
+
+
+# -- decode ------------------------------------------------------------------
+
+def _restore(obj, buffers, payload_nbytes=0):
+    if isinstance(obj, list) and len(obj) == 7 and obj[0] == _MARKER:
+        _, index, kind, dtype, dims, codec, meta = obj
+        try:
+            view = buffers[int(index)]
+        except (IndexError, ValueError) as exc:
+            raise WireError(f"envelope buffer {index!r} missing") from exc
+        if kind == "bytes":
+            return bytes(view)
+        if isinstance(meta, dict):            # sexpr read 2-item meta back
+            meta = [k2 for pair in meta.items() for k2 in pair]
+        shape = tuple(int(d) for d in dims)
+        try:
+            try:
+                np_dtype = np.dtype(dtype)
+            except TypeError:
+                import ml_dtypes  # noqa: F401 — registers bfloat16/fp8
+                np_dtype = np.dtype(dtype)
+            array = np.frombuffer(view, dtype=np_dtype).reshape(shape)
+        except WireError:
+            raise
+        except Exception as exc:
+            raise WireError(
+                f"envelope buffer {index} does not match its "
+                f"dtype/shape tag ({dtype}, {shape}): {exc}") from exc
+        if codec:
+            if codec not in WIRE_CODECS:
+                raise WireError(f"unknown wire codec {codec!r}")
+            return WIRE_CODECS[codec][1](array, list(meta))
+        if array.nbytes * 8 < payload_nbytes:
+            # a view pins the WHOLE envelope payload alive: for a small
+            # array in a large coalesced envelope (e.g. one stream's
+            # tokens among many streams' replies), copying out is far
+            # cheaper than retaining megabytes per retained result
+            array = array.copy()
+            array.flags.writeable = False     # same contract as views
+        return array                          # read-only zero-copy view
+    if isinstance(obj, dict):
+        return {k: _restore(v, buffers, payload_nbytes)
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore(v, buffers, payload_nbytes) for v in obj]
+    return obj
+
+
+def decode_envelope(payload):
+    """One binary envelope payload -> (command, params).
+
+    ndarrays come back as read-only views over `payload` (zero-copy);
+    everything else keeps S-expression semantics (strings)."""
+    view = memoryview(payload).cast("B")
+    if view.nbytes < 4 + _HEAD.size or bytes(view[:4]) != MAGIC:
+        raise WireError("not a binary envelope (bad magic / truncated)")
+    version, header_len = _HEAD.unpack_from(view, 4)
+    if version != WIRE_VERSION:
+        raise WireError(f"unsupported envelope version {version}")
+    offset = 4 + _HEAD.size
+    if offset + header_len + _COUNT.size > view.nbytes:
+        raise WireError("envelope header overruns payload")
+    try:
+        header = bytes(view[offset:offset + header_len]).decode("utf-8")
+    except UnicodeDecodeError as exc:
+        raise WireError(f"envelope header is not utf-8: {exc}") from exc
+    offset += header_len
+    (count,) = _COUNT.unpack_from(view, offset)
+    offset += _COUNT.size
+    buffers = []
+    for _ in range(count):
+        if offset + _BUFLEN.size > view.nbytes:
+            raise WireError("envelope buffer table overruns payload")
+        (length,) = _BUFLEN.unpack_from(view, offset)
+        offset += _BUFLEN.size
+        if offset + length > view.nbytes:
+            raise WireError("envelope buffer overruns payload")
+        buffers.append(view[offset:offset + length])
+        offset += length
+    try:
+        expr = parse_sexpr(header)
+    except Exception as exc:
+        raise WireError(f"envelope header parse failed: {exc}") from exc
+    if isinstance(expr, str):
+        return expr, []
+    if not isinstance(expr, list) or not expr or \
+            not isinstance(expr[0], str):
+        raise WireError(f"envelope header is not an RPC: {header!r}")
+    return expr[0], [_restore(p, buffers, view.nbytes)
+                     for p in expr[1:]]
+
+
+def encode_rpc(command: str, parameters=(), transport=None,
+               codec_hints=None):
+    """Pick the wire representation for an outbound RPC: the binary
+    envelope when the transport can carry bytes AND the params hold
+    binary values; S-expression text otherwise (control-plane messages
+    stay human-readable, non-binary transports keep working)."""
+    if supports_binary(transport) and contains_binary(parameters):
+        return encode_envelope(command, parameters,
+                               codec_hints=codec_hints)
+    return generate(command, [
+        p if not _is_arraylike(p) or isinstance(p, (str, int, float, bool))
+        else generate_sexpr(np.asarray(p).tolist()) for p in parameters])

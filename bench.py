@@ -441,6 +441,40 @@ def make_audio_source(chunk_s: float):
                 {"chunk_seconds": chunk_s})
 
 
+class PE_BenchWireSource:
+    """Source element for the WIRE rung: emits a fixed chunk PRE-ENCODED
+    as µ-law uint8 codes (a real mic ingest element encodes once at
+    capture).  The codes ship inside the binary wire envelope untouched
+    (zero-copy), and PE_WhisperASR's collate passes uint8 straight into
+    the device batch — no per-frame transcode anywhere on the host."""
+
+    chunk_seconds = CHUNK_SECONDS
+
+    def __init__(self, runtime, name, definition, pipeline=None):
+        from aiko_services_tpu.ops.audio import mulaw_encode
+        self.name = name
+        self.definition = definition
+        rng = np.random.default_rng(0)
+        audio = (0.1 * rng.standard_normal(
+            int(self.chunk_seconds * SAMPLE_RATE))).astype(np.float32)
+        self._chunk = mulaw_encode(audio)          # uint8, encoded ONCE
+
+    def start_stream(self, stream) -> None:
+        pass
+
+    def stop_stream(self, stream) -> None:
+        pass
+
+    def process_frame(self, frame, **_):
+        from aiko_services_tpu.pipeline import FrameOutput
+        return FrameOutput(True, {"audio": self._chunk})
+
+
+def make_wire_source(chunk_s: float):
+    return type("PE_BenchWireSource", (PE_BenchWireSource,),
+                {"chunk_seconds": chunk_s})
+
+
 def pipeline_definition(batch: int, frontend: str = "mel",
                         max_wait: float = 0.1,
                         chunk_seconds: float = CHUNK_SECONDS,
@@ -544,9 +578,13 @@ class PipelineBench:
             element_classes={
                 "PE_BenchAudioSource": make_audio_source(chunk_seconds)})
         self.pipeline.add_frame_handler(self._on_frame)
+        self._init_load_accounting()
+
+    def _init_load_accounting(self) -> None:
         # per-stream FIFO of post times: frames of one stream complete in
         # order, so popleft pairs each completion with its own post even
-        # when several frames of a stream are in flight
+        # when several frames of a stream are in flight.  Shared by the
+        # wire-mode subclass so both rungs measure identically.
         import collections
         self._post_times = collections.defaultdict(collections.deque)
         self._latencies: list[float] = []
@@ -720,6 +758,128 @@ def bench_pipeline(bench, capacity: float, drain_budget: float = 2.0):
             return n, p50, frames, mean_batch, True, attempts
         last = (n, p50, frames, mean_batch, False, attempts)
     return last
+
+
+class WirePipelineBench(PipelineBench):
+    """PipelineBench whose frames cross a REAL pub/sub wire (ISSUE 2).
+
+    Two ProcessRuntimes on one indexed MemoryBroker: a caller pipeline
+    (source -> remote ASR hop) and a serving pipeline (PE_WhisperASR ->
+    BatchingScheduler -> device).  Every frame ships as a binary wire
+    envelope (transport/wire.py): µ-law uint8 codes ride out-of-band
+    zero-copy, bursts bound for the serving pipeline coalesce into one
+    envelope per engine turn, and replies (tokens) coalesce back the
+    same way.  Latency spans caller frame post -> reply merged, so
+    lat_wire_* measures the full wire path directly — the same
+    open-loop real-time arrival methodology as PipelineBench."""
+
+    def __init__(self, batch: int, max_wait: float = 0.1,
+                 chunk_seconds: float = CHUNK_SECONDS,
+                 max_tokens: int = MAX_TOKENS,
+                 deadline_ms: float = 0.0, coalesce_frames: int = 32,
+                 depth: int = 0):
+        from aiko_services_tpu.compute import ComputeRuntime
+        from aiko_services_tpu.event import EventEngine
+        from aiko_services_tpu.pipeline import Pipeline, \
+            parse_pipeline_definition
+        from aiko_services_tpu.process import ProcessRuntime
+        from aiko_services_tpu.registrar import Registrar
+        from aiko_services_tpu.share import ServicesCache
+        from aiko_services_tpu.transport.memory import (MemoryBroker,
+                                                        MemoryMessage)
+
+        self.chunk_seconds = chunk_seconds
+        depth = depth or DEPTH        # module constant defined below
+        self.engine = EventEngine()           # real clock
+        broker = MemoryBroker()
+
+        def transport_factory(on_message, lwt_topic, lwt_payload,
+                              lwt_retain):
+            return MemoryMessage(
+                on_message=on_message, broker=broker,
+                lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                lwt_retain=lwt_retain)
+
+        def make_rt(name):
+            return ProcessRuntime(
+                name=name, engine=self.engine,
+                transport_factory=transport_factory).initialize()
+
+        Registrar(make_rt("bench_reg"))
+
+        serve_rt = make_rt("bench_serve")
+        self.runtime = serve_rt
+        self.compute = ComputeRuntime(serve_rt, "compute")
+        frames = int(chunk_seconds * FRAMES_PER_SECOND)
+        serving_def = parse_pipeline_definition({
+            "version": 0, "name": "p_bench_serve", "runtime": "jax",
+            "graph": ["(PE_WhisperASR)"],
+            "parameters": {
+                "PE_WhisperASR.preset": PRESET,
+                "PE_WhisperASR.mode": "batched",
+                "PE_WhisperASR.pipelined": True,
+                "PE_WhisperASR.max_tokens": max_tokens,
+                "PE_WhisperASR.buckets": [frames],
+                "PE_WhisperASR.max_batch": batch,
+                "PE_WhisperASR.deadline_ms": deadline_ms,
+                "PE_WhisperASR.kv_quant": KV_QUANT,
+                "PE_WhisperASR.max_wait": max_wait,
+                "PE_WhisperASR.max_in_flight": depth,
+                # the source pre-encodes µ-law once; collate passes the
+                # uint8 codes straight through to the device batch
+                "PE_WhisperASR.frontend": "audio",
+                "PE_WhisperASR.wire": "mulaw",
+            },
+            "elements": [
+                {"name": "PE_WhisperASR", "input": [{"name": "audio"}],
+                 "output": [{"name": "tokens"}]},
+            ],
+        })
+        self.serving = Pipeline(serve_rt, serving_def,
+                                stream_lease_time=0,
+                                auto_create_streams=True)
+
+        call_rt = make_rt("bench_call")
+        caller_def = parse_pipeline_definition({
+            "version": 0, "name": "p_bench_call", "runtime": "jax",
+            "graph": ["(PE_BenchWireSource (asr))"],
+            "elements": [
+                {"name": "PE_BenchWireSource", "input": [],
+                 "output": [{"name": "audio"}]},
+                {"name": "asr", "input": [{"name": "audio"}],
+                 "output": [{"name": "tokens"}],
+                 "deploy": {"remote": {"service_filter":
+                                       {"name": "p_bench_serve"}}}},
+            ],
+        })
+        self.pipeline = Pipeline(
+            call_rt, caller_def, stream_lease_time=0,
+            element_classes={
+                "PE_BenchWireSource": make_wire_source(chunk_seconds)},
+            services_cache=ServicesCache(call_rt),
+            # hops must survive the first-batch device compile
+            remote_timeout=900.0, coalesce_frames=coalesce_frames)
+        self.pipeline.add_frame_handler(self._on_frame)
+
+        # envelope accounting: publishes that carried frames to the
+        # serving pipeline (coalescing ratio = frames / envelopes)
+        self.wire_publishes = [0]
+        serving_in = f"{self.serving.topic_path}/in"
+        original_publish = call_rt.message.publish
+
+        def counting_publish(topic, payload, retain=False, wait=False):
+            if topic == serving_in:
+                self.wire_publishes[0] += 1
+            return original_publish(topic, payload, retain=retain,
+                                    wait=wait)
+
+        call_rt.message.publish = counting_publish
+
+        self._init_load_accounting()
+        if not self.engine.run_until(
+                self.pipeline.remote_elements_ready, timeout=30.0):
+            raise RuntimeError(
+                "wire bench: remote ASR element never discovered")
 
 
 class PE_BenchImageSource:
@@ -1149,6 +1309,14 @@ LAT_DEV_RUNGS = tuple(int(x) for x in os.environ.get(
 LAT_WIRE_DESCEND = (120, 80, 40)
 LAT_WIRE_ASCEND = (280, 360)
 LAT_WINDOW = float(os.environ.get("AIKO_BENCH_LAT_WINDOW", "10"))
+# wire rung (binary envelope path) knobs: the serving batch is larger
+# than the device-resident rung's because the tunnel's fixed per-batch
+# dispatch cost dominates the wire path — bigger batches amortize it;
+# max_wait scales accordingly so batches actually fill under load
+WIRE_BATCH = int(os.environ.get("AIKO_BENCH_WIRE_BATCH", "0")) or \
+    2 * LAT_BATCH
+WIRE_WAIT = float(os.environ.get("AIKO_BENCH_WIRE_WAIT", "0.2"))
+WIRE_COALESCE = int(os.environ.get("AIKO_BENCH_WIRE_COALESCE", "32"))
 
 
 def _measured_latency_loop(compiled, params, pool, n_streams: int,
@@ -1350,6 +1518,20 @@ def bench_latency():
         poisson = _measured_latency_loop(
             compiled, params, pool, best_uniform["streams"], LAT_WINDOW,
             "poisson", tunnel_floor, frames)
+    # device-only baseline at the WIRE rung's batch shape, so
+    # lat_wire_overhead_ms subtracts a same-shape compute round (the
+    # wire rung batches bigger to amortize the fixed per-batch tunnel
+    # cost)
+    if WIRE_BATCH == LAT_BATCH:
+        wire_round_chained = compute_chained
+    else:
+        idx_wire = jnp.arange(WIRE_BATCH, dtype=jnp.int32) % LAT_POOL
+        compiled_wire = compile_with_retry(fused, params, pool, idx_wire)
+        wire_round_chained = measure_compiled(compiled_wire, params,
+                                              pool, idx_wire, chain=8)
+        print(f"wire-batch baseline: {wire_round_chained*1000:.1f} ms "
+              f"chained @ batch {WIRE_BATCH}", file=sys.stderr)
+        del compiled_wire
     del compiled, pool, params
 
     result = {
@@ -1401,16 +1583,20 @@ def bench_latency():
             dev_capacity * chunk_bytes_mulaw / 1e6, 1),
     }
 
-    # wire configuration: the full pipeline, real-time arrivals.
-    # Adaptive ladder around the 200-stream target: when 200 fails,
-    # DESCEND to find the wire path's true operating point (how many
-    # streams it CAN sustain within budget on this machine — r4 only
-    # recorded the failing rung); when it passes, ascend.
-    bench = PipelineBench(LAT_BATCH, "audio", max_wait=0.08,
-                          chunk_seconds=LAT_CHUNK_S,
-                          max_tokens=LAT_TOKENS,
-                          deadline_ms=LAT_DEADLINE_MS)
-    bench.warmup(LAT_BATCH)
+    # wire configuration: the FULL wire path, real-time arrivals —
+    # caller pipeline -> binary envelope over the indexed MemoryBroker
+    # (zero-copy µ-law codes, burst coalescing) -> serving pipeline ->
+    # batched device program -> coalesced binary replies.  Adaptive
+    # ladder around the 200-stream target: when 200 fails, DESCEND to
+    # find the wire path's true operating point (how many streams it
+    # CAN sustain within budget on this machine — r4 only recorded the
+    # failing rung); when it passes, ascend.
+    bench = WirePipelineBench(WIRE_BATCH, max_wait=WIRE_WAIT,
+                              chunk_seconds=LAT_CHUNK_S,
+                              max_tokens=LAT_TOKENS,
+                              deadline_ms=LAT_DEADLINE_MS,
+                              coalesce_frames=WIRE_COALESCE)
+    bench.warmup(WIRE_BATCH)
     program = bench.compute.programs["whisper_asr.PE_WhisperASR"]
 
     def run_wire_rung(n):
@@ -1420,6 +1606,7 @@ def bench_latency():
         program.scheduler.recent_waits.clear()
         program.recent_service.clear()
         deadline_before = program.scheduler.stats["deadline_dispatches"]
+        envelopes_before = bench.wire_publishes[0]
         ok, p50, done, mean_batch = bench.measure(
             n, PIPELINE_SECONDS, drain_budget=2.0)
         ordered = sorted(bench._latencies) or [float("inf")]
@@ -1428,6 +1615,7 @@ def bench_latency():
         queue_p50 = waits[len(waits) // 2]
         service = sorted(s for _, s in program.recent_service) or [0.0]
         service_p50 = service[len(service) // 2]
+        envelopes = bench.wire_publishes[0] - envelopes_before
         return {
             "lat_wire_streams": n,
             "lat_wire_sustained": bool(ok),
@@ -1435,13 +1623,17 @@ def bench_latency():
             "lat_wire_p95_ms": round(p95 * 1000.0, 1),
             "lat_queue_p50_ms": round(queue_p50 * 1000.0, 1),
             "lat_service_p50_ms": round(service_p50 * 1000.0, 1),
-            # wire = in-flight service minus the device-only round
+            # wire = in-flight service minus the device-only round at
+            # the SAME batch shape
             "lat_wire_overhead_ms": round(
-                max(0.0, service_p50 - compute_chained) * 1000.0, 1),
+                max(0.0, service_p50 - wire_round_chained) * 1000.0, 1),
             "lat_mean_batch": round(mean_batch, 1),
             "lat_deadline_dispatches":
                 program.scheduler.stats["deadline_dispatches"] -
                 deadline_before,
+            "lat_wire_envelopes": envelopes,
+            "lat_wire_frames_per_envelope": round(done / envelopes, 2)
+            if envelopes else 0.0,
             "lat_wire_budget_met": bool(
                 ok and p50 <= LATENCY_BUDGET and n >= 200),
         }
@@ -1474,6 +1666,15 @@ def bench_latency():
             if within_budget(wire_fields) else 0
     del bench
     result |= wire_fields
+    result |= {
+        "lat_wire_batch": WIRE_BATCH,
+        "lat_wire_round_chained_ms": round(
+            wire_round_chained * 1000.0, 1),
+        "lat_wire_path": "binary envelope over indexed MemoryBroker: "
+                         "caller pipeline -> remote hop (zero-copy "
+                         "µ-law uint8, coalesced) -> serving pipeline "
+                         "-> device; replies coalesced",
+    }
     met_wire = result.get("lat_wire_budget_met", False)
     result["latency_budget_met"] = bool(met_wire or dev_met)
     result["latency_budget_config"] = (

@@ -222,3 +222,255 @@ def test_remote_one_way_when_no_outputs_declared(make_runtime, engine):
     assert done and done[0].swag["tail_ran"] is True
     assert received and float(received[0].swag["total"]) == 15.0
     assert not caller._pending_remote
+
+
+# ---------------------------------------------------------------------------
+# Binary wire path: tensors cross the remote hop with no PE_DataEncode /
+# PE_DataDecode, replies carry ndarrays back, and bursts coalesce into
+# one envelope (ISSUE 2).
+# ---------------------------------------------------------------------------
+
+class PE_TensorDouble(PipelineElement):
+    """Serving-side work that RETURNS a tensor: the reply must carry it."""
+
+    def process_frame(self, frame: Frame, data=None, **_) -> FrameOutput:
+        array = np.asarray(data)
+        return FrameOutput(True, {"doubled": array * 2.0,
+                                  "total": float(array.sum())})
+
+
+def binary_serving_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "serve_bin", "runtime": "python",
+        "graph": ["(PE_TensorDouble)"],
+        "elements": [
+            element("PE_TensorDouble", ["data"], ["doubled", "total"]),
+        ],
+    })
+
+
+def binary_calling_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "call_bin", "runtime": "python",
+        "graph": ["(PE_MakeTensor (remote_double (PE_UseTotal)))"],
+        "elements": [
+            element("PE_MakeTensor", [], ["data"]),
+            element("remote_double", ["data"], ["doubled", "total"],
+                    deploy={"remote": {"service_filter":
+                                       {"name": "serve_bin"}}}),
+            element("PE_UseTotal", ["total"], ["final"]),
+        ],
+    })
+
+
+def build_binary_system(make_runtime, engine, **caller_kwargs):
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    serve_rt = make_runtime("serve_host").initialize()
+    serving = Pipeline(serve_rt, binary_serving_definition(),
+                       element_classes={"PE_TensorDouble":
+                                        PE_TensorDouble},
+                       auto_create_streams=True, stream_lease_time=0)
+
+    call_rt = make_runtime("call_host").initialize()
+    caller = Pipeline(call_rt, binary_calling_definition(),
+                      element_classes={"PE_MakeTensor": PE_MakeTensor,
+                                       "PE_UseTotal": PE_UseTotal},
+                      services_cache=ServicesCache(call_rt),
+                      stream_lease_time=0, remote_timeout=10.0,
+                      **caller_kwargs)
+    settle(engine, 30)
+    return serve_rt, serving, call_rt, caller
+
+
+def test_tensor_crosses_binary_wire_without_dataencode(make_runtime,
+                                                       engine):
+    """No PE_DataEncode/PE_DataDecode anywhere: the ndarray ships inside
+    the binary envelope and the reply ships one back."""
+    _, serving, call_rt, caller = build_binary_system(make_runtime,
+                                                      engine)
+    assert caller.remote_elements_ready()
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 40)
+
+    assert done, "remote frame never completed"
+    swag = done[0].swag
+    assert isinstance(swag["doubled"], np.ndarray)
+    assert np.array_equal(swag["doubled"],
+                          np.arange(6, dtype=np.float32) * 2.0)
+    assert float(swag["total"]) == 15.0
+    assert swag["final"] == 15.5
+    assert not caller._pending_remote
+
+
+def test_remote_hop_codec_hint_applies(make_runtime, engine):
+    """A remote_wire_codecs hint quantizes the named swag key on the
+    wire; the serving side sees the (slightly lossy) decoded tensor."""
+    _, serving, _, caller = build_binary_system(
+        make_runtime, engine, remote_wire_codecs={"data": "i8"})
+    assert caller.remote_elements_ready()
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 40)
+
+    assert done
+    original = np.arange(6, dtype=np.float32)
+    # i8 absmax quantization error bound: max|x|/127
+    assert np.abs(np.asarray(done[0].swag["doubled"]) -
+                  original * 2.0).max() <= 2 * original.max() / 127 + 1e-6
+
+
+def test_burst_coalesces_into_fewer_envelopes(make_runtime, engine):
+    """A burst of frames bound for one destination must ship in fewer
+    publishes than frames: the hop buffers while a reply is outstanding
+    and flushes ONE envelope (chunk coalescing)."""
+    _, serving, call_rt, caller = build_binary_system(make_runtime,
+                                                      engine)
+    assert caller.remote_elements_ready()
+
+    sent_to_serving = [0]
+    serving_in = f"{serving.topic_path}/in"
+    original_publish = call_rt.message.publish
+
+    def counting_publish(topic, payload, retain=False, wait=False):
+        if topic == serving_in:
+            sent_to_serving[0] += 1
+        return original_publish(topic, payload, retain=retain, wait=wait)
+
+    call_rt.message.publish = counting_publish
+
+    done = []
+    caller.add_frame_handler(done.append)
+    frames = 8
+    for index in range(frames):
+        caller.create_stream(f"s{index}", lease_time=0)
+        caller.post("process_frame", f"s{index}", {})
+    settle(engine, 80)
+
+    assert len(done) == frames, f"only {len(done)}/{frames} completed"
+    # first frame flushes immediately (idle link); the rest buffer
+    # behind the outstanding reply and coalesce
+    assert 1 <= sent_to_serving[0] < frames, \
+        f"{sent_to_serving[0]} publishes for {frames} frames"
+    assert not caller._pending_remote
+
+
+def test_text_transport_falls_back_to_sexpr(make_runtime, engine,
+                                            broker):
+    """A transport that cannot carry bytes keeps the legacy text path:
+    PE_DataEncode/Decode moves the tensor, coalescing stays off."""
+    from aiko_services_tpu.process import ProcessRuntime
+    from aiko_services_tpu.transport.memory import MemoryMessage
+
+    class TextOnlyMessage(MemoryMessage):
+        BINARY = False
+
+    def make_text_runtime(name):
+        def transport_factory(on_message, lwt_topic, lwt_payload,
+                              lwt_retain):
+            return TextOnlyMessage(
+                on_message=on_message, broker=broker,
+                lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                lwt_retain=lwt_retain)
+        return ProcessRuntime(name=name, engine=engine,
+                              transport_factory=transport_factory)
+
+    reg_rt = make_text_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    serve_rt = make_text_runtime("serve_host").initialize()
+    Pipeline(serve_rt, serving_definition(),
+             element_classes={"PE_TensorTotal": PE_TensorTotal},
+             auto_create_streams=True, stream_lease_time=0)
+
+    call_rt = make_text_runtime("call_host").initialize()
+    caller = Pipeline(call_rt, calling_definition(),
+                      element_classes=CALLER_CLASSES,
+                      services_cache=ServicesCache(call_rt),
+                      stream_lease_time=0, remote_timeout=10.0)
+    settle(engine, 30)
+    assert caller.remote_elements_ready()
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 40)
+    assert done and done[0].swag["final"] == 15.5
+
+
+class PE_PassThrough(PipelineElement):
+    """Serving element that returns its input OBJECT unchanged — the
+    identity-passthrough case the reply elision must not break."""
+
+    def process_frame(self, frame: Frame, data=None, **_) -> FrameOutput:
+        return FrameOutput(True, {"data": data})
+
+
+def test_identity_passthrough_output_survives_reply_elision(make_runtime,
+                                                            engine):
+    """The serving side elides identity passthroughs from the reply (no
+    point echoing the payload); the caller must re-merge them from the
+    inputs it sent — including when the caller's own swag holds the
+    value under a DIFFERENT name (edge rename raw -> data)."""
+    reg_rt = make_runtime("reg_host").initialize()
+    Registrar(reg_rt)
+    engine.clock.advance(2.1)
+    settle(engine)
+
+    serve_rt = make_runtime("serve_host").initialize()
+    Pipeline(serve_rt, parse_pipeline_definition({
+        "version": 0, "name": "serve_pass", "runtime": "python",
+        "graph": ["(PE_PassThrough)"],
+        "elements": [element("PE_PassThrough", ["data"], ["data"])],
+    }), element_classes={"PE_PassThrough": PE_PassThrough},
+        auto_create_streams=True, stream_lease_time=0)
+
+    class PE_RawSource(PipelineElement):
+        def process_frame(self, frame, **_):
+            return FrameOutput(True,
+                               {"raw": np.arange(4, dtype=np.float32)})
+
+    class PE_Consume(PipelineElement):
+        def process_frame(self, frame, data=None, **_):
+            return FrameOutput(True,
+                               {"got": float(np.asarray(data).sum())})
+
+    call_rt = make_runtime("call_host").initialize()
+    caller = Pipeline(call_rt, parse_pipeline_definition({
+        "version": 0, "name": "call_pass", "runtime": "python",
+        "graph": ["(PE_RawSource (remote_pass (raw: data) "
+                  "(PE_Consume)))"],
+        "elements": [
+            element("PE_RawSource", [], ["raw"]),
+            element("remote_pass", ["data"], ["data"],
+                    deploy={"remote": {"service_filter":
+                                       {"name": "serve_pass"}}}),
+            element("PE_Consume", ["data"], ["got"]),
+        ],
+    }), element_classes={"PE_RawSource": PE_RawSource,
+                         "PE_Consume": PE_Consume},
+        services_cache=ServicesCache(call_rt),
+        stream_lease_time=0, remote_timeout=10.0)
+    settle(engine, 30)
+    assert caller.remote_elements_ready()
+
+    done = []
+    caller.add_frame_handler(done.append)
+    caller.create_stream("s1", lease_time=0)
+    caller.post("process_frame", "s1", {})
+    settle(engine, 40)
+    assert done, "frame failed (identity passthrough lost on reply)"
+    assert done[0].swag["got"] == 6.0

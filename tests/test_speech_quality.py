@@ -241,10 +241,12 @@ def test_kv_quant_tensor_parity():
         valid = np.arange(tokens[False].shape[1])[None, :] < \
             np.minimum(lengths[False], lengths[mode])[:, None]
         # token floor: observed 0.73-1.00 across configs/seeds (the
-        # flip point cascades), so the floor is deliberately loose...
+        # flip point cascades), so the floor is deliberately loose —
+        # widened to 0.6 (ADVICE r5: 0.7 still flaked on some seeds);
+        # the avg_logprob gate below is the stable quality check
         match = (tokens[mode] == tokens[False])[valid].mean() \
             if valid.any() else 1.0
-        assert match >= 0.7, f"{mode} int8 diverged too far: {match}"
+        assert match >= 0.6, f"{mode} int8 diverged too far: {match}"
         # ...and the stable gate is QUALITY: a near-tie flip picks an
         # almost-equally-likely token, so the mean log-probability of
         # the emitted sequence must stay close even where tokens

@@ -106,3 +106,328 @@ class TestMemoryBroker:
         client, seen = self.make_client(broker, [])
         client.subscribe("cfg")
         assert seen == [("cfg", "v1")]
+
+
+# ---------------------------------------------------------------------------
+# Binary wire envelope (transport/wire.py)
+# ---------------------------------------------------------------------------
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.transport import wire
+
+
+class TestWireEnvelope:
+    def roundtrip(self, command, params, codec_hints=None):
+        payload = wire.encode_envelope(command, params,
+                                       codec_hints=codec_hints)
+        assert isinstance(payload, bytes) and wire.is_envelope(payload)
+        return wire.decode_envelope(payload)
+
+    def test_ndarray_dtypes_and_shapes(self):
+        arrays = [
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.arange(6, dtype=np.int32),
+            np.arange(8, dtype=np.uint8).reshape(2, 2, 2),
+            np.array(2.5, dtype=np.float64),              # 0-d
+            np.zeros((0,), dtype=np.int16),               # empty
+            np.array([True, False]),
+        ]
+        command, decoded = self.roundtrip("f", [arrays])
+        assert command == "f"
+        for original, restored in zip(arrays, decoded[0]):
+            assert restored.dtype == original.dtype
+            assert restored.shape == original.shape
+            assert np.array_equal(restored, original)
+
+    def test_decode_is_zero_copy_view(self):
+        array = np.arange(1000, dtype=np.float32)
+        _, (restored,) = self.roundtrip("f", [array])
+        # a read-only frombuffer view over the payload, not a copy
+        assert not restored.flags.writeable
+        assert not restored.flags.owndata
+
+    def test_scalars_keep_sexpr_semantics_and_bytes_survive(self):
+        _, params = self.roundtrip(
+            "process_frame", ["s1", {"n": 7, "ok": True}, b"\x00\xffraw"])
+        assert params[0] == "s1"
+        assert params[1]["n"] == "7"          # sexpr: scalars as strings
+        assert params[1]["ok"] == "true"
+        assert params[2] == b"\x00\xffraw"
+
+    def test_mulaw_codec_tag(self):
+        audio = (0.3 * np.sin(np.linspace(0, 100, 8000))
+                 ).astype(np.float32)
+        payload = wire.encode_envelope("f", [{"audio": audio}],
+                                       codec_hints={"audio": "mulaw"})
+        # uint8 codes on the wire: ~4x smaller than f32
+        assert len(payload) < audio.nbytes / 3
+        _, (decoded,) = wire.decode_envelope(payload)
+        assert decoded["audio"].dtype == np.float32
+        assert np.abs(decoded["audio"] - audio).max() < 0.01
+
+    def test_i8_codec_tag(self):
+        mel = np.random.default_rng(0).standard_normal(
+            (50, 80)).astype(np.float32)
+        _, (decoded,) = self.roundtrip("f", [{"mel": mel}],
+                                       codec_hints={"mel": "i8"})
+        assert decoded["mel"].dtype == np.float32
+        assert np.abs(decoded["mel"] - mel).max() <= \
+            np.abs(mel).max() / 127 + 1e-6
+
+    def test_dct8_codec_matches_device_decoder(self):
+        from aiko_services_tpu.ops.image_wire import (dct8_decode,
+                                                      dct8_encode)
+        image = np.random.default_rng(1).integers(
+            0, 255, (32, 32, 3), np.uint8)
+        _, (decoded,) = self.roundtrip("f", [{"image": image}],
+                                       codec_hints={"image": "dct8"})
+        assert decoded["image"].shape == image.shape
+        assert decoded["image"].dtype == np.uint8
+        # host-side inverse agrees with the jax (device) decoder
+        reference = np.asarray(
+            dct8_decode(dct8_encode(image)[None], 32, 32))[0] * 255.0
+        assert np.abs(decoded["image"].astype(np.float64) -
+                      reference).max() <= 1.0
+
+    def test_sexpr_fallback_for_text_transports(self):
+        class TextOnly:
+            BINARY = False
+
+        class Binary:
+            BINARY = True
+
+        array = np.arange(4, dtype=np.float32)
+        assert isinstance(
+            wire.encode_rpc("c", ["a", 1], transport=Binary()), str)
+        assert isinstance(
+            wire.encode_rpc("c", [array], transport=Binary()), bytes)
+        assert isinstance(
+            wire.encode_rpc("c", [array], transport=TextOnly()), str)
+
+    def test_malformed_envelopes_raise(self):
+        with pytest.raises(wire.WireError):
+            wire.decode_envelope(b"nope")
+        truncated = wire.encode_envelope("f", [np.arange(10)])[:-9]
+        with pytest.raises(wire.WireError):
+            wire.decode_envelope(truncated)
+
+    def test_jax_array_ships_as_numpy(self):
+        import jax.numpy as jnp
+        _, (restored,) = self.roundtrip(
+            "f", [jnp.arange(5, dtype=jnp.int32)])
+        assert isinstance(restored, np.ndarray)
+        assert np.array_equal(restored, np.arange(5, dtype=np.int32))
+
+    def test_extension_dtype_bfloat16_roundtrips(self):
+        # bfloat16 has no buffer protocol: the envelope reinterprets
+        # the memory as uint8 and restores the registered dtype
+        import jax.numpy as jnp
+        array = jnp.linspace(-2, 2, 16, dtype=jnp.bfloat16)
+        _, (restored,) = self.roundtrip("f", [array])
+        assert str(restored.dtype) == "bfloat16"
+        assert np.array_equal(np.asarray(array, np.float32),
+                              np.asarray(restored, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Indexed broker routing (exact map + wildcard trie)
+# ---------------------------------------------------------------------------
+
+class TestIndexedRouting:
+    def make_client(self, broker, topics, **kwargs):
+        seen = []
+        client = MemoryMessage(
+            on_message=lambda t, p: seen.append((t, p)),
+            subscriptions=topics, broker=broker, **kwargs)
+        client.connect()
+        return client, seen
+
+    def test_exact_and_wildcard_only_reach_subscribers(self):
+        broker = MemoryBroker()
+        _, seen_exact = self.make_client(broker, ["a/b/c"])
+        _, seen_plus = self.make_client(broker, ["a/+/c"])
+        _, seen_hash = self.make_client(broker, ["a/#"])
+        _, seen_other = self.make_client(broker, ["x/y"])
+        sender, _ = self.make_client(broker, [])
+        sender.publish("a/b/c", "1")
+        assert seen_exact == [("a/b/c", "1")]
+        assert seen_plus == [("a/b/c", "1")]
+        assert seen_hash == [("a/b/c", "1")]
+        assert seen_other == []
+
+    def test_overlapping_patterns_deliver_once(self):
+        broker = MemoryBroker()
+        client, seen = self.make_client(broker, ["a/#", "a/b", "a/+"])
+        sender, _ = self.make_client(broker, [])
+        sender.publish("a/b", "x")
+        assert seen == [("a/b", "x")]       # one delivery, not three
+
+    def test_hash_matches_parent_level(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["a/#"])
+        sender, _ = self.make_client(broker, [])
+        sender.publish("a", "parent")
+        sender.publish("a/b/c/d", "deep")
+        assert seen == [("a", "parent"), ("a/b/c/d", "deep")]
+
+    def test_unsubscribe_updates_index(self):
+        broker = MemoryBroker()
+        client, seen = self.make_client(broker, ["t/+", "t/x"])
+        sender, _ = self.make_client(broker, [])
+        client.unsubscribe("t/+")
+        sender.publish("t/y", "a")          # only matched the wildcard
+        sender.publish("t/x", "b")
+        assert seen == [("t/x", "b")]
+
+    def test_retained_through_index(self):
+        broker = MemoryBroker()
+        sender, _ = self.make_client(broker, [])
+        sender.publish("cfg/one", "v1", retain=True)
+        sender.publish("cfg/two", "v2", retain=True)
+        _, seen = self.make_client(broker, ["cfg/+"])
+        assert sorted(seen) == [("cfg/one", "v1"), ("cfg/two", "v2")]
+
+    def test_lwt_ordering_preserved(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["#"])
+        dying = MemoryMessage(broker=broker, lwt_topic="w/1",
+                              lwt_payload="first")
+        dying.add_last_will_and_testament("w/2", "second")
+        dying.add_last_will_and_testament("w/3", "third", retain=True)
+        dying.connect()
+        dying.crash()
+        assert seen == [("w/1", "first"), ("w/2", "second"),
+                        ("w/3", "third")]
+        assert broker.retained("w/3") == "third"
+
+    def test_detach_removes_from_index(self):
+        broker = MemoryBroker()
+        client, seen = self.make_client(broker, ["a/+"])
+        client.disconnect()
+        sender, _ = self.make_client(broker, [])
+        sender.publish("a/b", "x")
+        assert seen == []
+        # trie pruned: no stale nodes route to the detached client
+        assert broker._trie.match("a/b") == set()
+
+    def test_binary_payload_passes_through(self):
+        broker = MemoryBroker()
+        _, seen = self.make_client(broker, ["bin"])
+        sender, _ = self.make_client(broker, [])
+        payload = wire.encode_envelope("f", [np.arange(4)])
+        sender.publish("bin", payload)
+        assert seen[0][1] is payload        # no copy, no decode
+
+
+# ---------------------------------------------------------------------------
+# Data-plane backpressure / drop policy
+# ---------------------------------------------------------------------------
+
+class TestDataPlaneBackpressure:
+    def test_drop_oldest_on_bounded_data_queue(self):
+        broker = MemoryBroker(data_queue_limit=3)
+        broker.mark_data_plane("frames/#")
+        seen = []
+        client = MemoryMessage(on_message=lambda t, p: seen.append(p),
+                               subscriptions=["frames/cam0", "ctl"],
+                               broker=broker)
+        client.connect()
+        client.hold()                      # consumer stalls
+        sender = MemoryMessage(broker=broker)
+        sender.connect()
+        for index in range(6):
+            sender.publish("frames/cam0", f"f{index}")
+        sender.publish("ctl", "c0")        # control plane: never shed
+        client.release()
+        # oldest three data frames shed, control message intact
+        assert seen == ["f3", "f4", "f5", "c0"]
+        assert client.stats["dropped"] == 3
+        assert broker.stats["dropped"] == 3
+
+    def test_drop_newest_policy(self):
+        broker = MemoryBroker(data_queue_limit=2)
+        broker.mark_data_plane("d")
+        seen = []
+        client = MemoryMessage(on_message=lambda t, p: seen.append(p),
+                               subscriptions=["d"], broker=broker,
+                               drop_policy="newest")
+        client.connect()
+        client.hold()
+        sender = MemoryMessage(broker=broker)
+        sender.connect()
+        for index in range(5):
+            sender.publish("d", f"f{index}")
+        client.release()
+        assert seen == ["f0", "f1"]        # later frames shed
+        assert client.stats["dropped"] == 3
+
+    def test_control_plane_unbounded(self):
+        broker = MemoryBroker(data_queue_limit=2)
+        broker.mark_data_plane("data/#")
+        seen = []
+        client = MemoryMessage(on_message=lambda t, p: seen.append(p),
+                               subscriptions=["ctl"], broker=broker)
+        client.connect()
+        client.hold()
+        sender = MemoryMessage(broker=broker)
+        sender.connect()
+        for index in range(10):
+            sender.publish("ctl", f"c{index}")
+        client.release()
+        assert seen == [f"c{index}" for index in range(10)]
+        assert client.stats["dropped"] == 0
+
+    def test_binary_handler_topics_marked_data_plane(self):
+        from aiko_services_tpu.event import EventEngine, VirtualClock
+        from aiko_services_tpu.process import ProcessRuntime
+
+        broker = MemoryBroker(data_queue_limit=4)
+        engine = EventEngine(VirtualClock())
+
+        def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+            return MemoryMessage(on_message=on_message, broker=broker,
+                                 lwt_topic=lwt_topic,
+                                 lwt_payload=lwt_payload,
+                                 lwt_retain=lwt_retain)
+
+        runtime = ProcessRuntime(name="dp", engine=engine,
+                                 transport_factory=factory)
+        runtime.add_message_handler(lambda t, p: None, "media/audio",
+                                    binary=True)   # before initialize
+        runtime.initialize()
+        runtime.add_message_handler(lambda t, p: None, "media/video",
+                                    binary=True)   # after initialize
+        runtime.add_message_handler(lambda t, p: None, "ctl/topic")
+        assert "media/audio" in broker._data_patterns
+        assert "media/video" in broker._data_patterns
+        assert "ctl/topic" not in broker._data_patterns
+        runtime.terminate()
+
+
+class TestWireCodecEdgeCases:
+    def test_i8_codec_survives_non_finite_samples(self):
+        mel = np.linspace(-1.0, 1.0, 64).astype(np.float32)
+        mel[3] = np.inf
+        mel[7] = np.nan
+        mel[11] = -np.inf
+        payload = wire.encode_envelope("f", [{"mel": mel}],
+                                       codec_hints={"mel": "i8"})
+        _, (decoded,) = wire.decode_envelope(payload)
+        out = decoded["mel"]
+        assert np.isfinite(out).all()       # never all-NaN corruption
+        finite = np.isfinite(mel)
+        assert np.abs(out[finite] - mel[finite]).max() <= 1.0 / 127 + 1e-6
+        assert out[7] == 0.0                # NaN -> 0
+        assert out[3] == out.max()          # inf saturates
+
+    def test_small_array_copies_out_of_large_envelope(self):
+        # a few-byte result must not pin a megabyte coalesced envelope
+        big = np.zeros(300_000, dtype=np.float32)
+        small = np.arange(4, dtype=np.int32)
+        _, params = wire.decode_envelope(
+            wire.encode_envelope("f", [big, small]))
+        assert not params[0].flags.owndata   # dominant buffer: view
+        assert params[1].flags.owndata       # small result: copied out
+        assert np.array_equal(params[1], small)

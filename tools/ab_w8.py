@@ -72,7 +72,8 @@ def closed_loop(decoder, rng):
     # canonical closed-loop methodology this tool mirrors): compile
     # time must not contaminate stats or SLO percentiles
     for key in decoder.stats:
-        decoder.stats[key] = 0 if isinstance(decoder.stats[key], int)             else 0.0
+        decoder.stats[key] = 0 if isinstance(decoder.stats[key], int) \
+            else 0.0
     decoder.ttft_samples.clear()
     decoder.itl_samples.clear()
     decoder.gap_samples.clear()
