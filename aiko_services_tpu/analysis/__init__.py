@@ -1,0 +1,36 @@
+# graft-check: static analysis for the pipeline framework.
+#
+# Three layers, one CLI (`python -m aiko_services_tpu.analysis`):
+#   * graph_check — contract-check a PipelineDefinition without
+#     instantiating elements (dataflow, name mappings, dtype/shape/codec
+#     contracts, remote-hop wire codec legality);
+#   * lint — AST rules over package and user element files (blocking
+#     calls in event-loop handlers, raw locks, validation asserts,
+#     publish-under-lock, jit-in-frame);
+#   * the runtime lock-order detector lives in utils/lock.py (opt-in via
+#     AIKO_LOCK_CHECK=1) — the dynamic complement to these static layers.
+#
+# Findings are structured (rule id, severity, file:line) so CI gates on
+# them; see README "Static analysis (graft-check)" for the rule catalog.
+
+from .findings import (                                     # noqa: F401
+    ERROR, WARNING, INFO, Finding, format_findings, has_errors,
+)
+from .contracts import (                                    # noqa: F401
+    Alt, ContractError, compatible, parse_contract,
+)
+from .graph_check import (                                  # noqa: F401
+    check_definition, check_pipeline_file,
+)
+from .lint import (                                         # noqa: F401
+    LINT_RULES, lint_file, lint_paths, lint_source,
+)
+from .cli import main, self_check_findings                  # noqa: F401
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "Finding", "format_findings",
+    "has_errors", "Alt", "ContractError", "compatible", "parse_contract",
+    "check_definition", "check_pipeline_file",
+    "LINT_RULES", "lint_file", "lint_paths", "lint_source",
+    "main", "self_check_findings",
+]

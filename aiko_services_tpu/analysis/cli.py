@@ -1,0 +1,116 @@
+# graft-check CLI: `python -m aiko_services_tpu.analysis ...`
+#
+#   --pipeline DEF.json    contract-check pipeline definitions (repeat)
+#   --lint PATH            lint files/directories (repeat)
+#   --self-check           lint this package + contract-check the bundled
+#                          example pipelines (the repo's own CI gate)
+#   --codec KEY=CODEC      wire codec hints for --pipeline checks
+#   --format text|json     output format
+#   --strict               treat warnings as errors
+#
+# Exit status: 0 = clean (warnings allowed unless --strict), 1 = findings
+# at gating severity, 2 = usage error.
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .findings import ERROR, format_findings
+from .graph_check import check_pipeline_file
+from .lint import lint_paths
+
+__all__ = ["main", "self_check_findings"]
+
+
+def _package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+def _looks_like_pipeline(pathname: Path) -> bool:
+    try:
+        data = json.loads(pathname.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return False
+    return isinstance(data, dict) and "graph" in data and \
+        "elements" in data
+
+
+def self_check_findings() -> list:
+    """The repo's own gate: lint the whole package and contract-check
+    every bundled example pipeline definition."""
+    findings = lint_paths([_package_root()])
+    examples = _package_root().parent / "examples"
+    if examples.is_dir():
+        for pathname in sorted(examples.rglob("*.json")):
+            if _looks_like_pipeline(pathname):
+                findings.extend(check_pipeline_file(str(pathname)))
+    return findings
+
+
+def _parse_codecs(entries) -> dict:
+    hints = {}
+    for entry in entries or []:
+        key, _, codec = entry.partition("=")
+        if not key or not codec:
+            raise ValueError(f"--codec wants KEY=CODEC, got {entry!r}")
+        hints[key] = codec
+    return hints
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m aiko_services_tpu.analysis",
+        description="graft-check: static pipeline contract checker and "
+                    "event-loop lint")
+    parser.add_argument("--pipeline", action="append", default=[],
+                        metavar="DEF.json",
+                        help="pipeline definition to contract-check")
+    parser.add_argument("--lint", action="append", default=[],
+                        metavar="PATH",
+                        help="file or directory to lint (recursive)")
+    parser.add_argument("--self-check", action="store_true",
+                        help="lint this package and check the bundled "
+                             "example pipelines")
+    parser.add_argument("--codec", action="append", default=[],
+                        metavar="KEY=CODEC",
+                        help="wire codec hint for --pipeline checks")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--strict", action="store_true",
+                        help="warnings gate too")
+    args = parser.parse_args(argv)
+    if not (args.pipeline or args.lint or args.self_check):
+        parser.print_usage(sys.stderr)
+        print("nothing to do: give --pipeline, --lint, or --self-check",
+              file=sys.stderr)
+        return 2
+    try:
+        wire_codecs = _parse_codecs(args.codec)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+
+    findings = []
+    for pathname in args.pipeline:
+        findings.extend(check_pipeline_file(pathname,
+                                            wire_codecs=wire_codecs))
+    if args.lint:
+        findings.extend(lint_paths(args.lint))
+    if args.self_check:
+        findings.extend(self_check_findings())
+
+    if findings or args.format == "json":
+        # json mode always emits a document ("[]" when clean) so
+        # machine consumers can parse stdout unconditionally
+        print(format_findings(findings, args.format))
+    gating = [f for f in findings
+              if f.severity == ERROR or args.strict]
+    summary = f"graft-check: {len(findings)} finding(s), " \
+              f"{len([f for f in findings if f.severity == ERROR])} " \
+              f"error(s)"
+    if args.format == "text":
+        print(summary)
+    return 1 if gating else 0

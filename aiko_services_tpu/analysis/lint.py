@@ -1,0 +1,253 @@
+# Event-loop lint: AST rules for the failure modes that only bite under
+# load.
+#
+# The event engine is cooperative — one blocking call in any handler
+# stalls EVERY pipeline in the process — and jit-in-frame or
+# publish-under-lock bugs pass every unit test, then melt down at the
+# 200-stream rung.  These rules are purely lexical (no imports, no
+# execution) so they run on user element files too.
+#
+#   lint-blocking-call    time.sleep / .result() / .block_until_ready()
+#                         / blocking socket ops inside an event-loop
+#                         context (process_frame, start_stream,
+#                         stop_stream, or any function registered via
+#                         add_*_handler)
+#   lint-raw-lock         threading.Lock() where the diagnostic
+#                         utils.lock.Lock is required (named holder,
+#                         misuse errors, lock-order cycle detection);
+#                         threading.RLock is exempt (the diagnostic lock
+#                         is not reentrant)
+#   lint-assert           `assert` used for validation in non-test code
+#                         (compiled away under -O; raise instead)
+#   lint-publish-locked   broker publish/route while holding a lock
+#                         (delivery can re-enter or block under the lock)
+#   lint-jit-hot          jax.jit in per-frame code (a recompile per
+#                         frame-shape: the classic serving latency cliff)
+#
+# Waivers: a line (or its enclosing statement's first line) containing
+# `graft: disable=<rule-id>` (or `graft: disable=all`) suppresses that
+# rule there — deliberate exceptions stay visible in the diff.
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .findings import ERROR, Finding
+
+__all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
+
+LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
+              "lint-publish-locked", "lint-jit-hot")
+
+_HANDLER_REGISTRARS = {
+    "add_timer_handler", "add_oneshot_handler", "add_mailbox_handler",
+    "add_queue_handler", "add_flatout_handler",
+}
+_FRAME_METHODS = {"process_frame", "start_stream", "stop_stream"}
+_BLOCKING_ATTRS = {
+    "result": "concurrent-future .result() blocks until completion",
+    "block_until_ready": "device sync blocks the event loop",
+    "recv": "blocking socket receive",
+    "recvfrom": "blocking socket receive",
+    "accept": "blocking socket accept",
+    "wait_for_publish": "broker round-trip blocks the event loop",
+}
+
+
+def _is_test_path(path: str) -> bool:
+    name = Path(path).name
+    parts = Path(path).parts
+    return name.startswith("test_") or name == "conftest.py" or \
+        "tests" in parts
+
+
+def _func_tail(node: ast.AST) -> str:
+    """Last attribute/name component of a call target ('' when dynamic)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _collect_handlers(tree: ast.AST) -> tuple[set, set]:
+    """Names (and lambda node ids) registered as event-engine handlers
+    anywhere in the module — including method references like
+    self._mailbox_handler."""
+    names: set = set()
+    lambda_ids: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if _func_tail(node.func) not in _HANDLER_REGISTRARS:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            names.add(target.id)
+        elif isinstance(target, ast.Attribute):
+            names.add(target.attr)
+        elif isinstance(target, ast.Lambda):
+            lambda_ids.add(id(target))
+    return names, lambda_ids
+
+
+def _mentions_lock(node: ast.AST) -> bool:
+    return "lock" in ast.unparse(node).lower()
+
+
+class _ContextScanner(ast.NodeVisitor):
+    """Scan one event-loop-context function body for blocking calls and
+    jit use.  Nested function definitions and lambdas are NOT descended
+    into: a nested thread target may legitimately block, and nested
+    registered handlers get their own scan from the module linter."""
+
+    def __init__(self, lint, context_name):
+        self.lint = lint
+        self.context = context_name
+
+    def scan(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def visit_FunctionDef(self, node):      # no descent (see docstring)
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):
+        tail = _func_tail(node.func)
+        target = ast.unparse(node.func)
+        if target == "time.sleep":
+            self.lint.report(
+                "lint-blocking-call", node,
+                f"time.sleep in event-loop context {self.context!r} "
+                f"stalls every pipeline in the process (use a timer "
+                f"handler)")
+        elif tail in _BLOCKING_ATTRS:
+            self.lint.report(
+                "lint-blocking-call", node,
+                f".{tail}() in event-loop context {self.context!r}: "
+                f"{_BLOCKING_ATTRS[tail]}")
+        if target in ("jax.jit", "jit"):
+            self.lint.report(
+                "lint-jit-hot", node,
+                f"jax.jit in per-frame context {self.context!r}: build "
+                f"the jitted program once in __init__/_setup (per-frame "
+                f"jit recompiles per shape)")
+        self.generic_visit(node)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: list = []
+        self._seen: set = set()
+        self.is_test = _is_test_path(path)
+        self.handler_names: set = set()
+        self.lambda_ids: set = set()
+        self.lock_depth = 0
+
+    # -- waivers -----------------------------------------------------------
+    def _waived(self, rule: str, lineno: int) -> bool:
+        for line_number in (lineno, lineno - 1):
+            if 1 <= line_number <= len(self.lines):
+                text = self.lines[line_number - 1]
+                if "graft: disable=" in text and \
+                        (rule in text or "disable=all" in text):
+                    return True
+        return False
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        key = (rule, node.lineno, getattr(node, "col_offset", 0))
+        if key in self._seen or self._waived(rule, node.lineno):
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(rule, ERROR, self.path, node.lineno, message))
+
+    # -- module-wide rules -------------------------------------------------
+    def visit_Call(self, node):
+        if ast.unparse(node.func) == "threading.Lock":
+            self.report(
+                "lint-raw-lock", node,
+                "raw threading.Lock: use aiko_services_tpu.utils.Lock "
+                "(named holder, misuse errors, AIKO_LOCK_CHECK "
+                "lock-order cycle detection)")
+        if self.lock_depth > 0 and \
+                _func_tail(node.func) in ("publish", "route"):
+            self.report(
+                "lint-publish-locked", node,
+                f".{_func_tail(node.func)}() while holding a lock: "
+                f"delivery can re-enter or block under the lock — "
+                f"buffer under the lock, publish after release")
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        locked = any(_mentions_lock(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.lock_depth -= 1
+
+    def visit_Assert(self, node):
+        if not self.is_test:
+            self.report(
+                "lint-assert", node,
+                "assert used for validation in non-test code: compiled "
+                "away under python -O — raise ValueError/RuntimeError")
+        self.generic_visit(node)
+
+    # -- event-loop contexts -----------------------------------------------
+    def visit_FunctionDef(self, node):
+        if node.name in _FRAME_METHODS or node.name in self.handler_names:
+            _ContextScanner(self, node.name).scan(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if id(node) in self.lambda_ids:
+            _ContextScanner(self, "<lambda handler>").scan(node)
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>") -> list:
+    """Lint one source text; returns Findings."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Finding("lint-parse", ERROR, path, exc.lineno or 0,
+                        f"syntax error: {exc.msg}")]
+    linter = _Linter(path, source)
+    linter.handler_names, linter.lambda_ids = _collect_handlers(tree)
+    linter.visit(tree)
+    return linter.findings
+
+
+def lint_file(pathname) -> list:
+    path = Path(pathname)
+    try:
+        source = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as exc:
+        return [Finding("lint-parse", ERROR, str(path), 0, str(exc))]
+    return lint_source(source, str(path))
+
+
+def lint_paths(paths) -> list:
+    """Lint files and/or directories (recursive over *.py)."""
+    findings: list = []
+    for entry in paths:
+        path = Path(entry)
+        if path.is_dir():
+            for file_path in sorted(path.rglob("*.py")):
+                if "__pycache__" in file_path.parts:
+                    continue
+                findings.extend(lint_file(file_path))
+        else:
+            findings.extend(lint_file(path))
+    return findings

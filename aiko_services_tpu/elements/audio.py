@@ -55,6 +55,8 @@ class PE_MicrophoneSim(PipelineElement):
     audio (tone + noise) per timer tick — the hardware-free source for
     tests, demos and load benchmarks."""
 
+    contracts = {"out:audio": "f32[*]"}
+
     def start_stream(self, stream) -> None:
         import numpy as np
 
@@ -140,6 +142,8 @@ class PE_Speaker(PipelineElement):
     stream.variables["speaker.audio"] (testable sink, reference:
     audio_io.py PE_Speaker)."""
 
+    contracts = {"in:audio": "f32[*]"}
+
     def process_frame(self, frame: Frame, audio=None, **_) -> FrameOutput:
         import numpy as np
 
@@ -168,6 +172,9 @@ class PE_Speaker(PipelineElement):
 class PE_FFT(PipelineElement):
     """audio → (frequencies, magnitudes) (reference: audio_io.py PE_FFT;
     jitted jax so it fuses with downstream device work)."""
+
+    contracts = {"in:audio": "f32[*]", "out:frequencies": "f64[*]",
+                 "out:magnitudes": "f32[*]"}
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

@@ -188,6 +188,9 @@ class PE_LlamaAgent(PipelineElement):
     the element self-contained; a real BPE tokenizer drops in via the
     `tokenizer`/`detokenizer` attributes)."""
 
+    contracts = {"in:text": "str", "out:response": "str",
+                 "out:response_tokens": "i32[*]"}
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._setup_done = False

@@ -80,6 +80,8 @@ class PE_AudioFraming(PipelineElement):
     per stream and emits their concatenation — more ASR context per frame
     (reference: speech_elements.py:44-73)."""
 
+    contracts = {"audio": "f32[*]"}
+
     def start_stream(self, stream) -> None:
         count, _ = self.get_parameter("window_count", 3, stream)
         stream.variables[f"{self.definition.name}.window"] = \
@@ -103,6 +105,8 @@ class PE_LogMel(PipelineElement):
     frontend to the host CPU backend — right when the accelerator is
     behind a thin link and the batched ASR program uploads mel itself
     (mel is 4× smaller than raw f32 audio over the wire)."""
+
+    contracts = {"in:audio": "f32[*]", "out:mel": "f32[*,80]"}
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -137,6 +141,14 @@ class PE_WhisperASR(PipelineElement):
     samples to tokens, no per-frame host feature dispatch).  The compute
     runtime is found by service name via parameter `compute` (default
     "compute").  Emits {"tokens": int32[T], "text": str}."""
+
+    contracts = {
+        "in:mel": "f32[*,80] | bf16[*,80]",
+        # raw float samples, 16-bit PCM, or pre-encoded µ-law codes
+        "in:audio": "f32[*] | i16[*] | mulaw-u8[*]",
+        "out:tokens": "i32[*]",
+        "out:text": "str",
+    }
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -277,7 +289,12 @@ class PE_WhisperASR(PipelineElement):
             task=str(task), timestamps=self.timestamps)
         # the prompt occupies decoder positions too; n_text_ctx was
         # sized max_tokens+8 above and the longest prompt is 4 tokens
-        assert len(sot_sequence) + max_tokens <= self.config.n_text_ctx
+        if len(sot_sequence) + max_tokens > self.config.n_text_ctx:
+            raise ValueError(
+                f"ASR element {self.name}: conditioning prompt "
+                f"({len(sot_sequence)} tokens) + max_tokens "
+                f"({max_tokens}) exceeds decoder context "
+                f"{self.config.n_text_ctx}")
 
         # pp_stages >= 2: TRUE pipeline parallelism over device groups —
         # the mel+encoder stage runs on one group, the autoregressive
@@ -580,6 +597,8 @@ class PE_Synthesize(PipelineElement):
     """Placeholder TTS: deterministic formant-ish sine stack per token —
     keeps the text→audio seam exercised end-to-end until a neural TTS
     model lands (reference uses Coqui VITS, speech_elements.py:96-131)."""
+
+    contracts = {"in:text": "str", "out:audio": "f32[*]"}
 
     def process_frame(self, frame: Frame, text="", **_) -> FrameOutput:
         import numpy as np

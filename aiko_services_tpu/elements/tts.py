@@ -21,6 +21,8 @@ class PE_NeuralTTS(PipelineElement):
     max_tokens, max_batch, max_wait, gl_iters.
     Emits {"audio": float32[samples], "sample_rate"}."""
 
+    contracts = {"in:text": "str", "out:audio": "f32[*]"}
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.logger = get_logger(f"tts.{self.name}")

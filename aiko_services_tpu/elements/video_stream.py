@@ -29,7 +29,7 @@ import threading
 import time
 
 from ..pipeline import Frame, FrameOutput, PipelineElement
-from ..utils import get_logger
+from ..utils import Lock, get_logger
 
 __all__ = ["PE_VideoStreamRead", "PE_VideoStreamServe", "MJPEGStreamServer",
            "PE_VideoStreamWrite",
@@ -80,7 +80,7 @@ class PE_VideoStreamRead(PipelineElement):
         logger = get_logger(f"videostream.{self.name}")
         state = {"latest": None, "stop": False, "connected": False,
                  "reconnects": -1,       # first connect isn't a reconnect
-                 "lock": threading.Lock()}
+                 "lock": Lock(f"videostream.{self.name}")}
         stream.variables[f"{self.definition.name}.state"] = state
 
         def capture_loop():

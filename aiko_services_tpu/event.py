@@ -20,6 +20,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -37,6 +38,23 @@ __all__ = [
 
 _TICK = 0.01    # idle sleep when nothing is due (reference: 10ms tick)
 _logger = logging.getLogger("aiko_tpu.event")
+
+
+def _slow_handler_threshold() -> float:
+    """AIKO_EVENT_CHECK=<seconds> (or =1 for 1 s): warn when a handler
+    blocks the cooperative loop longer than this — the runtime
+    counterpart of the static lint-blocking-call rule.  0 disables (the
+    default; handlers doing first-call jax compiles legitimately spike)."""
+    raw = os.environ.get("AIKO_EVENT_CHECK", "")
+    if raw.lower() in ("", "0", "false", "no", "off"):
+        return 0.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 1.0
+
+
+SLOW_HANDLER_SECONDS = _slow_handler_threshold()
 
 
 class Clock:
@@ -213,12 +231,25 @@ class EventEngine:
     @staticmethod
     def _guard(handler, *args) -> None:
         """Handler faults must never kill the scheduler: any remote peer can
-        trigger a handler exception with one malformed message."""
+        trigger a handler exception with one malformed message.  With
+        AIKO_EVENT_CHECK set, handlers that BLOCK the loop past the
+        threshold are reported too (wall time: the loop is stalled for
+        real regardless of which clock the engine schedules by)."""
+        started = time.perf_counter() if SLOW_HANDLER_SECONDS else 0.0
         try:
             handler(*args)
         except Exception:
             _logger.exception("event handler %r raised",
                               getattr(handler, "__qualname__", handler))
+        if SLOW_HANDLER_SECONDS:
+            elapsed = time.perf_counter() - started
+            if elapsed > SLOW_HANDLER_SECONDS:
+                _logger.warning(
+                    "event handler %r blocked the loop for %.3fs "
+                    "(threshold %.3fs; every pipeline in this process "
+                    "stalled meanwhile)",
+                    getattr(handler, "__qualname__", handler), elapsed,
+                    SLOW_HANDLER_SECONDS)
 
     def step(self) -> bool:
         """Run one scheduler iteration.  Returns True if any work was done."""

@@ -13,11 +13,12 @@
 from __future__ import annotations
 
 import bisect
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+from ..utils.lock import Lock
 
 __all__ = ["BatchItem", "BatchingScheduler", "ShapeBuckets"]
 
@@ -76,7 +77,7 @@ class BatchingScheduler:
         # the device at once — overlap depth is explicit and tunable,
         # not an accident of arrival timing)
         self.dispatch_gate = dispatch_gate
-        self._lock = threading.Lock()
+        self._lock = Lock("batching.scheduler")
         self._queues: dict[int, _Bucket] = {}
         # EWMA of recent per-batch service time (dispatch → results),
         # fed back by the owner via observe_service_time(): the

@@ -52,6 +52,7 @@ __all__ = [
     "PipelineGraph", "PipelineElement", "Pipeline", "Stream", "Frame",
     "FrameOutput", "DEFERRED", "parse_pipeline_definition",
     "load_pipeline_definition", "definition_to_dict", "PipelineError",
+    "lookup_contract",
 ]
 
 PROTOCOL_PIPELINE = ServiceProtocol("pipeline")
@@ -64,6 +65,14 @@ class PipelineError(ValueError):
     pass
 
 
+def lookup_contract(contracts: dict, name: str, direction: str):
+    """The one contract-lookup rule: a direction-prefixed key
+    ("in:audio"/"out:audio") beats a plain one ("audio").  Shared by
+    PipelineElementDefinition.contract_for and the static checker's
+    class-attribute fallback so the two can never drift."""
+    return contracts.get(f"{direction}:{name}", contracts.get(name))
+
+
 # ---------------------------------------------------------------------------
 # Definition schema
 # ---------------------------------------------------------------------------
@@ -74,12 +83,22 @@ class PipelineElementDefinition:
 
     deploy is either local —  {"local": {"module": ..., "class_name": ...}}
     — or remote — {"remote": {"service_filter": {...}}} (reference:
-    pipeline.py:156-173)."""
+    pipeline.py:156-173).
+
+    contracts maps io names to dtype/shape/codec contract strings (see
+    analysis/contracts.py), e.g. {"audio": "f32[*] | mulaw-u8[*]"}.
+    Prefix a key "in:"/"out:" when the same name needs different
+    contracts per direction; a plain key covers both.  Declared either
+    here, per io item ({"name": "audio", "contract": "f32[*]"}), or as
+    a class-level `contracts` attribute on the element class — the
+    static checker (python -m aiko_services_tpu.analysis) proves
+    producer/consumer compatibility per edge before deployment."""
     name: str
     input: list = field(default_factory=list)    # [{"name":..,"type":..}]
     output: list = field(default_factory=list)
     parameters: dict = field(default_factory=dict)
     deploy: dict = field(default_factory=dict)
+    contracts: dict = field(default_factory=dict)
 
     @property
     def input_names(self) -> list[str]:
@@ -92,6 +111,10 @@ class PipelineElementDefinition:
     @property
     def is_remote(self) -> bool:
         return "remote" in self.deploy
+
+    def contract_for(self, name: str, direction: str) -> str | None:
+        """Contract string for an io name; direction is "in" or "out"."""
+        return lookup_contract(self.contracts, name, direction)
 
 
 @dataclass
@@ -151,10 +174,23 @@ def parse_pipeline_definition(data: dict,
         if name in seen:
             fail(f"{where}: duplicate element name {name!r}")
         seen.add(name)
-        for io_key in ("input", "output"):
+        contracts = raw.get("contracts", {})
+        if not isinstance(contracts, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in contracts.items()):
+            fail(f"{where}.contracts: must map io names to contract "
+                 f"strings")
+        contracts = dict(contracts)
+        for io_key, prefix in (("input", "in:"), ("output", "out:")):
             for io_item in raw.get(io_key, []):
                 if not isinstance(io_item, dict) or "name" not in io_item:
                     fail(f"{where}.{io_key}: entries need a name")
+                if "contract" in io_item:
+                    if not isinstance(io_item["contract"], str):
+                        fail(f"{where}.{io_key}: contract must be a "
+                             f"string")
+                    contracts.setdefault(prefix + io_item["name"],
+                                         io_item["contract"])
         deploy = raw.get("deploy", {})
         if deploy:
             if set(deploy) - {"local", "remote"} or len(deploy) != 1:
@@ -168,7 +204,8 @@ def parse_pipeline_definition(data: dict,
             input=list(raw.get("input", [])),
             output=list(raw.get("output", [])),
             parameters=dict(raw.get("parameters", {})),
-            deploy=dict(deploy)))
+            deploy=dict(deploy),
+            contracts=contracts))
 
     return PipelineDefinition(
         version=data["version"], name=data["name"], runtime=data["runtime"],
@@ -192,6 +229,8 @@ def definition_to_dict(definition: PipelineDefinition) -> dict:
             raw["parameters"] = dict(element.parameters)
         if element.deploy:
             raw["deploy"] = dict(element.deploy)
+        if element.contracts:
+            raw["contracts"] = dict(element.contracts)
         elements.append(raw)
     data = {"version": definition.version, "name": definition.name,
             "runtime": definition.runtime, "graph": list(definition.graph),
@@ -352,7 +391,14 @@ class PipelineElement(Actor):
 
     Elements whose compute is a jax program should build/jit it once in
     __init__ or start_stream and call it in process_frame — process_frame
-    itself is host-side control code."""
+    itself is host-side control code.
+
+    Subclasses may declare a class-level `contracts` dict (io name →
+    contract string, "in:"/"out:" prefixes for direction-specific ones)
+    that the static checker uses when the pipeline definition doesn't
+    declare its own — resolved by import only, never construction."""
+
+    contracts: dict = {}
 
     def __init__(self, runtime, name, definition: PipelineElementDefinition,
                  pipeline: "Pipeline | None" = None, protocol=None,

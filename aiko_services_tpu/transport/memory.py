@@ -25,6 +25,7 @@ import threading
 from collections import deque
 from typing import Callable
 
+from ..utils.lock import Lock
 from .message import Message, topic_matches
 
 __all__ = ["MemoryBroker", "MemoryMessage"]
@@ -273,7 +274,7 @@ class MemoryMessage(Message):
         self._rx_ctl: deque = deque()       # (seq, topic, payload)
         self._rx_data: deque = deque()
         self._rx_seq = itertools.count()
-        self._rx_lock = threading.Lock()
+        self._rx_lock = Lock("memory.rx")
         self._draining = False
         self._held = False
 

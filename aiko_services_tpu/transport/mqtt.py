@@ -185,13 +185,17 @@ class MQTTMessage(Message):
     def _flush_pending(self) -> None:
         # serialized so two threads (on_connect network thread + a
         # publish() caller hitting the re-check) cannot interleave pops
-        # and reorder the buffered messages
+        # and reorder the buffered messages.  Publishing under the lock
+        # is deliberate here — paho's publish() only enqueues to its own
+        # network thread, and releasing between pop and publish would
+        # reopen the reorder window the lock exists to close.
         with self._lock:
             while self._pending:
                 try:
                     topic, payload, retain = self._pending.popleft()
                 except IndexError:        # pragma: no cover - race
                     break
+                # graft: disable=lint-publish-locked (see comment above)
                 self._client.publish(topic, payload, retain=retain)
 
     # -- Message interface -------------------------------------------------

@@ -46,7 +46,7 @@ from ..utils.sexpr import generate, generate_sexpr, parse_sexpr
 __all__ = [
     "MAGIC", "WIRE_VERSION", "WireError", "is_envelope", "contains_binary",
     "encode_envelope", "decode_envelope", "encode_rpc", "supports_binary",
-    "WIRE_CODECS",
+    "WIRE_CODECS", "WIRE_CODEC_DTYPES", "WIRE_CODEC_RANK", "codec_legal",
 ]
 
 MAGIC = b"AIKW"
@@ -161,6 +161,30 @@ WIRE_CODECS = {
     "dct8": (_dct8_encode, _dct8_decode),
 }
 
+# The codec/dtype legality table — what each lossy codec can CARRY.
+# Exported so the static checker (analysis/graph_check.py) proves remote
+# hops sound before any frame moves, and enforced at encode time below
+# so a wrong hint fails loudly instead of producing garbage tensors.
+#   mulaw: companding of float audio in [-1, 1];
+#   i8:    absmax quantization of float tensors (one f32 scale);
+#   dct8:  blockwise DCT of uint8 images, shape [H, W, C].
+WIRE_CODEC_DTYPES = {
+    "mulaw": ("float16", "float32", "float64"),
+    "i8": ("float16", "float32", "float64", "bfloat16"),
+    "dct8": ("uint8",),
+}
+WIRE_CODEC_RANK = {"dct8": 3}
+
+
+def codec_legal(codec: str, dtype, ndim: int | None = None) -> bool:
+    """True when `codec` can legally carry an array of `dtype` (and,
+    when given, rank `ndim`)."""
+    allowed = WIRE_CODEC_DTYPES.get(codec)
+    if allowed is None or str(dtype) not in allowed:
+        return False
+    rank = WIRE_CODEC_RANK.get(codec)
+    return ndim is None or rank is None or ndim == rank
+
 
 # -- encode ------------------------------------------------------------------
 
@@ -179,6 +203,11 @@ def _extract(obj, buffers, key=None, codec_hints=None):
         if codec:
             if codec not in WIRE_CODECS:
                 raise WireError(f"unknown wire codec {codec!r}")
+            if not codec_legal(codec, array.dtype, array.ndim):
+                raise WireError(
+                    f"wire codec {codec!r} cannot carry key {key!r} "
+                    f"(dtype {array.dtype}, rank {array.ndim}; legal "
+                    f"dtypes: {WIRE_CODEC_DTYPES[codec]})")
             array, meta = WIRE_CODECS[codec][0](array)
         if not array.flags.c_contiguous:
             array = np.ascontiguousarray(array)

@@ -54,6 +54,12 @@ class Graph:
         return node
 
     def add_edge(self, tail: str, head: str):
+        # validate the head NOW: a dangling successor used to slip in
+        # silently and only surface later in predecessor_map()
+        if head not in self._nodes:
+            raise GraphError(
+                f"add_edge {tail}->{head}: unknown head node {head!r} "
+                f"(add it first)")
         self.node(tail).add_successor(head)
 
     def remove(self, name: str):

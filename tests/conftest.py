@@ -5,6 +5,11 @@
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Run the whole suite under the lock-order race detector (utils/lock.py):
+# every diagnostic Lock acquisition feeds the global acquisition-order
+# graph, so an ABBA inversion anywhere in the tests surfaces as a
+# potential-deadlock report instead of a once-a-month CI hang.
+os.environ.setdefault("AIKO_LOCK_CHECK", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = \
@@ -23,6 +28,21 @@ from aiko_services_tpu.event import EventEngine, VirtualClock  # noqa: E402
 from aiko_services_tpu.transport.memory import MemoryBroker  # noqa: E402
 from aiko_services_tpu.process import ProcessRuntime  # noqa: E402
 from aiko_services_tpu.transport.memory import MemoryMessage  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lock_order_gate():
+    """Fail the run if any test left a lock-order violation behind:
+    the detector reporting without gating would reduce a potential
+    deadlock to a log line nobody reads.  Tests that provoke
+    violations on purpose (test_analysis ABBA fixtures) reset the
+    checker before yielding control back."""
+    yield
+    from aiko_services_tpu.utils import lock_check_report
+    violations = lock_check_report()
+    assert not violations, (
+        "lock-order violations detected during the test run:\n"
+        + "\n".join(str(v) for v in violations))
 
 
 @pytest.fixture

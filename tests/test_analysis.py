@@ -1,0 +1,435 @@
+# graft-check: pipeline contract checker, event-loop lint, and the
+# runtime lock-order detector.
+
+import threading
+
+import pytest
+
+from aiko_services_tpu.analysis import (
+    check_definition, lint_source, main, parse_contract, compatible,
+    ContractError, self_check_findings, has_errors,
+)
+from aiko_services_tpu.pipeline import parse_pipeline_definition
+from aiko_services_tpu.transport import wire
+from aiko_services_tpu.utils import lock as lock_module
+from aiko_services_tpu.utils.lock import Lock
+
+
+def _definition(graph, elements, parameters=None):
+    return parse_pipeline_definition({
+        "version": 0, "name": "p_test", "runtime": "python",
+        "graph": graph, "elements": elements,
+        "parameters": parameters or {}})
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# contract grammar
+# ---------------------------------------------------------------------------
+
+class TestContracts:
+    def test_parse_alternatives(self):
+        alts = parse_contract("f32[*,80] | mulaw-u8[*]")
+        assert [(a.codec, a.dtype, a.shape) for a in alts] == [
+            ("", "float32", ("*", 80)), ("mulaw", "uint8", ("*",))]
+
+    def test_parse_scalar_and_any(self):
+        assert parse_contract("str")[0].dtype == "str"
+        assert parse_contract("any")[0].shape is None
+
+    def test_syntax_errors(self):
+        for bad in ("", "f99", "f32[", "f32[x]", "zstd-u8", "mulaw-str"):
+            with pytest.raises(ContractError):
+                parse_contract(bad)
+
+    def test_compatibility(self):
+        f32 = parse_contract("f32[*]")
+        assert compatible(f32, parse_contract("f32[*] | i16[*]"))
+        assert compatible(f32, parse_contract("any"))
+        assert not compatible(f32, parse_contract("i16[*]"))
+        assert not compatible(f32, parse_contract("f32[*,80]"))  # rank
+        assert not compatible(parse_contract("mulaw-u8[*]"),
+                              parse_contract("u8[*]"))           # codec
+        assert compatible(parse_contract("f32[3,80]"),
+                          parse_contract("f32[*,80]"))
+
+
+# ---------------------------------------------------------------------------
+# graph contract checker: seeded-broken definitions
+# ---------------------------------------------------------------------------
+
+class TestGraphCheck:
+    def test_clean_pipeline_passes(self):
+        definition = _definition(
+            ["(PE_A (PE_B PE_C))"],
+            [{"name": "PE_A", "output": [{"name": "x"}]},
+             {"name": "PE_B", "input": [{"name": "x"}],
+              "output": [{"name": "y"}]},
+             {"name": "PE_C", "input": [{"name": "y"}]}])
+        assert not has_errors(check_definition(definition))
+
+    def test_missing_producer(self):
+        definition = _definition(
+            ["(PE_A PE_B)"],
+            [{"name": "PE_A", "output": [{"name": "x"}]},
+             {"name": "PE_B", "input": [{"name": "never_made"}]}])
+        findings = check_definition(definition)
+        assert "graph-missing-input" in _rules(findings)
+
+    def test_stream_parameter_satisfies_input(self):
+        definition = _definition(
+            ["(PE_A PE_B)"],
+            [{"name": "PE_A", "output": [{"name": "x"}]},
+             {"name": "PE_B", "input": [{"name": "threshold"}]}],
+            parameters={"PE_B.threshold": 0.5})
+        assert "graph-missing-input" not in \
+            _rules(check_definition(definition))
+
+    def test_mapping_mismatch(self):
+        definition = _definition(
+            ["(PE_A (PE_B (nope: y)))"],
+            [{"name": "PE_A", "output": [{"name": "x"}]},
+             {"name": "PE_B", "input": [{"name": "y"}]}])
+        findings = check_definition(definition)
+        assert "graph-mapping" in _rules(findings)
+
+    def test_dtype_mismatch_on_edge(self):
+        definition = _definition(
+            ["(PE_A PE_B)"],
+            [{"name": "PE_A",
+              "output": [{"name": "audio", "contract": "f32[*]"}]},
+             {"name": "PE_B",
+              "input": [{"name": "audio", "contract": "i16[*]"}]}])
+        findings = check_definition(definition)
+        assert "graph-contract" in _rules(findings)
+
+    def test_compatible_contracts_pass(self):
+        definition = _definition(
+            ["(PE_A PE_B)"],
+            [{"name": "PE_A",
+              "output": [{"name": "audio", "contract": "f32[*]"}]},
+             {"name": "PE_B",
+              "input": [{"name": "audio",
+                         "contract": "f32[*] | i16[*]"}]}])
+        assert not has_errors(check_definition(definition))
+
+    def test_contract_syntax_error_reported(self):
+        definition = _definition(
+            ["(PE_A PE_B)"],
+            [{"name": "PE_A",
+              "output": [{"name": "x", "contract": "float99[*]"}]},
+             {"name": "PE_B",
+              "input": [{"name": "x", "contract": "f32[*]"}]}])
+        findings = check_definition(definition)
+        assert "graph-contract-syntax" in _rules(findings)
+
+    def test_illegal_codec_on_remote_hop(self):
+        definition = _definition(
+            ["(PE_Cam PE_Remote)"],
+            [{"name": "PE_Cam",
+              "output": [{"name": "image", "contract": "u8[*,*,3]"}]},
+             {"name": "PE_Remote",
+              "input": [{"name": "image", "contract": "u8[*,*,3]"}],
+              "output": [{"name": "objects"}],
+              "deploy": {"remote": {"service_filter": {"name": "s"}}}}],
+            parameters={"wire_codecs": {"image": "mulaw"}})
+        findings = check_definition(definition)
+        assert "graph-codec" in _rules(findings)
+
+    def test_legal_codec_on_remote_hop(self):
+        definition = _definition(
+            ["(PE_Mic PE_Remote)"],
+            [{"name": "PE_Mic",
+              "output": [{"name": "audio", "contract": "f32[*]"}]},
+             {"name": "PE_Remote",
+              "input": [{"name": "audio", "contract": "f32[*]"}],
+              "output": [{"name": "text"}],
+              "deploy": {"remote": {"service_filter": {"name": "s"}}}}],
+            parameters={"wire_codecs": {"audio": "mulaw"}})
+        findings = check_definition(definition)
+        assert "graph-codec" not in _rules(findings)
+
+    def test_unmatched_codec_hint_warns(self):
+        # a typo'd hint key would silently disable compression at
+        # runtime — the checker must say so
+        definition = _definition(
+            ["(PE_Mic PE_Remote)"],
+            [{"name": "PE_Mic",
+              "output": [{"name": "audio", "contract": "f32[*]"}]},
+             {"name": "PE_Remote",
+              "input": [{"name": "audio", "contract": "f32[*]"}],
+              "output": [{"name": "text"}],
+              "deploy": {"remote": {"service_filter": {"name": "s"}}}}],
+            parameters={"wire_codecs": {"auido": "mulaw"}})
+        findings = check_definition(definition)
+        assert "graph-codec-unused" in _rules(findings)
+
+    def test_unknown_codec_reported(self):
+        definition = _definition(
+            ["(PE_A PE_Remote)"],
+            [{"name": "PE_A", "output": [{"name": "x"}]},
+             {"name": "PE_Remote", "input": [{"name": "x"}],
+              "output": [{"name": "y"}],
+              "deploy": {"remote": {"service_filter": {"name": "s"}}}}],
+            parameters={"wire_codecs": {"x": "zstd"}})
+        assert "graph-codec" in _rules(check_definition(definition))
+
+    def test_dead_output_and_unused_element_warn(self):
+        definition = _definition(
+            ["(PE_A PE_B)"],
+            [{"name": "PE_A",
+              "output": [{"name": "x"}, {"name": "unused"}]},
+             {"name": "PE_B", "input": [{"name": "x"}]},
+             {"name": "PE_Orphan", "input": [], "output": []}])
+        findings = check_definition(definition)
+        rules = _rules(findings)
+        assert "graph-dead-output" in rules
+        assert "graph-unused-element" in rules
+        assert not has_errors(findings)     # both are warnings
+
+    def test_class_contracts_resolved_without_instantiation(self):
+        # PE_LogMel emits f32[*,80]; an i16-only consumer must clash
+        definition = _definition(
+            ["(PE_LogMel PE_Sink)"],
+            [{"name": "PE_LogMel",
+              "input": [{"name": "audio"}],
+              "output": [{"name": "mel"}]},
+             {"name": "PE_Sink",
+              "input": [{"name": "mel", "contract": "i16[*]"}]}])
+        findings = check_definition(definition)
+        assert "graph-contract" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# event-loop lint
+# ---------------------------------------------------------------------------
+
+class TestLint:
+    def _rules_at(self, source):
+        return {(f.rule, f.line)
+                for f in lint_source(source, "element.py")}
+
+    def test_blocking_sleep_in_process_frame(self):
+        rules = self._rules_at(
+            "import time\n"
+            "class PE_X:\n"
+            "    def process_frame(self, frame):\n"
+            "        time.sleep(1)\n")
+        assert ("lint-blocking-call", 4) in rules
+
+    def test_blocking_in_registered_handler(self):
+        rules = self._rules_at(
+            "import time\n"
+            "def setup(engine):\n"
+            "    def on_tick():\n"
+            "        time.sleep(0.5)\n"
+            "    engine.add_timer_handler(on_tick, 1.0)\n")
+        assert ("lint-blocking-call", 4) in rules
+
+    def test_thread_target_not_flagged(self):
+        rules = self._rules_at(
+            "import time, threading\n"
+            "class PE_X:\n"
+            "    def start_stream(self, stream):\n"
+            "        def capture():\n"
+            "            time.sleep(1)\n"
+            "        threading.Thread(target=capture).start()\n")
+        assert not any(r == "lint-blocking-call" for r, _ in rules)
+
+    def test_block_until_ready_flagged(self):
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        y = self._fn(x)\n"
+            "        y.block_until_ready()\n")
+        assert ("lint-blocking-call", 4) in rules
+
+    def test_raw_lock_flagged_and_rlock_exempt(self):
+        rules = self._rules_at(
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.RLock()\n")
+        assert ("lint-raw-lock", 2) in rules
+        assert ("lint-raw-lock", 3) not in rules
+
+    def test_assert_flagged_outside_tests(self):
+        assert ("lint-assert", 1) in self._rules_at("assert x > 0\n")
+        # same source under a test path: exempt
+        assert not lint_source("assert x > 0\n", "tests/test_x.py")
+
+    def test_publish_under_lock(self):
+        rules = self._rules_at(
+            "class Broker:\n"
+            "    def send(self):\n"
+            "        with self._lock:\n"
+            "            self.transport.publish('t', 'p')\n")
+        assert ("lint-publish-locked", 4) in rules
+
+    def test_jit_in_process_frame(self):
+        rules = self._rules_at(
+            "import jax\n"
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        return jax.jit(lambda v: v)(x)\n")
+        assert ("lint-jit-hot", 4) in rules
+
+    def test_jit_in_init_not_flagged(self):
+        rules = self._rules_at(
+            "import jax\n"
+            "class PE_X:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda v: v)\n")
+        assert not any(r == "lint-jit-hot" for r, _ in rules)
+
+    def test_waiver_comment(self):
+        source = ("import threading\n"
+                  "x = threading.Lock()"
+                  "   # graft: disable=lint-raw-lock\n")
+        assert not lint_source(source, "element.py")
+
+
+# ---------------------------------------------------------------------------
+# wire codec legality table
+# ---------------------------------------------------------------------------
+
+class TestCodecLegality:
+    def test_table(self):
+        assert wire.codec_legal("mulaw", "float32")
+        assert not wire.codec_legal("mulaw", "uint8")
+        assert wire.codec_legal("dct8", "uint8", 3)
+        assert not wire.codec_legal("dct8", "uint8", 1)
+        assert not wire.codec_legal("nope", "float32")
+
+    def test_encode_rejects_illegal_codec(self):
+        import numpy as np
+        image = np.zeros((8, 8, 3), np.uint8)
+        with pytest.raises(wire.WireError, match="cannot carry"):
+            wire.encode_envelope("cmd", [{"image": image}],
+                                 codec_hints={"image": "mulaw"})
+
+    def test_encode_accepts_legal_codec(self):
+        import numpy as np
+        audio = np.zeros(160, np.float32)
+        payload = wire.encode_envelope("cmd", [{"audio": audio}],
+                                      codec_hints={"audio": "mulaw"})
+        command, params = wire.decode_envelope(payload)
+        assert command == "cmd" and params[0]["audio"].shape == (160,)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock diagnostics
+# ---------------------------------------------------------------------------
+
+class TestLockDiagnostics:
+    def test_release_without_acquire(self):
+        with pytest.raises(RuntimeError, match="release without acquire"):
+            Lock("t_never").release()
+
+    def test_double_release(self):
+        lk = Lock("t_double")
+        lk.acquire("here")
+        lk.release()
+        with pytest.raises(RuntimeError, match="release without acquire"):
+            lk.release()
+
+    def test_release_by_non_holder_thread(self):
+        lk = Lock("t_foreign")
+        lk.acquire("main-thread")
+        errors = []
+
+        def foreign():
+            try:
+                lk.release()
+            except RuntimeError as exc:
+                errors.append(str(exc))
+
+        thread = threading.Thread(target=foreign, name="intruder")
+        thread.start()
+        thread.join()
+        assert errors and "intruder" in errors[0]
+        lk.release()        # holder releases cleanly afterwards
+
+    def test_holder_records_thread_name(self):
+        lk = Lock("t_holder")
+        with lk:
+            location, thread_name = lk.holder()
+            assert location == "context-manager"
+            assert thread_name == threading.current_thread().name
+        assert lk.holder() is None
+
+    def test_reentrant_acquire_raises_under_check(self):
+        lock_module.enable_lock_check(True)
+        lock_module.lock_check_reset()
+        try:
+            lk = Lock("t_reentrant")
+            lk.acquire("outer")
+            with pytest.raises(RuntimeError, match="re-entrant"):
+                lk.acquire("inner")
+            lk.release()
+        finally:
+            lock_module.lock_check_reset()
+
+    def test_abba_cycle_detected(self):
+        lock_module.enable_lock_check(True)
+        lock_module.lock_check_reset()
+        try:
+            lock_a, lock_b = Lock("t_A"), Lock("t_B")
+            with lock_a:
+                with lock_b:
+                    pass
+            assert not lock_module.lock_check_report()  # consistent order
+            with lock_b:
+                with lock_a:        # inversion: the ABBA pattern
+                    pass
+            report = lock_module.lock_check_report()
+            assert len(report) == 1
+            violation = report[0]
+            assert {"t_A", "t_B"} <= set(violation.cycle)
+            # both acquisition stacks are recorded for the deadlock report
+            assert violation.this_stack and violation.prior_stack
+            assert "test_analysis" in violation.this_stack
+        finally:
+            lock_module.lock_check_reset()
+
+
+# ---------------------------------------------------------------------------
+# CLI + the repo's own gate
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def test_no_arguments_is_usage_error(self):
+        assert main([]) == 2
+
+    def test_json_output_parses_even_when_clean(self, tmp_path, capsys):
+        import json
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert main(["--lint", str(clean), "--format", "json"]) == 0
+        assert json.loads(capsys.readouterr().out) == []
+
+    def test_lint_broken_file_fails(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\nlock = threading.Lock()\n")
+        assert main(["--lint", str(bad)]) == 1
+
+    def test_pipeline_check_fails_on_broken_definition(self, tmp_path):
+        import json
+        definition = {
+            "version": 0, "name": "p", "runtime": "python",
+            "graph": ["(PE_A PE_B)"],
+            "elements": [
+                {"name": "PE_A", "output": [{"name": "x"}]},
+                {"name": "PE_B", "input": [{"name": "never_made"}]}]}
+        pathname = tmp_path / "broken.json"
+        pathname.write_text(json.dumps(definition))
+        assert main(["--pipeline", str(pathname)]) == 1
+
+    def test_self_check_passes_on_this_repo(self):
+        # the tier-1 gate: our own package and examples stay clean
+        findings = self_check_findings()
+        errors = [f for f in findings if f.severity == "error"]
+        assert not errors, "\n".join(str(f) for f in errors)
+        assert main(["--self-check"]) == 0

@@ -13,6 +13,14 @@ class TestGraphBasics:
         assert g.predecessors("b") == ["a"]
         assert "a" in g and len(g) == 2
 
+    def test_add_edge_unknown_head(self):
+        # a dangling successor used to slip in silently and only blow up
+        # later in predecessor_map(); now it fails at edge-add time
+        g = Graph()
+        g.add("a")
+        with pytest.raises(GraphError, match="unknown head"):
+            g.add_edge("a", "ghost")
+
     def test_duplicate_node(self):
         g = Graph()
         g.add("a")
