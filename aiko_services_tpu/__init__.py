@@ -23,7 +23,7 @@ from .actor import (                                        # noqa: F401
     get_remote_proxy,
 )
 from .registrar import Registrar                            # noqa: F401
-from .process_manager import ProcessManager                 # noqa: F401
+from .process_manager import ProcessManager, RestartPolicy  # noqa: F401
 from .lifecycle import (                                    # noqa: F401
     LifeCycleClient, LifeCycleManager,
 )
@@ -36,6 +36,6 @@ from .storage import (                                      # noqa: F401
     ResponseCollector, Storage, do_command, do_request,
 )
 from .transport import (                                    # noqa: F401
-    MemoryBroker, MemoryMessage, Message, MQTT_AVAILABLE, default_broker,
-    topic_matches,
+    ChaosBroker, ChaosMessage, FaultPlan, FaultRule, MemoryBroker,
+    MemoryMessage, Message, MQTT_AVAILABLE, default_broker, topic_matches,
 )

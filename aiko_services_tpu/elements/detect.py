@@ -17,6 +17,14 @@ class PE_Detect(PipelineElement):
     score_threshold, max_batch, max_wait, compute, wire (raw|dct8),
     dct_keep."""
 
+    # any-size RGB frame (resized host-side to image_size); uint8 is
+    # the wire-native form, floats keep the historical 0-255 contract.
+    # Detections are host-side python lists — explicit opt-out.
+    contracts = {
+        "in:image": "u8[*,*,3] | dct8-u8[*,*,3] | f32[*,*,3]",
+        "out:boxes": "any", "out:scores": "any", "out:classes": "any",
+    }
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._program = f"detect.{self.definition.name}"

@@ -20,6 +20,8 @@ __all__ = [
 class PE_ImageReadFile(PipelineElement):
     """pathname (parameter or swag) → image [H, W, 3] uint8."""
 
+    contracts = {"out:image": "u8[*,*,3]"}
+
     def process_frame(self, frame: Frame, pathname=None, **_) -> FrameOutput:
         import numpy as np
         from PIL import Image
@@ -49,6 +51,10 @@ class PE_ImageWriteFile(PipelineElement):
 
 
 class PE_ImageResize(PipelineElement):
+
+    contracts = {"in:image": "u8[*,*,3] | f32[*,*,3]",
+                 "out:image": "u8[*,*,3]"}
+
     def process_frame(self, frame: Frame, image=None, **_) -> FrameOutput:
         import numpy as np
         from PIL import Image
@@ -105,6 +111,13 @@ class PE_ImageClassify(PipelineElement):
 
     Parameters: preset (resnet18/resnet34), image_size, mode
     ("batched"|"sync"), max_batch, max_wait, compute (service name)."""
+
+    # any-size RGB frame (resized host-side); outputs are python
+    # scalars (int class id, float confidence) — explicit opt-out
+    contracts = {
+        "in:image": "u8[*,*,3] | f32[*,*,3]",
+        "out:class_id": "any", "out:confidence": "any",
+    }
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

@@ -20,6 +20,8 @@ class PE_VideoReadFile(PipelineElement):
     """Source: decodes a video file, one frame per timer tick at the
     requested rate (reference: video_io.py VideoReadFile)."""
 
+    contracts = {"out:image": "u8[*,*,3]"}
+
     def start_stream(self, stream) -> None:
         import cv2
 

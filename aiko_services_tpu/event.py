@@ -33,7 +33,7 @@ __all__ = [
     "add_mailbox_handler", "remove_mailbox_handler", "mailbox_put",
     "add_queue_handler", "remove_queue_handler", "queue_put",
     "add_flatout_handler", "remove_flatout_handler",
-    "loop", "step", "terminate",
+    "loop", "step", "terminate", "settle_virtual",
 ]
 
 _TICK = 0.01    # idle sleep when nothing is due (reference: 10ms tick)
@@ -403,6 +403,18 @@ def add_flatout_handler(handler):
 
 def remove_flatout_handler(handler):
     default_engine.remove_flatout_handler(handler)
+
+
+def settle_virtual(engine, seconds, tick=0.05):
+    """Advance a VirtualClock engine by `seconds`, stepping the engine
+    dry each tick — the one canonical drive loop for timed
+    multi-runtime scenarios (tests and the chaos soak runner)."""
+    for _ in range(int(seconds / tick)):
+        while engine.step():
+            pass
+        engine.clock.advance(tick)
+    while engine.step():
+        pass
 
 
 def loop(loop_when_no_handlers=False):

@@ -50,8 +50,21 @@ class Lease:
             self.lease_time = lease_time
         self._schedule()
 
-    def terminate(self) -> None:
+    def cancel(self) -> None:
+        """Retire the lease NOW: the timer is removed and neither the
+        expired nor the extend handler will ever fire again.  Every code
+        path that stops caring about a lease (reply arrived, stream
+        destroyed, proxy re-resolved) must call this — an uncancelled
+        timer on a dead hop fires an expired handler into state that no
+        longer exists."""
         self.expired = True
         if self._timer is not None:
             self.event.remove_timer_handler(self._timer)
             self._timer = None
+
+    # historical name; cancel() is the explicit spelling
+    terminate = cancel
+
+    @property
+    def active(self) -> bool:
+        return not self.expired

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from .actor import Actor
 from .lease import Lease
 from .process import STATE_ABSENT
+from .process_manager import RestartPolicy, RestartWindow
 from .service import ServiceProtocol, ServiceTopicPath
 from .share import ECConsumer
 from .utils import get_logger, parse
@@ -70,13 +71,23 @@ class LifeCycleManager(Actor):
 
     def __init__(self, runtime, name: str, spawner, terminator=None,
                  client_change_handler=None,
-                 handshake_lease_time: float = _HANDSHAKE_LEASE):
+                 handshake_lease_time: float = _HANDSHAKE_LEASE,
+                 restart_policy: RestartPolicy | None = None):
         super().__init__(runtime, name, PROTOCOL_LIFECYCLE_MANAGER)
         self.logger = get_logger(f"lifecycle_manager.{name}")
         self.spawner = spawner
         self.terminator = terminator
         self.client_change_handler = client_change_handler
         self.handshake_lease_time = handshake_lease_time
+        # restart_policy supervises the FLEET: a client that dies (LWT)
+        # is replaced under backoff; too many deaths inside the policy
+        # window is a crash loop and replacement stops (ISSUE 4)
+        self.restart_policy = restart_policy
+        self.crash_looping = False
+        self._restart_window = RestartWindow(restart_policy) \
+            if restart_policy else None
+        self._restart_timers: set[int] = set()
+        self.restart_stats = {"respawns": 0, "deaths": 0}
         self.clients: dict[str, _ClientRecord] = {}
         self._handles: dict[str, object] = {}
         self._counter = 0
@@ -152,11 +163,51 @@ class LifeCycleManager(Actor):
     def _client_state_handler(self, topic, payload) -> None:
         if not is_absent(payload):
             return
+        died = 0
         for client_id, record in list(self.clients.items()):
             if record.state_topic == topic:
                 self.logger.warning("client %s died (LWT on %s)",
                                     client_id, topic)
+                died += 1
                 self.delete_client(client_id)
+        for _ in range(died):
+            self._client_died()
+
+    # -- supervised replacement (ISSUE 4) -----------------------------------
+    def _client_died(self) -> None:
+        if self._restart_window is None or self.crash_looping:
+            return
+        self.restart_stats["deaths"] += 1
+        delay = self._restart_window.record(
+            self.runtime.event.clock.now())
+        if delay is None:
+            self.crash_looping = True
+            self.logger.error(
+                "lifecycle %s: client crash loop (%d deaths in %.1fs); "
+                "no further replacements", self.name,
+                len(self._restart_window.events),
+                self.restart_policy.window)
+            if self.client_change_handler:
+                self.client_change_handler("crash_loop", "", None)
+            return
+        self.logger.warning(
+            "lifecycle %s: replacing dead client in %.2fs "
+            "(death %d/%d in window)", self.name, delay,
+            len(self._restart_window.events),
+            self.restart_policy.max_restarts)
+        handle_box = []
+
+        def respawn():
+            self._restart_timers.discard(handle_box[0])
+            if not self.crash_looping:
+                self.restart_stats["respawns"] += 1
+                self.create_clients(1)
+
+        # each death queues exactly one replacement; every pending
+        # handle is tracked so stop() cancels them all
+        handle_box.append(
+            self.runtime.event.add_oneshot_handler(respawn, delay))
+        self._restart_timers.add(handle_box[0])
 
     def _unwatch_state(self, topic: str, client_id: str) -> None:
         watchers = self._state_watch.get(topic)
@@ -204,6 +255,9 @@ class LifeCycleManager(Actor):
         self.ec_producer.update("client_count", len(self.clients))
 
     def stop(self) -> None:
+        for handle in self._restart_timers:
+            self.runtime.event.remove_timer_handler(handle)
+        self._restart_timers.clear()
         for record in self.clients.values():
             if record.lease:
                 record.lease.terminate()

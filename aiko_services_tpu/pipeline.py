@@ -36,7 +36,9 @@ from __future__ import annotations
 
 import itertools
 import json
+import random
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -45,7 +47,10 @@ from .lease import Lease
 from .service import ServiceFilter, ServiceProtocol
 from .share import ServicesCache
 from .transport import wire
-from .utils import Graph, GraphError, get_logger, load_class, load_module
+from .utils import (
+    Graph, GraphError, get_logger, jittered_backoff, load_class,
+    load_module,
+)
 
 __all__ = [
     "PROTOCOL_PIPELINE", "PipelineDefinition", "PipelineElementDefinition",
@@ -327,6 +332,9 @@ class Stream:
     state: str = "run"              # run | stop
     lease: Lease | None = None
     variables: dict = field(default_factory=dict)   # element scratch space
+    consecutive_failures: int = 0   # frame failures since the last success
+    last_diagnostic: str = ""       # why the most recent frame failed
+    parked: list = field(default_factory=list)      # DEFERRED frames
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_id
@@ -334,7 +342,7 @@ class Stream:
         return frame_id
 
 
-@dataclass
+@dataclass(eq=False)        # identity semantics: Stream.parked removal
 class Frame:
     """One unit of work: stream context + named values ("swag")."""
     stream: Stream
@@ -460,12 +468,18 @@ class _RemoteElementPlaceholder:
     Also holds the hop's coalescing state: frames bound for this
     destination buffer here and flush as ONE envelope when the consumer
     is behind (outstanding replies > 0), amortizing per-message wire
-    overhead across the burst."""
+    overhead across the burst.
+
+    `candidates` keeps EVERY currently-discovered matching service (in
+    discovery order), not just the active one: when the active proxy
+    leaves — or a hop times out against it — the pipeline fails over to
+    the next candidate instead of erroring frames."""
 
     def __init__(self, definition: PipelineElementDefinition):
         self.definition = definition
         self.proxy = None
         self.topic_path = None
+        self.candidates: dict[str, bool] = {}   # topic_path -> True
         self.buffer: list = []          # (entry, one_way) pending sends
         self.outstanding = 0            # request/response hops in flight
         self.flush_scheduled = False
@@ -473,6 +487,54 @@ class _RemoteElementPlaceholder:
     @property
     def found(self) -> bool:
         return self.proxy is not None
+
+
+@dataclass
+class _PendingHop:
+    """One outstanding request/response remote hop.  The single source
+    of truth for everything the recovery machinery needs: the frame to
+    resume, retry budget spent, whether a request copy is currently in
+    flight, and the timers (timeout lease + scheduled resend) that MUST
+    be cancelled on every exit path — reply, expiry, failover redirect,
+    stream destruction — so dead hops never fire expired handlers."""
+    frame: Frame
+    node_name: str
+    inputs: dict
+    lease: Lease | None = None
+    attempts: int = 0               # retries consumed
+    sent: bool = False              # a request copy is in flight
+    sent_to: str | None = None      # candidate the last copy shipped to
+    resend_timer: int | None = None
+
+    def cancel(self, engine) -> None:
+        if self.lease is not None:
+            self.lease.cancel()
+            self.lease = None
+        if self.resend_timer is not None:
+            engine.remove_timer_handler(self.resend_timer)
+            self.resend_timer = None
+
+
+_RETIRED_HOP_CAP = 2048     # recently settled hop ids (reply dedup)
+_SERVED_HOP_CAP = 1024      # serving-side request dedup + reply replay
+_SERVED_REPLY_CACHE_BYTES = 1 << 18   # replies above this aren't cached
+_SERVED_REPLY_BUDGET_BYTES = 8 << 20  # aggregate pin across ALL entries
+
+
+def _payload_nbytes(value) -> int:
+    """Tensor/bytes weight of a reply payload (nested containers
+    included) — the replay cache must not pin up to _SERVED_HOP_CAP
+    full-size image replies in memory."""
+    import numpy as np
+    if isinstance(value, np.ndarray):
+        return value.nbytes
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    if isinstance(value, dict):
+        return sum(_payload_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(_payload_nbytes(v) for v in value)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -497,7 +559,13 @@ class Pipeline(PipelineElement):
                  auto_create_streams: bool = False,
                  remote_timeout: float = 30.0,
                  coalesce_frames: int = 16,
-                 remote_wire_codecs: dict | None = None):
+                 remote_wire_codecs: dict | None = None,
+                 remote_retries: int = 0,
+                 remote_backoff: float = 0.25,
+                 remote_backoff_max: float = 4.0,
+                 retry_jitter: float = 0.25,
+                 retry_seed: int | None = None,
+                 stream_failure_budget: int = 1):
         self._element_classes = element_classes or {}
         self.graph = PipelineGraph.from_definition(definition)
         self.graph.validate(definition)
@@ -523,8 +591,40 @@ class Pipeline(PipelineElement):
         # outstanding request/response remote hops: hop_id → (frame,
         # node_name, timeout lease)
         self.remote_timeout = remote_timeout
-        self._pending_remote: dict = {}
+        self._pending_remote: dict[str, _PendingHop] = {}
         self._hop_counter = itertools.count(1)
+        # incarnation nonce: hop ids must not collide across pipeline
+        # rebuilds that reuse the same reply topic (embedded runtime
+        # re-creation, OS pid reuse), or the serving dedup ring would
+        # answer a NEW caller's hop 'name.1' with a replay of the OLD
+        # incarnation's cached reply
+        self._hop_nonce = uuid.uuid4().hex[:8]
+        # -- failure recovery (ISSUE 4) ----------------------------------
+        # remote_retries > 0 turns the recovery machinery ON: hop
+        # timeouts retry with exponential backoff + seeded jitter,
+        # candidate rotation tries OTHER discovered services, absent
+        # placeholders buffer frames until discovery re-resolves, and
+        # proxy loss redirects in-flight hops to the replacement.  The
+        # default (0) keeps the legacy fail-fast semantics.
+        self.remote_retries = max(0, int(remote_retries))
+        self.remote_backoff = float(remote_backoff)
+        self.remote_backoff_max = float(remote_backoff_max)
+        self.retry_jitter = float(retry_jitter)
+        # retry_seed=None spreads the jitter for real (a fleet of
+        # pipelines must not retry in lockstep); seed it for tests
+        self._retry_rng = random.Random(retry_seed)
+        # stream_failure_budget consecutive frame failures stop a stream
+        # (1 = legacy: first failure destroys it)
+        self.stream_failure_budget = max(1, int(stream_failure_budget))
+        self.recovery_stats = {
+            "retries": 0, "failovers": 0, "dup_replies": 0,
+            "dup_requests": 0, "replayed_replies": 0,
+            "frames_failed": 0, "streams_stopped": 0,
+            "one_way_shed": 0,
+        }
+        self._retired_hops: dict[str, bool] = {}    # reply dedup ring
+        self._served_hops: dict = {}    # (reply_topic, hop_id) -> reply
+        self._served_reply_bytes = 0    # aggregate pinned reply payload
         # remote-hop wire tuning: coalesce_frames bounds how many frames
         # one envelope may carry (1 disables); codec hints opt named
         # swag keys into lossy wire codecs (transport/wire.py)
@@ -586,9 +686,15 @@ class Pipeline(PipelineElement):
                     rename[dst] = src
             self._renames[node.name] = rename
 
+    @property
+    def _recovery_enabled(self) -> bool:
+        return self.remote_retries > 0
+
     def _watch_remote(self, node_name: str, element_def) -> None:
         """Swap the placeholder for a live proxy when the remote pipeline
-        service appears (reference: pipeline.py:591-620)."""
+        service appears (reference: pipeline.py:591-620).  Every matching
+        service is tracked as a candidate; losing the active one fails
+        over to the next instead of going absent."""
         if self._services_cache is None:
             return
         raw = element_def.deploy["remote"]["service_filter"]
@@ -597,19 +703,48 @@ class Pipeline(PipelineElement):
 
         def handler(command, fields):
             placeholder = self._remote[node_name]
-            if command == "add" and not placeholder.found:
-                placeholder.topic_path = fields.topic_path
-                placeholder.proxy = get_remote_proxy(
-                    self.runtime, f"{fields.topic_path}/in", Pipeline,
-                    codec_hints=self._remote_wire_codecs)
-                self.logger.info("pipeline %s: remote element %s found at %s",
-                                 self.name, node_name, fields.topic_path)
-            elif command == "remove" and \
-                    placeholder.topic_path == fields.topic_path:
-                placeholder.proxy = None
-                placeholder.topic_path = None
+            if command == "add":
+                placeholder.candidates[fields.topic_path] = True
+                if not placeholder.found:
+                    self._activate_remote(node_name, fields.topic_path)
+            elif command == "remove":
+                placeholder.candidates.pop(fields.topic_path, None)
+                if placeholder.topic_path == fields.topic_path:
+                    placeholder.proxy = None
+                    placeholder.topic_path = None
+                    if placeholder.candidates:
+                        self._activate_remote(
+                            node_name, next(iter(placeholder.candidates)),
+                            failover=True,
+                            redirect=self._recovery_enabled)
 
         self._services_cache.add_handler(handler, service_filter)
+
+    def _activate_remote(self, node_name: str, topic_path: str,
+                         failover: bool = False,
+                         redirect: bool = False) -> None:
+        """Point a remote node at `topic_path` and, on a failover with
+        recovery enabled, redirect in-flight and buffered hops to the new
+        proxy (duplicate replies from the old one dedup on hop id)."""
+        placeholder = self._remote[node_name]
+        placeholder.topic_path = topic_path
+        placeholder.proxy = get_remote_proxy(
+            self.runtime, f"{topic_path}/in", Pipeline,
+            codec_hints=self._remote_wire_codecs)
+        if failover:
+            self.recovery_stats["failovers"] += 1
+            self.logger.warning(
+                "pipeline %s: remote element %s failed over to %s",
+                self.name, node_name, topic_path)
+        else:
+            self.logger.info("pipeline %s: remote element %s found at %s",
+                             self.name, node_name, topic_path)
+        if redirect:
+            for hop_id, pending in list(self._pending_remote.items()):
+                if pending.node_name == node_name and pending.sent:
+                    self._resend_hop(hop_id)
+        if placeholder.buffer:
+            self._flush_remote(placeholder)
 
     def remote_elements_ready(self) -> bool:
         return all(p.found for p in self._remote.values())
@@ -650,7 +785,30 @@ class Pipeline(PipelineElement):
             return
         stream.state = "stop"
         if stream.lease is not None:
-            stream.lease.terminate()
+            stream.lease.cancel()
+        # retire every remote hop the stream still has pending: cancel
+        # its timeout lease and any scheduled resend, so a dead hop can
+        # never fire an expired handler into a destroyed stream
+        for hop_id, pending in list(self._pending_remote.items()):
+            if pending.frame.stream is stream:
+                self._pending_remote.pop(hop_id, None)
+                pending.cancel(self.runtime.event)
+                self._retire_hop(hop_id)
+                self._purge_buffered_hop(pending.node_name, hop_id)
+                if pending.sent:
+                    self._hop_settled(pending.node_name)
+        # answer remote callers of frames still parked DEFERRED: without
+        # a reply the caller's serving-side dedup entry stays "in
+        # progress" forever and every retry of the hop id is skipped —
+        # the failure reply below is cached, so retries replay it
+        parked, stream.parked = stream.parked, []
+        for frame in parked:
+            if frame.reply_to is not None:
+                self._send_remote_reply(
+                    frame, False,
+                    {"diagnostic": stream.last_diagnostic
+                     or "stream destroyed while frame deferred",
+                     "stream_stopped": True})
         for node in self._topo_nodes:
             element = node.element
             if isinstance(element, PipelineElement):
@@ -717,6 +875,13 @@ class Pipeline(PipelineElement):
         batching: the element submitted work to a scheduler and calls this
         — typically via `pipeline.post("resume_frame", ...)` — when the
         batch completes)."""
+        if frame.stream.state == "stop":
+            # the stream died while the frame was parked (failure budget,
+            # lease expiry, shutdown): drop the resume quietly — a remote
+            # caller was already answered by destroy_stream
+            return FrameOutput(False, diagnostic="stream stopped")
+        if frame in frame.stream.parked:
+            frame.stream.parked.remove(frame)
         index = frame.deferred_at
         if index is None:
             return FrameOutput(False, diagnostic="frame not deferred")
@@ -751,9 +916,12 @@ class Pipeline(PipelineElement):
                                    diagnostic=f"{node.name}: missing inputs")
             element_start = time.perf_counter()
 
+            diagnostic = ""
             if isinstance(element, _RemoteElementPlaceholder):
                 ok, outputs = self._process_remote(element, frame,
                                                    inputs, node.name)
+                if not ok:
+                    diagnostic = "remote element absent"
             else:
                 try:
                     result = element.process_frame(frame, **inputs)
@@ -765,22 +933,32 @@ class Pipeline(PipelineElement):
                     return FrameOutput(False,
                                        diagnostic=f"{node.name}: {exc!r}")
                 ok, outputs = result
+                diagnostic = getattr(result, "diagnostic", "")
             if ok and outputs is DEFERRED:
-                # park the frame; the element resumes it asynchronously
+                # park the frame; the element resumes it asynchronously.
+                # The stream remembers it so destroy_stream can answer
+                # its remote caller instead of leaving the hop hanging
                 frame.deferred_at = index
                 frame.deferred_since = element_start
+                frame.stream.parked.append(frame)
                 return FrameOutput(True, DEFERRED)
             frame.metrics[f"time_{node.name}"] = \
                 time.perf_counter() - element_start
             if not ok:
-                self._fail_frame(frame, node.name, "element reported not-ok")
+                diagnostic = diagnostic or "element reported not-ok"
+                self._fail_frame(frame, node.name, diagnostic)
                 return FrameOutput(
-                    False, diagnostic=f"{node.name}: reported not-ok")
+                    False, diagnostic=f"{node.name}: {diagnostic}")
             if outputs:
                 self._merge_outputs(node, element_def, outputs, swag)
 
         frame.metrics["time_pipeline"] = \
             time.perf_counter() - frame.metrics["time_pipeline_start"]
+        if self.streams.get(frame.stream.stream_id) is frame.stream:
+            # the budget counts whole FRAMES on streams this pipeline
+            # owns: a nested element's success mid-frame must not erase
+            # the parent stream's run of frame failures
+            frame.stream.consecutive_failures = 0
         for handler in self._frame_handlers:
             handler(frame)
         if frame.reply_to is not None:
@@ -847,26 +1025,81 @@ class Pipeline(PipelineElement):
         envelope.  On text-only transports the legacy S-expression path
         applies: tensors must pass through PE_DataEncode before the
         boundary and PE_DataDecode after it (the device data plane
-        bypasses this entirely for co-located elements)."""
-        if not placeholder.found:
-            return False, None
+        bypasses this entirely for co-located elements).
+
+        With recovery enabled (remote_retries > 0) an ABSENT placeholder
+        no longer fails the frame: the hop buffers (bounded for one-way
+        sinks, lease-governed for request/response) and flushes when
+        discovery re-resolves the service."""
         element_def = self._element_defs[node_name]
         if not element_def.output:
-            self._queue_remote(placeholder,
-                               [frame.stream_id, inputs], one_way=True)
+            if placeholder.found:
+                self._queue_remote(placeholder,
+                                   [frame.stream_id, inputs], one_way=True)
+            elif self._recovery_enabled:
+                self._buffer_entry(placeholder,
+                                   [frame.stream_id, inputs], one_way=True)
+            else:
+                return False, None
             return True, {}
-        hop_id = f"{self.name}.{next(self._hop_counter)}"
-        lease = Lease(self.runtime.event, self.remote_timeout, hop_id,
-                      lease_expired_handler=self._remote_hop_expired)
+        if not placeholder.found and not self._recovery_enabled:
+            return False, None
+        hop_id = (f"{self.name}.{self._hop_nonce}"
+                  f".{next(self._hop_counter)}")
         # keep the sent inputs: the serving side elides identity
         # passthroughs from its reply (no point echoing the payload),
         # so the resume re-merges them from here when declared
-        self._pending_remote[hop_id] = (frame, node_name, lease, inputs)
-        self._queue_remote(
-            placeholder,
-            [frame.stream_id, inputs, self.topic_in, hop_id],
-            one_way=False)
+        pending = _PendingHop(frame=frame, node_name=node_name,
+                              inputs=inputs)
+        self._pending_remote[hop_id] = pending
+        self._arm_hop_lease(pending, hop_id)
+        entry = [frame.stream_id, inputs, self.topic_in, hop_id]
+        if placeholder.found:
+            self._queue_remote(placeholder, entry, one_way=False)
+        else:
+            # awaiting discovery: the lease bounds the wait
+            self._buffer_entry(placeholder, entry, one_way=False)
         return True, DEFERRED
+
+    def _arm_hop_lease(self, pending: _PendingHop, hop_id: str) -> None:
+        if pending.lease is not None:
+            pending.lease.cancel()
+        pending.lease = Lease(
+            self.runtime.event, self.remote_timeout, hop_id,
+            lease_expired_handler=self._remote_hop_expired)
+
+    def _purge_buffered_hop(self, node_name: str, hop_id: str) -> None:
+        """Drop a retired hop's still-buffered request entry — request
+        hops escape the one-way shed cap (they are lease-governed), so
+        every pop path of _pending_remote must also purge here or an
+        absent placeholder's buffer grows without bound over a long
+        outage."""
+        placeholder = self._remote.get(node_name)
+        if placeholder is None:
+            return
+        placeholder.buffer = [(e, ow) for e, ow in placeholder.buffer
+                              if ow or e[3] != hop_id]
+
+    def _buffer_entry(self, placeholder, entry, one_way: bool) -> None:
+        """Park a hop for an absent destination.  One-way (sink) entries
+        have no lease watching them, so their OWN share of the buffer is
+        bounded: past the cap the oldest one-way entry is shed (request
+        hops don't count against it — they are lease-governed)."""
+        placeholder.buffer.append((entry, one_way))
+        cap = max(4 * self.coalesce_frames, 64)
+        if one_way and sum(
+                1 for _, ow in placeholder.buffer if ow) > cap:
+            for index, (_, buffered_one_way) in \
+                    enumerate(placeholder.buffer):
+                if buffered_one_way:
+                    del placeholder.buffer[index]
+                    break
+            # shed loss must stay observable: soaks and production both
+            # read recovery_stats to account for every frame
+            self.recovery_stats["one_way_shed"] += 1
+            self.logger.debug(
+                "pipeline %s: absent remote sink over buffer cap %d; "
+                "oldest one-way frame shed", self.name, cap)
 
     # -- remote-hop coalescing ----------------------------------------------
     # Per-destination send buffer: an idle link (no outstanding replies)
@@ -909,26 +1142,42 @@ class Pipeline(PipelineElement):
 
     def _send_remote(self, entries, placeholder) -> None:
         if not placeholder.found:
-            # discovery raced away mid-buffer: fail the hops cleanly
-            # (never sent, so outstanding was never incremented)
+            if self._recovery_enabled:
+                # discovery raced away mid-buffer: hold the hops for the
+                # next candidate (request hops stay lease-governed; a
+                # stale request whose hop already retired is dropped)
+                for entry, one_way in entries:
+                    if one_way or entry[3] in self._pending_remote:
+                        self._buffer_entry(placeholder, entry, one_way)
+                return
+            # legacy fail-fast: fail the hops cleanly (never sent, so
+            # outstanding was never incremented)
             for entry, one_way in entries:
                 if not one_way:
                     pending = self._pending_remote.pop(entry[3], None)
                     if pending is not None:
-                        frame, node_name, lease, _ = pending
-                        lease.terminate()
-                        self.resume_frame(frame, node_name, RuntimeError(
-                            f"remote element {node_name} left before "
-                            f"send"))
+                        pending.cancel(self.runtime.event)
+                        self._retire_hop(entry[3])
+                        self.resume_frame(
+                            pending.frame, pending.node_name, RuntimeError(
+                                f"remote element {pending.node_name} left "
+                                f"before send"))
             return
         one_way = [entry for entry, ow in entries if ow]
-        request = [entry for entry, ow in entries if not ow]
+        # a request whose hop already settled (reply raced the resend,
+        # stream destroyed) must not ship again
+        request = [entry for entry, ow in entries
+                   if not ow and entry[3] in self._pending_remote]
         if one_way:
             if len(one_way) == 1:
                 placeholder.proxy.process_frame(*one_way[0])
             else:
                 placeholder.proxy.process_frames(one_way)
         if request:
+            for entry in request:
+                hop = self._pending_remote[entry[3]]
+                hop.sent = True
+                hop.sent_to = placeholder.topic_path
             placeholder.outstanding += len(request)
             if len(request) == 1:
                 placeholder.proxy.process_frame_remote(*request[0])
@@ -946,34 +1195,131 @@ class Pipeline(PipelineElement):
             self._flush_remote(placeholder)
 
     def _remote_hop_expired(self, hop_id) -> None:
-        pending = self._pending_remote.pop(str(hop_id), None)
+        hop_id = str(hop_id)
+        pending = self._pending_remote.get(hop_id)
         if pending is None:
             return
-        frame, node_name, _lease, _inputs = pending
-        self._hop_settled(node_name)
-        self.resume_frame(frame, node_name, TimeoutError(
-            f"remote element {node_name}: no reply within "
-            f"{self.remote_timeout}s"))
+        pending.lease = None            # the oneshot just fired
+        if pending.sent:
+            pending.sent = False
+            self._hop_settled(pending.node_name)
+        if pending.attempts < self.remote_retries:
+            # bounded retry: exponential backoff + seeded jitter, and
+            # rotate to another discovered candidate first — a timeout
+            # against a wedged service recovers via its peer
+            pending.attempts += 1
+            self.recovery_stats["retries"] += 1
+            delay = jittered_backoff(
+                self.remote_backoff, pending.attempts,
+                self.remote_backoff_max, self.retry_jitter,
+                self._retry_rng)
+            placeholder = self._remote.get(pending.node_name)
+            if placeholder is None or pending.sent_to is None \
+                    or pending.sent_to == placeholder.topic_path:
+                # rotate only while the active candidate is still the
+                # one that timed this hop out: a burst of simultaneous
+                # expiries must advance ONCE, not once per expired hop
+                # (an even burst would land back on the dead candidate)
+                self._rotate_candidate(pending.node_name)
+            pending.resend_timer = self.runtime.event.add_oneshot_handler(
+                lambda: self._resend_hop(hop_id), delay)
+            return
+        self._pending_remote.pop(hop_id, None)
+        self._retire_hop(hop_id)
+        self._purge_buffered_hop(pending.node_name, hop_id)
+        detail = f" after {pending.attempts} retries" \
+            if pending.attempts else ""
+        self.resume_frame(pending.frame, pending.node_name, TimeoutError(
+            f"remote element {pending.node_name}: no reply within "
+            f"{self.remote_timeout}s{detail}"))
+
+    def _rotate_candidate(self, node_name: str) -> None:
+        """Advance a remote node to its next discovered candidate (no-op
+        with fewer than two)."""
+        placeholder = self._remote.get(node_name)
+        if placeholder is None or len(placeholder.candidates) < 2:
+            return
+        order = list(placeholder.candidates)
+        try:
+            index = order.index(placeholder.topic_path)
+        except ValueError:
+            index = -1
+        next_topic = order[(index + 1) % len(order)]
+        if next_topic != placeholder.topic_path:
+            self._activate_remote(node_name, next_topic, failover=True)
+
+    def _resend_hop(self, hop_id: str) -> None:
+        """Re-ship a pending hop (retry after timeout, or redirect after
+        failover) under a fresh timeout lease, with the SAME hop id so
+        duplicate replies dedup instead of double-resuming the frame."""
+        hop_id = str(hop_id)
+        pending = self._pending_remote.get(hop_id)
+        if pending is None:
+            return
+        pending.resend_timer = None
+        if pending.frame.stream.state == "stop":
+            self._pending_remote.pop(hop_id, None)
+            pending.cancel(self.runtime.event)
+            self._retire_hop(hop_id)
+            self._purge_buffered_hop(pending.node_name, hop_id)
+            return
+        placeholder = self._remote.get(pending.node_name)
+        if placeholder is None:
+            return
+        self._arm_hop_lease(pending, hop_id)
+        # drop any still-buffered copy of this hop before re-queueing
+        self._purge_buffered_hop(pending.node_name, hop_id)
+        entry = [pending.frame.stream_id, pending.inputs, self.topic_in,
+                 hop_id]
+        if pending.sent:
+            # the in-flight copy is being superseded; release its slot
+            pending.sent = False
+            placeholder.outstanding = max(0, placeholder.outstanding - 1)
+        if placeholder.found:
+            self._send_remote([(entry, False)], placeholder)
+        else:
+            self._buffer_entry(placeholder, entry, one_way=False)
+
+    def _retire_hop(self, hop_id: str) -> None:
+        """Remember a settled hop id so a late duplicate reply is
+        recognized as such (bounded ring)."""
+        self._retired_hops[str(hop_id)] = True
+        while len(self._retired_hops) > _RETIRED_HOP_CAP:
+            self._retired_hops.pop(next(iter(self._retired_hops)))
 
     def resume_remote_frame(self, hop_id, ok, outputs=None, elided=None):
         """Reply entry (invoked over the wire by the serving pipeline).
         `elided` names identity-passthrough outputs the serving side
         did not echo: they are restored from the inputs this hop sent —
-        only those, so a genuinely dropped output still fails loudly."""
-        pending = self._pending_remote.pop(str(hop_id), None)
+        only those, so a genuinely dropped output still fails loudly.
+
+        Duplicate replies (retried requests, failover redirects, chaos
+        duplication) dedup here: the first reply pops the pending hop,
+        later ones find it retired and are counted, not warned."""
+        hop_id = str(hop_id)
+        pending = self._pending_remote.pop(hop_id, None)
         if pending is None:
-            self.logger.warning("pipeline %s: stale remote reply %r",
-                                self.name, hop_id)
+            if hop_id in self._retired_hops:
+                self.recovery_stats["dup_replies"] += 1
+                self.logger.debug("pipeline %s: duplicate reply for "
+                                  "settled hop %s", self.name, hop_id)
+            else:
+                self.logger.warning("pipeline %s: stale remote reply %r",
+                                    self.name, hop_id)
             return
-        frame, node_name, lease, sent_inputs = pending
-        lease.terminate()
-        self._hop_settled(node_name)
+        frame, node_name = pending.frame, pending.node_name
+        was_sent = pending.sent
+        pending.cancel(self.runtime.event)
+        self._purge_buffered_hop(node_name, hop_id)
+        self._retire_hop(hop_id)
+        if was_sent:
+            self._hop_settled(node_name)
         if str(ok) not in ("true", "True"):
             self.resume_frame(frame, node_name, RuntimeError(
                 f"remote element {node_name} failed: {outputs!r}"))
             return
         outputs = dict(outputs or {})
-        sent_inputs = sent_inputs or {}
+        sent_inputs = pending.inputs or {}
         for key in elided or []:
             if key in sent_inputs:
                 outputs.setdefault(key, sent_inputs[key])
@@ -988,11 +1334,92 @@ class Pipeline(PipelineElement):
     def process_frame_remote(self, stream_id, inputs, reply_topic, hop_id):
         """Serving entry: walk a frame for a remote caller and reply with
         the final swag when it completes (including through DEFERRED
-        elements)."""
+        elements).
+
+        At-least-once callers (retries, chaos duplication) may deliver
+        the same hop twice: the first request walks, a duplicate while
+        the walk is still running is skipped (its reply goes out when
+        the walk completes), and a duplicate of a COMPLETED hop replays
+        the cached reply — the original may have been lost on the wire."""
+        key = (str(reply_topic), str(hop_id))
+        if key in self._served_hops:
+            self.recovery_stats["dup_requests"] += 1
+            cached = self._served_hops[key]
+            if cached is not None:
+                self._replay_reply(cached)
+            return
+        self._served_hops[key] = None       # walk in progress
+        while len(self._served_hops) > _SERVED_HOP_CAP:
+            # evict oldest COMPLETED entry: an in-progress (None) entry
+            # dropped here would let a retry re-walk a side-effecting
+            # frame and orphan the eventual reply caching
+            stale = next((k for k, v in self._served_hops.items()
+                          if v is not None), None)
+            if stale is None:
+                break
+            self._served_reply_bytes -= self._served_hops.pop(stale)[3]
         inputs = dict(inputs or {})
-        self.process_frame(stream_id, inputs,
-                           _reply_to=(str(reply_topic), str(hop_id)),
-                           _reply_skip=inputs)
+        try:
+            result = self.process_frame(stream_id, inputs,
+                                        _reply_to=(str(reply_topic),
+                                                   str(hop_id)),
+                                        _reply_skip=inputs)
+        except Exception as exc:
+            self._shim_failure_reply(key, stream_id, repr(exc))
+            raise
+        if not result.ok:
+            self._shim_failure_reply(key, stream_id, result.diagnostic)
+
+    def _shim_failure_reply(self, key, stream_id, diagnostic) -> None:
+        """Answer a remote request whose walk died before any frame
+        could carry the reply address (unknown stream with auto-create
+        off, start_stream raised): the reply is cached in the dedup
+        ring, so the caller's retries replay this failure instead of
+        being skipped as duplicates of a hop that will never complete."""
+        if self._served_hops.get(key, True) is not None:
+            return
+        shim = Frame(stream=Stream(stream_id=str(stream_id),
+                                   state="stop"),
+                     frame_id=-1, reply_to=key)
+        self._send_remote_reply(shim, False, {"diagnostic": diagnostic})
+
+    def _cache_served_reply(self, key, kind, topic, data) -> None:
+        """Pin a completed reply for duplicate replay, under an
+        AGGREGATE byte budget as well as the per-entry size cap: when
+        the total pinned payload would exceed
+        _SERVED_REPLY_BUDGET_BYTES, the oldest cached replies are
+        demoted to 'uncached' (still dedup-recognized as completed,
+        just no longer replayable) — 1024 entries of just-under-cap
+        image replies must not pin a quarter gigabyte."""
+        nbytes = _payload_nbytes(data)
+        self._served_hops[key] = (kind, topic, data, nbytes)
+        self._served_reply_bytes += nbytes
+        while self._served_reply_bytes > _SERVED_REPLY_BUDGET_BYTES:
+            stale = next((k for k, v in self._served_hops.items()
+                          if v is not None and v[3] and k != key), None)
+            if stale is None:
+                break
+            _, stale_topic, _, stale_nbytes = self._served_hops[stale]
+            self._served_hops[stale] = ("uncached", stale_topic, None, 0)
+            self._served_reply_bytes -= stale_nbytes
+
+    def _replay_reply(self, cached) -> None:
+        """Re-send a cached reply for a duplicate of a completed hop."""
+        kind, topic, data, _ = cached
+        if kind == "uncached":
+            self.logger.warning(
+                "pipeline %s: duplicate of a completed hop whose reply "
+                "was too large to cache; not replayed", self.name)
+            return
+        self.recovery_stats["replayed_replies"] += 1
+        if kind == "bin":
+            self._reply_buffer.setdefault(topic, []).append(data)
+            if not self._reply_flush_scheduled:
+                self._reply_flush_scheduled = True
+                self.runtime.event.add_oneshot_handler(
+                    self._flush_replies, 0.0)
+        else:
+            self.runtime.publish(topic, data)
 
     def process_frames(self, entries):
         """Coalesced one-way entry: one envelope, many (stream_id,
@@ -1013,9 +1440,29 @@ class Pipeline(PipelineElement):
         self.logger.error("pipeline %s stream %s frame %s: element %s "
                           "failed: %s", self.name, frame.stream_id,
                           frame.frame_id, node_name, diagnostic)
+        self.recovery_stats["frames_failed"] += 1
+        stream = frame.stream
+        stream.last_diagnostic = f"{node_name}: {diagnostic}"
+        if self.streams.get(stream.stream_id) is not stream:
+            # nested as an element on the PARENT's stream: the parent
+            # charges its own failure budget when our not-ok output
+            # propagates — charging here too would double-count every
+            # failure, and destroy_stream below could kill an unrelated
+            # same-id stream this pipeline happens to own
+            return
+        stream.consecutive_failures += 1
+        over_budget = \
+            stream.consecutive_failures >= self.stream_failure_budget
         if frame.reply_to is not None:
             self._send_remote_reply(frame, False,
-                                    {"diagnostic": str(diagnostic)})
+                                    {"diagnostic": str(diagnostic),
+                                     "stream_stopped": over_budget})
+        if not over_budget:
+            # inside the per-stream failure budget: the frame is lost but
+            # the stream survives — a transient remote fault must not
+            # tear down a long-lived stream and leak its consumers
+            return
+        self.recovery_stats["streams_stopped"] += 1
         self.destroy_stream(frame.stream_id)
 
     def _send_remote_reply(self, frame, ok: bool, outputs: dict) -> None:
@@ -1035,6 +1482,7 @@ class Pipeline(PipelineElement):
                       and isinstance(v, (_np.ndarray, bytes))]
             outputs = {k: v for k, v in outputs.items()
                        if k not in elided}
+        key = (topic, str(hop_id))
         if wire.supports_binary(self.runtime.message):
             # binary envelope reply: tensors cross back out-of-band
             # (zero text round-trip); replies to one caller coalesce
@@ -1043,8 +1491,16 @@ class Pipeline(PipelineElement):
                        if isinstance(v, (str, int, float, bool, bytes,
                                          list, tuple, dict))
                        or wire.contains_binary(v)}
-            self._reply_buffer.setdefault(topic, []).append(
-                [hop_id, bool(ok), payload, elided])
+            entry = [hop_id, bool(ok), payload, elided]
+            if key in self._served_hops:
+                if _payload_nbytes(payload) <= _SERVED_REPLY_CACHE_BYTES:
+                    self._cache_served_reply(key, "bin", topic, entry)
+                else:
+                    # completed, but too heavy to pin for replay: a
+                    # duplicate request is still recognized (never
+                    # re-walked), it just can't be answered again
+                    self._served_hops[key] = ("uncached", topic, None, 0)
+            self._reply_buffer.setdefault(topic, []).append(entry)
             if not self._reply_flush_scheduled:
                 self._reply_flush_scheduled = True
                 self.runtime.event.add_oneshot_handler(
@@ -1055,8 +1511,10 @@ class Pipeline(PipelineElement):
         # tensors must be PE_DataEncode'd (to str) by the serving graph
         safe = {k: v for k, v in outputs.items()
                 if isinstance(v, (str, int, float, bool))}
-        self.runtime.publish(topic, generate(
-            "resume_remote_frame", [hop_id, ok, safe, elided]))
+        text = generate("resume_remote_frame", [hop_id, ok, safe, elided])
+        if key in self._served_hops:
+            self._cache_served_reply(key, "text", topic, text)
+        self.runtime.publish(topic, text)
 
     def _flush_replies(self) -> None:
         self._reply_flush_scheduled = False
@@ -1073,6 +1531,12 @@ class Pipeline(PipelineElement):
     def stop(self) -> None:
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
+        # any hop that survived stream teardown (e.g. nested frames on
+        # foreign streams) still holds timers: cancel them all
+        for hop_id, pending in list(self._pending_remote.items()):
+            pending.cancel(self.runtime.event)
+            self._retire_hop(hop_id)
+        self._pending_remote.clear()
         for node in self.graph.nodes():
             element = node.element
             if isinstance(element, PipelineElement) and element is not self:

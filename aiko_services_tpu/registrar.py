@@ -52,6 +52,7 @@ class Registrar(Service):
         self.services = Services()
         self.history: deque[ServiceFields] = deque(maxlen=_HISTORY_LIMIT)
         self._search_timer = None
+        self._primary_topic_path: str | None = None   # whom we stand by for
         self.state_machine = StateMachine(
             self, _STATES, _TRANSITIONS, initial="start")
 
@@ -120,6 +121,8 @@ class Registrar(Service):
             primary_topic = params[1] if len(params) > 1 else None
             if primary_topic == self.topic_path:
                 return      # our own announcement
+            if primary_topic:
+                self._primary_topic_path = primary_topic
             if self.state_machine.state == "primary_search":
                 self.state_machine.transition("primary_found")
             elif self.state_machine.state == "primary":
@@ -195,13 +198,30 @@ class Registrar(Service):
 
     # -- process liveness --------------------------------------------------
     def _state_handler(self, topic, payload) -> None:
-        if not self.is_primary:
-            return
         try:
             command, _ = parse(payload) if payload else ("", [])
         except Exception:
             return
         if command != "absent":
+            return
+        if self.state_machine.state == "secondary":
+            # Failover hardening (ISSUE 4): the boot-topic "(primary
+            # absent)" LWT is ONE message on a lossy transport — if it is
+            # dropped, a secondary that only listened there stands by
+            # forever.  The primary's process-state LWT ("(absent)",
+            # RETAINED on its state topic) is an independent death
+            # signal carried by the same wildcard subscription, so a
+            # secondary promotes on either.
+            primary = self._primary_topic_path
+            parsed = ServiceTopicPath.parse(primary) if primary else None
+            if parsed is not None and \
+                    topic == f"{parsed.process_path}/0/state":
+                self.logger.warning(
+                    "registrar %s: primary %s process died (state LWT); "
+                    "starting promotion", self.topic_path, primary)
+                self.state_machine.transition("primary_absent")
+            return
+        if not self.is_primary:
             return
         topic_path = ServiceTopicPath.parse(topic.rsplit("/", 1)[0])
         if topic_path is None:

@@ -23,12 +23,13 @@
 
 from __future__ import annotations
 
+import random
 import threading
 from collections import deque
 
 from .message import Message
 from .wire import is_envelope
-from ..utils import get_logger
+from ..utils import get_logger, jittered_backoff
 
 __all__ = ["MQTT_AVAILABLE", "MQTTMessage"]
 
@@ -41,6 +42,9 @@ except ImportError:        # pragma: no cover - environment without paho
 
 _BACKOFF_MIN = 0.5         # seconds; doubles per failed attempt
 _BACKOFF_MAX = 30.0
+_BACKOFF_JITTER = 0.25     # fraction of the delay added, seeded rng —
+                           # a broker restart must not get every client
+                           # redialing on the same doubling schedule
 _BUFFER_LIMIT = 1024       # publishes held while disconnected
 
 logger = get_logger("transport.mqtt")
@@ -78,11 +82,16 @@ class MQTTMessage(Message):
                  tls=False, lwt_topic=None, lwt_payload=None,
                  lwt_retain=False, client_factory=None,
                  backoff_min=_BACKOFF_MIN, backoff_max=_BACKOFF_MAX,
+                 backoff_jitter=_BACKOFF_JITTER, jitter_seed=None,
                  buffer_limit=_BUFFER_LIMIT):
         super().__init__(on_message, subscriptions)
         self.host, self.port = host, port
         self.backoff_min, self.backoff_max = backoff_min, backoff_max
-        self._backoff = backoff_min
+        self.backoff_jitter = backoff_jitter
+        # seeded so tests reproduce the exact delay sequence; None keeps
+        # production spread (urandom-seeded)
+        self._jitter_rng = random.Random(jitter_seed)
+        self._attempts = 0          # consecutive reconnect attempts
         self._connected_event = threading.Event()
         self._closing = False
         self._lock = threading.RLock()
@@ -126,7 +135,7 @@ class MQTTMessage(Message):
         # session state cannot be assumed (clean-session default)
         for topic in tuple(self.subscriptions):
             client.subscribe(topic)
-        self._backoff = self.backoff_min
+        self._attempts = 0
         # drain the buffer BEFORE announcing connected: a concurrent
         # publish() seeing connected()=True must not overtake buffered
         # messages (retained last-write-wins topics would invert state)
@@ -156,8 +165,13 @@ class MQTTMessage(Message):
             if self._closing or (self._reconnect_timer is not None
                                  and self._reconnect_timer.is_alive()):
                 return
-            delay = self._backoff
-            self._backoff = min(self._backoff * 2, self.backoff_max)
+            # jittered exponential backoff (shared formula, utils/
+            # backoff.py) so a fleet of clients fans out instead of
+            # stampeding the broker together
+            self._attempts += 1
+            delay = jittered_backoff(
+                self.backoff_min, self._attempts, self.backoff_max,
+                self.backoff_jitter, self._jitter_rng)
             timer = threading.Timer(delay, self._attempt_reconnect)
             timer.daemon = True
             self._reconnect_timer = timer
@@ -178,8 +192,10 @@ class MQTTMessage(Message):
             except Exception as exc:
                 self.stats["last_error"] = repr(exc)
                 logger.warning("MQTT reconnect to %s:%s failed (%r); "
-                               "retrying in %.1fs",
-                               self.host, self.port, exc, self._backoff)
+                               "retrying in ~%.1fs",
+                               self.host, self.port, exc,
+                               min(self.backoff_min * (2 ** self._attempts),
+                                   self.backoff_max))
                 self._schedule_reconnect()    # next try, doubled backoff
 
     def _flush_pending(self) -> None:

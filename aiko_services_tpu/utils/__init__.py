@@ -3,6 +3,7 @@ from .sexpr import (                                        # noqa: F401
     parse_int, parse_float, parse_number, parse_bool,
     list_to_dict, dict_to_list,
 )
+from .backoff import jittered_backoff                       # noqa: F401
 from .graph import Graph, Node, GraphError                  # noqa: F401
 from .configuration import (                                # noqa: F401
     get_namespace, get_hostname, get_pid, get_username, pid_verified,

@@ -1,0 +1,18 @@
+# Jittered exponential backoff, shared by every retry site in the tree
+# (pipeline remote-hop retry, ProcessManager/LifeCycleManager restart
+# policies, MQTT reconnect).  One formula, one place: base doubles per
+# attempt, capped, then stretched by up to `jitter` fraction so a fleet
+# of retriers fans out instead of stampeding in lockstep.
+
+from __future__ import annotations
+
+import random
+
+__all__ = ["jittered_backoff"]
+
+
+def jittered_backoff(base: float, attempt: int, cap: float,
+                     jitter: float, rng: random.Random) -> float:
+    """Delay before the attempt-th retry (attempt >= 1)."""
+    delay = min(base * (2 ** (attempt - 1)), cap)
+    return delay * (1.0 + jitter * rng.random())

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+# Chaos soak: the speech pipeline across two runtimes over a ChaosBroker,
+# surviving drops, duplicates, a network partition, and a mid-stream kill
+# of the active serving runtime (ISSUE 4 capstone).
+#
+# Scenario (all times in VIRTUAL seconds from the end of setup):
+#
+#   caller runtime   PE_AudioReadFile → PE_AudioFraming → PE_LogMel →
+#                    [remote hop, retries + failover enabled]
+#   serving runtimes serve_asr × 2 (PE_WhisperASR, "test" preset) —
+#                    the caller discovers both; the active one is KILLED
+#                    mid-stream (transport crash: LWTs fire, then the
+#                    plan silences the corpse) and traffic fails over
+#   chaos plan       seeded drops + duplicates on the data topics, a
+#                    partition window severing caller ↔ serving, all
+#                    deterministic under --seed
+#
+# The run is a pure function of the seed: one random.Random drives every
+# fault decision in delivery order on a VirtualClock engine.  The JSON
+# report counts frames sent/recovered/lost, every fault injected, the
+# recovery machinery's work (retries, failovers, dedups) and the leak
+# checks (pending hops, live hop leases) — the same report the pytest
+# soak asserts on (tests/test_chaos_soak.py).
+#
+# Usage:
+#   python scripts/chaos_soak.py --seed 11 --frames 8
+#   python scripts/chaos_soak.py --seed 7 --frames 24 --drop 0.25 \
+#       --horizon 120 --max-lost 0
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+from aiko_services_tpu.event import settle_virtual as _settle  # noqa: E402
+
+
+def _serving_definition(compute_name: str = "compute"):
+    return {
+        "version": 0, "name": "serve_asr", "runtime": "jax",
+        "graph": ["(PE_WhisperASR)"],
+        "parameters": {
+            "PE_WhisperASR.preset": "test",
+            "PE_WhisperASR.mode": "sync",
+            "PE_WhisperASR.max_tokens": 4,
+            "PE_WhisperASR.buckets": [200],
+            # the two serving runtimes share one engine in tests: the
+            # compute service name must be unique per runtime
+            "PE_WhisperASR.compute": compute_name,
+        },
+        "elements": [
+            {"name": "PE_WhisperASR", "input": [{"name": "mel"}],
+             "output": [{"name": "tokens"}, {"name": "text"}]},
+        ],
+    }
+
+
+def _calling_definition():
+    return {
+        "version": 0, "name": "chaos_call", "runtime": "jax",
+        "graph": ["(PE_AudioReadFile (PE_AudioFraming (PE_LogMel "
+                  "(remote_asr))))"],
+        "parameters": {"PE_AudioFraming.window_count": 2},
+        "elements": [
+            {"name": "PE_AudioReadFile", "input": [],
+             "output": [{"name": "audio"}, {"name": "sample_rate"}]},
+            {"name": "PE_AudioFraming", "input": [{"name": "audio"}],
+             "output": [{"name": "audio"}]},
+            {"name": "PE_LogMel", "input": [{"name": "audio"}],
+             "output": [{"name": "mel"}]},
+            {"name": "remote_asr", "input": [{"name": "mel"}],
+             "output": [{"name": "tokens"}, {"name": "text"}],
+             "deploy": {"remote": {"service_filter":
+                                   {"name": "serve_asr"}}}},
+        ],
+    }
+
+
+def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
+             duplicates: int = 3, partition: tuple = (1.0, 2.5),
+             kill_at: float = 4.0, frame_interval: float = 0.4,
+             remote_timeout: float = 1.5, retries: int = 6,
+             failure_budget: int = 4, horizon: float = 60.0,
+             wav_path: str | None = None) -> dict:
+    """Run the scenario; returns the JSON-able report."""
+    import numpy as np
+
+    from aiko_services_tpu.compute import ComputeRuntime
+    from aiko_services_tpu.elements.speech import save_wav
+    from aiko_services_tpu.event import EventEngine, VirtualClock
+    from aiko_services_tpu.lease import Lease
+    from aiko_services_tpu.pipeline import (
+        Pipeline, parse_pipeline_definition)
+    from aiko_services_tpu.process import ProcessRuntime
+    from aiko_services_tpu.registrar import Registrar
+    from aiko_services_tpu.share import ServicesCache
+    from aiko_services_tpu.transport.chaos import ChaosBroker, FaultPlan
+    from aiko_services_tpu.transport.memory import MemoryMessage
+
+    wall_start = time.monotonic()
+    engine = EventEngine(VirtualClock())
+    plan = FaultPlan(seed)
+    broker = ChaosBroker(plan, engine)
+
+    def make_runtime(name):
+        def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+            return MemoryMessage(
+                on_message=on_message, broker=broker, lwt_topic=lwt_topic,
+                lwt_payload=lwt_payload, lwt_retain=lwt_retain,
+                client_id=name)
+        return ProcessRuntime(name=name, engine=engine,
+                              transport_factory=factory).initialize()
+
+    own_tmpdir = None
+    if wav_path is None:
+        rng = np.random.default_rng(seed)
+        audio = (0.1 * rng.standard_normal(16000)).astype(np.float32)
+        own_tmpdir = tempfile.mkdtemp(prefix="chaos_soak_")
+        wav_path = os.path.join(own_tmpdir, "utterance.wav")
+        save_wav(wav_path, audio)
+
+    # -- clean bring-up (chaos starts after discovery settles) ----------
+    registrar_rt = make_runtime("registrar")
+    Registrar(registrar_rt)
+    _settle(engine, 3.0)
+
+    servings = []
+    for index in (1, 2):
+        serve_rt = make_runtime(f"serving{index}")
+        ComputeRuntime(serve_rt, f"compute{index}")
+        pipeline = Pipeline(
+            serve_rt,
+            parse_pipeline_definition(_serving_definition(
+                f"compute{index}")),
+            auto_create_streams=True, stream_lease_time=30.0)
+        servings.append((serve_rt, pipeline))
+    call_rt = make_runtime("caller")
+    caller = Pipeline(
+        call_rt, parse_pipeline_definition(_calling_definition()),
+        services_cache=ServicesCache(call_rt), stream_lease_time=0,
+        remote_timeout=remote_timeout, remote_retries=retries,
+        remote_backoff=0.25, remote_backoff_max=2.0, retry_seed=seed,
+        stream_failure_budget=failure_budget)
+    _settle(engine, 2.0)
+    assert caller.remote_elements_ready(), "setup: discovery failed"
+
+    # -- arm the chaos schedule -----------------------------------------
+    base = engine.clock.now()
+    data_topics = [f"{pipeline.topic_path}/in"
+                   for _, pipeline in servings]
+    data_topics.append(f"{caller.topic_path}/in")
+    for topic in data_topics:
+        plan.drop(topic=topic, probability=drop)
+        plan.duplicate(topic=topic, probability=1.0, count=duplicates)
+        plan.delay(topic=topic, probability=0.2, delay=0.1)
+    plan.partition([["caller"], ["serving*"]],
+                   start=base + partition[0], stop=base + partition[1])
+    kill_time = base + kill_at
+
+    # -- drive -----------------------------------------------------------
+    done = []
+    caller.add_frame_handler(done.append)
+    posted: list[str] = []
+    killed = False
+    next_frame = 0
+    deadline = base + horizon
+    while engine.clock.now() < deadline:
+        now = engine.clock.now()
+        while next_frame < frames and \
+                now >= base + next_frame * frame_interval:
+            stream_id = f"s{next_frame}"
+            caller.create_stream(stream_id, lease_time=0, parameters={
+                "PE_AudioReadFile.pathname": wav_path})
+            caller.post("process_frame", stream_id, {})
+            posted.append(stream_id)
+            next_frame += 1
+        if not killed and now >= kill_time:
+            killed = True
+            # transport-level crash: LWTs fire through the chaos broker
+            # first (a real broker generates them itself), THEN the
+            # corpse is silenced — anything the dead runtime's handlers
+            # still try to send vanishes
+            servings[0][0].message.crash()
+            plan.drop(sender="serving1", start=now)
+        while engine.step():
+            pass
+        completed = {frame.stream_id for frame in done}
+        lost = [sid for sid in posted
+                if sid not in caller.streams and sid not in completed]
+        if next_frame >= frames and \
+                len(completed) + len(lost) >= frames:
+            break
+        engine.clock.advance(0.05)
+    _settle(engine, 1.0)
+
+    # -- report + leak checks --------------------------------------------
+    completed = {frame.stream_id for frame in done}
+    lost = [sid for sid in posted
+            if sid not in caller.streams and sid not in completed]
+    leaked_hop_leases = 0
+    for timer in list(engine._timer_handles.values()):
+        owner = getattr(timer.handler, "__self__", None)
+        if isinstance(owner, Lease) and not timer.cancelled and \
+                str(owner.lease_id).startswith("chaos_call."):
+            leaked_hop_leases += 1
+    serving_stats = {
+        key: sum(p.recovery_stats[key] for _, p in servings)
+        for key in servings[0][1].recovery_stats}
+    report = {
+        "seed": seed,
+        "frames_sent": len(posted),
+        "frames_recovered": len(completed),
+        "frames_lost": len(lost),
+        "lost_streams": lost,
+        # every recovered reply must carry the ASR text output; on the
+        # synthetic noise utterance the decoded text itself may be ""
+        "texts_returned": sum(
+            1 for frame in done
+            if isinstance(frame.swag.get("text"), str)),
+        "texts_nonempty": sum(
+            1 for frame in done
+            if isinstance(frame.swag.get("text"), str)
+            and frame.swag.get("text")),
+        "faults_injected": dict(plan.stats),
+        "caller_recovery": dict(caller.recovery_stats),
+        "serving_recovery": serving_stats,
+        "pending_hops": len(caller._pending_remote),
+        "leaked_hop_leases": leaked_hop_leases,
+        "virtual_seconds": round(engine.clock.now() - base, 2),
+        "wall_seconds": round(time.monotonic() - wall_start, 2),
+    }
+
+    # -- teardown (serving1 already crashed; leave its corpse be) --------
+    caller.stop()
+    call_rt.terminate()
+    servings[1][1].stop()
+    servings[1][0].terminate()
+    registrar_rt.terminate()
+    if own_tmpdir is not None:
+        shutil.rmtree(own_tmpdir, ignore_errors=True)
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="chaos soak: speech pipeline across two runtimes "
+                    "under seeded drops, a partition, and a kill")
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--frames", type=int, default=8)
+    parser.add_argument("--drop", type=float, default=0.15,
+                        help="per-delivery drop probability on data "
+                             "topics")
+    parser.add_argument("--retries", type=int, default=6)
+    parser.add_argument("--horizon", type=float, default=60.0,
+                        help="virtual-seconds budget")
+    parser.add_argument("--max-lost", type=int, default=0,
+                        help="frame-loss policy: exit 1 beyond this")
+    args = parser.parse_args(argv)
+    report = run_soak(seed=args.seed, frames=args.frames, drop=args.drop,
+                      retries=args.retries, horizon=args.horizon)
+    print(json.dumps(report, indent=2))
+    return 0 if report["frames_lost"] <= args.max_lost else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,609 @@
+# Chaos transport + end-to-end failure recovery (ISSUE 4): the seeded
+# fault-injection layer (transport/chaos.py) and the machinery it
+# exercises — remote-hop retry with backoff, candidate failover,
+# duplicate request/reply dedup, the per-stream failure budget, hop
+# lease hygiene, and registrar failover when the boot-topic LWT is lost.
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.lease import Lease
+from aiko_services_tpu.pipeline import (
+    DEFERRED, Frame, FrameOutput, Pipeline, PipelineElement,
+    parse_pipeline_definition)
+from aiko_services_tpu.process import ProcessRuntime
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.share import ServicesCache
+from aiko_services_tpu.transport.chaos import (
+    ChaosBroker, FaultPlan, FaultRule)
+from aiko_services_tpu.transport.memory import MemoryMessage
+from aiko_services_tpu.event import settle_virtual as settle
+
+
+@pytest.fixture
+def plan():
+    return FaultPlan(seed=7)
+
+
+@pytest.fixture
+def chaos_broker(plan, engine):
+    return ChaosBroker(plan, engine)
+
+
+@pytest.fixture
+def make_chaos_runtime(engine, chaos_broker):
+    """ProcessRuntime factory over the chaos broker, client ids = names
+    (so fault rules target runtimes by name)."""
+    created = []
+
+    def factory(name):
+        def transport_factory(on_message, lwt_topic, lwt_payload,
+                              lwt_retain):
+            return MemoryMessage(
+                on_message=on_message, broker=chaos_broker,
+                lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                lwt_retain=lwt_retain, client_id=name)
+        runtime = ProcessRuntime(name=name, engine=engine,
+                                 transport_factory=transport_factory)
+        created.append(runtime)
+        return runtime.initialize()
+
+    yield factory
+    for runtime in created:
+        try:
+            if runtime.message is not None and runtime.message.connected():
+                runtime.terminate()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / ChaosBroker mechanics
+# ---------------------------------------------------------------------------
+
+def _client(broker, name, topics, seen):
+    client = MemoryMessage(
+        on_message=lambda t, p: seen.append((name, t, p)),
+        subscriptions=topics, broker=broker, client_id=name)
+    client.connect()
+    return client
+
+
+class TestChaosMechanics:
+    def test_same_seed_same_fault_sequence(self, engine):
+        def run(seed):
+            plan = FaultPlan(seed)
+            broker = ChaosBroker(plan, engine)
+            plan.drop(topic="t/#", probability=0.5)
+            seen = []
+            _client(broker, "rx", ["t/#"], seen)
+            tx = _client(broker, "tx", [], seen)
+            for index in range(40):
+                tx.publish(f"t/{index}", f"m{index}")
+            return dict(plan.stats), [p for _, _, p in seen]
+
+        stats_a, seen_a = run(123)
+        stats_b, seen_b = run(123)
+        assert stats_a == stats_b and seen_a == seen_b
+        assert 0 < stats_a["drop"] < 40
+
+    def test_drop_rule_is_per_recipient(self, chaos_broker, plan):
+        plan.drop(topic="t/#", client="b")
+        seen = []
+        _client(chaos_broker, "a", ["t/#"], seen)
+        _client(chaos_broker, "b", ["t/#"], seen)
+        tx = _client(chaos_broker, "tx", [], seen)
+        tx.publish("t/1", "x")
+        assert [name for name, _, _ in seen] == ["a"]
+
+    def test_delay_defers_until_clock_advance(self, chaos_broker, plan,
+                                              engine):
+        plan.delay(topic="t/#", delay=0.5)
+        seen = []
+        _client(chaos_broker, "rx", ["t/#"], seen)
+        tx = _client(chaos_broker, "tx", [], seen)
+        tx.publish("t/1", "x")
+        engine.step()
+        assert seen == []
+        engine.clock.advance(0.6)
+        engine.step()
+        assert [p for _, _, p in seen] == ["x"]
+
+    def test_duplicate_and_truncate(self, chaos_broker, plan):
+        plan.duplicate(topic="dup/#", copies=2)
+        plan.truncate(topic="cut/#", truncate_to=4)
+        seen = []
+        _client(chaos_broker, "rx", ["dup/#", "cut/#"], seen)
+        tx = _client(chaos_broker, "tx", [], seen)
+        tx.publish("dup/1", "payload")
+        assert [p for _, _, p in seen] == ["payload"] * 3
+        seen.clear()
+        tx.publish("cut/1", b"0123456789")
+        assert [p for _, _, p in seen] == [b"0123"]
+
+    def test_reorder_holds_one_engine_turn(self, chaos_broker, plan,
+                                           engine):
+        plan.reorder(topic="t/#", count=1)       # only the first message
+        seen = []
+        _client(chaos_broker, "rx", ["t/#"], seen)
+        tx = _client(chaos_broker, "tx", [], seen)
+        tx.publish("t/1", "first")
+        tx.publish("t/2", "second")
+        engine.step()
+        assert [p for _, _, p in seen] == ["second", "first"]
+
+    def test_partition_severs_groups_then_heals(self, chaos_broker, plan,
+                                                engine):
+        plan.partition([["a*"], ["b*"]], start=1.0, stop=2.0)
+        seen = []
+        _client(chaos_broker, "b_rx", ["t/#"], seen)
+        _client(chaos_broker, "observer", ["t/#"], seen)
+        tx = _client(chaos_broker, "a_tx", [], seen)
+
+        tx.publish("t/1", "before")              # t=0: no partition yet
+        engine.clock.advance(1.5)
+        tx.publish("t/2", "during")              # severed a* -> b*
+        engine.clock.advance(1.0)
+        tx.publish("t/3", "after")               # healed
+        b_sees = [p for name, _, p in seen if name == "b_rx"]
+        observer_sees = [p for name, _, p in seen if name == "observer"]
+        assert b_sees == ["before", "after"]
+        # clients in no group are unaffected (control plane stays up)
+        assert observer_sees == ["before", "during", "after"]
+        assert plan.stats["partitioned"] == 1
+
+    def test_payload_match_and_count_window(self, chaos_broker, plan):
+        plan.drop(topic="t/#", payload_match="poison", count=1)
+        seen = []
+        _client(chaos_broker, "rx", ["t/#"], seen)
+        tx = _client(chaos_broker, "tx", [], seen)
+        tx.publish("t/1", "fine")
+        tx.publish("t/2", "poison pill")         # dropped (matches, 1st)
+        tx.publish("t/3", "poison again")        # count spent: delivered
+        assert [p for _, _, p in seen] == ["fine", "poison again"]
+
+    def test_unknown_fault_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule("explode")
+
+
+# ---------------------------------------------------------------------------
+# Remote-hop recovery: retry, failover, dedup
+# ---------------------------------------------------------------------------
+
+class PE_Source(PipelineElement):
+    def process_frame(self, frame: Frame, **_) -> FrameOutput:
+        return FrameOutput(True, {"data": np.arange(6, dtype=np.float32)})
+
+
+class PE_Work(PipelineElement):
+    def process_frame(self, frame: Frame, data=None, **_) -> FrameOutput:
+        return FrameOutput(True, {"total": float(np.asarray(data).sum())})
+
+
+class PE_Tail(PipelineElement):
+    def process_frame(self, frame: Frame, total=0, **_) -> FrameOutput:
+        return FrameOutput(True, {"final": float(total) + 0.5})
+
+
+def element(name, inputs=(), outputs=(), deploy=None):
+    return {"name": name, "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": deploy or {}}
+
+
+def serving_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "serve_pipe", "runtime": "python",
+        "graph": ["(PE_Work)"],
+        "elements": [element("PE_Work", ["data"], ["total"])],
+    })
+
+
+def calling_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "call_pipe", "runtime": "python",
+        "graph": ["(PE_Source (remote_work (PE_Tail)))"],
+        "elements": [
+            element("PE_Source", [], ["data"]),
+            element("remote_work", ["data"], ["total"],
+                    deploy={"remote": {"service_filter":
+                                       {"name": "serve_pipe"}}}),
+            element("PE_Tail", ["total"], ["final"]),
+        ],
+    })
+
+
+def build_system(make_chaos_runtime, engine, servings=1, **caller_kwargs):
+    registrar_rt = make_chaos_runtime("registrar")
+    Registrar(registrar_rt)
+    settle(engine, 3.0)
+    serve_pipes = []
+    for index in range(servings):
+        serve_rt = make_chaos_runtime(f"serving{index + 1}")
+        serve_pipes.append(Pipeline(
+            serve_rt, serving_definition(),
+            name=f"serve_pipe", element_classes={"PE_Work": PE_Work},
+            auto_create_streams=True, stream_lease_time=0))
+        settle(engine, 0.5)     # deterministic discovery order
+    call_rt = make_chaos_runtime("caller")
+    caller = Pipeline(call_rt, calling_definition(),
+                      element_classes={"PE_Source": PE_Source,
+                                       "PE_Tail": PE_Tail},
+                      services_cache=ServicesCache(call_rt),
+                      stream_lease_time=0, remote_timeout=2.0,
+                      retry_jitter=0.0, **caller_kwargs)
+    settle(engine, 2.0)
+    assert caller.remote_elements_ready()
+    return serve_pipes, caller
+
+
+class TestRemoteRecovery:
+    def test_retry_recovers_dropped_request(self, make_chaos_runtime,
+                                            engine, plan):
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           remote_retries=2)
+        serving_in = f"{serve_pipes[0].topic_path}/in"
+        plan.drop(topic=serving_in, count=1)     # eat the first request
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 0.5)
+        assert not done and caller._pending_remote
+        settle(engine, 4.0)                      # timeout + backoff + retry
+        assert done and done[0].swag["final"] == 15.5
+        assert caller.recovery_stats["retries"] == 1
+        assert not caller._pending_remote
+        assert "s1" in caller.streams            # stream survived
+
+    def test_timeout_fails_over_to_second_service(self,
+                                                  make_chaos_runtime,
+                                                  engine):
+        """ISSUE 4 acceptance: a remote-hop timeout with a second
+        matching service available recovers via failover — the frame
+        completes, no stream teardown."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           servings=2, remote_retries=3)
+        placeholder = caller._remote["remote_work"]
+        assert len(placeholder.candidates) == 2
+        # wedge whichever service is ACTIVE: requests vanish into it
+        active = next(p for p in serve_pipes
+                      if p.topic_path == placeholder.topic_path)
+        active.process_frame_remote = lambda *args, **kwargs: None
+        active.process_frames_remote = lambda *args, **kwargs: None
+
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 0.5)
+        assert not done                          # wedged service is mute
+        settle(engine, 5.0)                      # expire, rotate, resend
+        assert done, "failover never recovered the frame"
+        assert done[0].swag["final"] == 15.5
+        assert caller.recovery_stats["failovers"] >= 1
+        assert placeholder.topic_path != active.topic_path
+        assert "s1" in caller.streams and not caller._pending_remote
+
+    def test_simultaneous_expiries_rotate_once(self, make_chaos_runtime,
+                                               engine):
+        """A burst of hop timeouts against one wedged service advances
+        the candidate ONCE: per-expired-hop rotation would walk an
+        even-sized burst right back onto the dead candidate and burn
+        every retry against it."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           servings=2, remote_retries=2)
+        placeholder = caller._remote["remote_work"]
+        active = next(p for p in serve_pipes
+                      if p.topic_path == placeholder.topic_path)
+        healthy = next(p for p in serve_pipes if p is not active)
+        active.process_frame_remote = lambda *args, **kwargs: None
+        active.process_frames_remote = lambda *args, **kwargs: None
+
+        done = []
+        caller.add_frame_handler(done.append)
+        for stream_id in ("s1", "s2"):
+            caller.create_stream(stream_id, lease_time=0)
+            caller.post("process_frame", stream_id, {})
+        settle(engine, 0.5)
+        assert not done and len(caller._pending_remote) == 2
+        settle(engine, 6.0)          # both expire -> one rotation -> resend
+        assert len(done) == 2, (len(done), caller.recovery_stats)
+        assert {frame.swag["final"] for frame in done} == {15.5}
+        assert placeholder.topic_path == healthy.topic_path
+        assert not caller._pending_remote
+
+    def test_hop_ids_carry_incarnation_nonce(self, make_chaos_runtime,
+                                             engine):
+        """Hop ids embed a per-instance nonce: a rebuilt caller that
+        reuses the same reply topic must not re-mint 'name.1', or the
+        serving dedup ring would answer its first request by replaying
+        the PREVIOUS incarnation's cached reply."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine)
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 2.0)
+        assert done
+        hop_id = next(iter(caller._retired_hops))
+        assert hop_id.startswith(f"{caller.name}.{caller._hop_nonce}.")
+        # a second incarnation of the same pipeline mints disjoint ids
+        rt2 = make_chaos_runtime("caller2")
+        reborn = Pipeline(rt2, calling_definition(),
+                          element_classes={"PE_Source": PE_Source,
+                                           "PE_Tail": PE_Tail},
+                          services_cache=ServicesCache(rt2),
+                          stream_lease_time=0)
+        assert reborn._hop_nonce != caller._hop_nonce
+
+    def test_proxy_loss_redirects_inflight_hops(self, make_chaos_runtime,
+                                                engine):
+        """The active service dies with a request IN FLIGHT: discovery
+        removal redirects the hop to the surviving candidate without
+        waiting for the timeout lease."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           servings=2, remote_retries=3)
+        placeholder = caller._remote["remote_work"]
+        active = next(p for p in serve_pipes
+                      if p.topic_path == placeholder.topic_path)
+        active.process_frame_remote = lambda *args, **kwargs: None
+        active.process_frames_remote = lambda *args, **kwargs: None
+
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 0.3)
+        assert caller._pending_remote            # hop stuck in the mute
+        active.runtime.message.crash()           # LWT -> registrar purge
+        settle(engine, 1.0)                      # << remote_timeout
+        assert done and done[0].swag["final"] == 15.5
+        assert caller.recovery_stats["failovers"] >= 1
+
+    def test_duplicate_reply_dedups(self, make_chaos_runtime, engine,
+                                    plan):
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           remote_retries=2)
+        plan.duplicate(topic=f"{caller.topic_path}/in", probability=1.0)
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 2.0)
+        assert len(done) == 1                    # resumed exactly once
+        assert caller.recovery_stats["dup_replies"] >= 1
+
+    def test_duplicate_request_dedups_on_serving_side(
+            self, make_chaos_runtime, engine, plan):
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           remote_retries=2)
+        serving = serve_pipes[0]
+        served = []
+        serving.add_frame_handler(served.append)
+        plan.duplicate(topic=f"{serving.topic_path}/in", probability=1.0)
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 2.0)
+        assert len(done) == 1
+        assert len(served) == 1                  # walked exactly once
+        assert serving.recovery_stats["dup_requests"] >= 1
+
+    def test_reply_replay_cache_aggregate_budget(
+            self, make_chaos_runtime, engine, plan, monkeypatch):
+        """The replay cache is bounded in AGGREGATE, not just per
+        entry: once the pinned payload budget is spent the oldest
+        replies demote to 'uncached' — the duplicate is still
+        recognized as completed, it just cannot be replayed."""
+        from aiko_services_tpu import pipeline as pipeline_module
+        serve_pipes, caller = build_system(make_chaos_runtime, engine)
+        serving = serve_pipes[0]
+        monkeypatch.setattr(pipeline_module,
+                            "_SERVED_REPLY_BUDGET_BYTES", 1024)
+        payload = np.zeros(100, dtype=np.float32)       # 400 B pinned
+        for n in range(4):
+            key = ("aiko/t", str(n))
+            serving._served_hops[key] = None            # walk started
+            serving._cache_served_reply(
+                key, "bin", "aiko/t", [str(n), True, {"x": payload}, []])
+        assert serving._served_reply_bytes <= 1024
+        kinds = [serving._served_hops[("aiko/t", str(n))][0]
+                 for n in range(4)]
+        assert kinds == ["uncached", "uncached", "bin", "bin"]
+
+    def test_truncated_envelope_recovers_via_retry(
+            self, make_chaos_runtime, engine, plan):
+        """A payload cut mid-envelope must not kill anything: the serving
+        actor logs the garbage, the hop times out, the retry ships a
+        clean copy."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           remote_retries=2)
+        plan.truncate(topic=f"{serve_pipes[0].topic_path}/in",
+                      truncate_to=10, count=1)
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 4.0)
+        assert done and done[0].swag["final"] == 15.5
+        assert caller.recovery_stats["retries"] == 1
+
+    def test_retries_exhausted_fails_frame_within_budget(
+            self, make_chaos_runtime, engine):
+        """No second service, serving mute, retries spent: the frame
+        fails, and with the default budget (1) the stream stops cleanly
+        — pending map empty, no hop lease left ticking."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           remote_retries=1)
+        serve_pipes[0].process_frame_remote = lambda *a, **k: None
+        serve_pipes[0].process_frames_remote = lambda *a, **k: None
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 8.0)
+        assert not caller._pending_remote
+        assert "s1" not in caller.streams
+        for timer in engine._timer_handles.values():
+            owner = getattr(timer.handler, "__self__", None)
+            assert not (isinstance(owner, Lease) and not timer.cancelled
+                        and str(owner.lease_id).startswith("call_pipe.")), \
+                f"leaked hop lease {owner.lease_id}"
+
+    def test_destroy_stream_cancels_pending_hops(self,
+                                                 make_chaos_runtime,
+                                                 engine):
+        """Lease-lifecycle audit: destroying a stream with a hop in
+        flight cancels the hop's timers — nothing fires later."""
+        serve_pipes, caller = build_system(make_chaos_runtime, engine,
+                                           remote_retries=2)
+        serve_pipes[0].process_frame_remote = lambda *a, **k: None
+        serve_pipes[0].process_frames_remote = lambda *a, **k: None
+        caller.create_stream("s1", lease_time=0)
+        caller.post("process_frame", "s1", {})
+        settle(engine, 0.3)
+        assert caller._pending_remote
+        caller.destroy_stream("s1")
+        assert not caller._pending_remote
+        for timer in engine._timer_handles.values():
+            owner = getattr(timer.handler, "__self__", None)
+            assert not (isinstance(owner, Lease) and not timer.cancelled
+                        and str(owner.lease_id).startswith("call_pipe."))
+        settle(engine, 6.0)                      # nothing blows up later
+        assert caller.recovery_stats["retries"] == 0
+
+    def test_destroyed_stream_answers_parked_remote_frame(
+            self, make_chaos_runtime, engine, chaos_broker):
+        """Serving side: a remote frame parked DEFERRED when its stream
+        is destroyed must still answer the caller — otherwise the dedup
+        ring holds the hop 'in progress' forever and every caller retry
+        of the hop id is silently skipped."""
+        class PE_Park(PipelineElement):
+            def process_frame(self, frame: Frame, data=None, **_):
+                return FrameOutput(True, DEFERRED)
+
+        rt = make_chaos_runtime("serving1")
+        definition = parse_pipeline_definition({
+            "version": 0, "name": "serve_pipe", "runtime": "python",
+            "graph": ["(PE_Park)"],
+            "elements": [element("PE_Park", ["data"], ["total"])],
+        })
+        serving = Pipeline(rt, definition, name="serve_pipe",
+                           element_classes={"PE_Park": PE_Park},
+                           auto_create_streams=True, stream_lease_time=0)
+        replies = []
+        _client(chaos_broker, "watcher", ["test/reply"], replies)
+        serving.process_frame_remote("s1", {"data": 1.0}, "test/reply",
+                                     "h1")
+        settle(engine, 0.2)
+        assert not replies                       # parked, no reply yet
+        serving.destroy_stream("s1")
+        settle(engine, 0.2)
+        assert len(replies) == 1                 # caller got the failure
+        # a retry of the settled hop replays the cached failure reply
+        serving.process_frame_remote("s1", {"data": 1.0}, "test/reply",
+                                     "h1")
+        settle(engine, 0.2)
+        assert len(replies) == 2
+        assert serving.recovery_stats["dup_requests"] == 1
+        assert serving.recovery_stats["replayed_replies"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Registrar failover under a dropped LWT
+# ---------------------------------------------------------------------------
+
+class TestRegistrarChaos:
+    def test_failover_when_boot_lwt_dropped(self, make_chaos_runtime,
+                                            engine, plan):
+        """The primary crashes and the boot-topic "(primary absent)" LWT
+        is LOST on the wire.  The secondary still promotes: the
+        primary's process-state LWT is an independent death signal."""
+        r1 = make_chaos_runtime("reg1")
+        reg1 = Registrar(r1)
+        settle(engine, 3.0)
+        r2 = make_chaos_runtime("reg2")
+        reg2 = Registrar(r2)
+        settle(engine, 3.0)
+        assert reg1.is_primary and not reg2.is_primary
+
+        plan.drop(topic=r1.topic_registrar_boot, payload_match="absent")
+        r1.message.crash()
+        settle(engine, 3.0)
+        assert reg2.is_primary, \
+            "secondary never promoted after the boot LWT was dropped"
+
+
+# ---------------------------------------------------------------------------
+# Per-stream failure budget + Lease.cancel
+# ---------------------------------------------------------------------------
+
+class PE_Flaky(PipelineElement):
+    def process_frame(self, frame: Frame, ok=None, **_) -> FrameOutput:
+        if not ok:
+            return FrameOutput(False, diagnostic="boom")
+        return FrameOutput(True, {"out": 1})
+
+
+class TestFailureBudget:
+    def _pipeline(self, make_runtime, budget):
+        runtime = make_runtime("budget_host").initialize()
+        definition = parse_pipeline_definition({
+            "version": 0, "name": "p_budget", "runtime": "python",
+            "graph": ["(PE_Flaky)"],
+            "elements": [
+                {"name": "PE_Flaky", "input": [{"name": "ok"}],
+                 "output": [{"name": "out"}]}],
+        })
+        return Pipeline(runtime, definition,
+                        element_classes={"PE_Flaky": PE_Flaky},
+                        stream_lease_time=0,
+                        stream_failure_budget=budget)
+
+    def test_stream_survives_failures_inside_budget(self, make_runtime,
+                                                    engine):
+        pipeline = self._pipeline(make_runtime, budget=3)
+        stream = pipeline.create_stream("s1", lease_time=0)
+        for _ in range(2):
+            ok, _ = pipeline.process_frame("s1", {"ok": False})
+            assert not ok
+        assert "s1" in pipeline.streams
+        assert stream.consecutive_failures == 2
+        assert "boom" in stream.last_diagnostic
+        # a success resets the consecutive count
+        ok, _ = pipeline.process_frame("s1", {"ok": True})
+        assert ok and stream.consecutive_failures == 0
+        for _ in range(2):
+            pipeline.process_frame("s1", {"ok": False})
+        assert "s1" in pipeline.streams
+        pipeline.process_frame("s1", {"ok": False})      # 3rd consecutive
+        assert "s1" not in pipeline.streams
+        assert pipeline.recovery_stats["streams_stopped"] == 1
+
+    def test_default_budget_keeps_fail_fast(self, make_runtime, engine):
+        pipeline = self._pipeline(make_runtime, budget=1)
+        pipeline.create_stream("s1", lease_time=0)
+        pipeline.process_frame("s1", {"ok": False})
+        assert "s1" not in pipeline.streams
+
+
+class TestLeaseCancel:
+    def test_cancel_stops_expiry(self, engine):
+        fired = []
+        lease = Lease(engine, 1.0, "x",
+                      lease_expired_handler=fired.append)
+        assert lease.active
+        lease.cancel()
+        assert not lease.active
+        engine.clock.advance(2.0)
+        engine.step()
+        assert fired == []
+
+    def test_expiry_fires_once_then_inactive(self, engine):
+        fired = []
+        lease = Lease(engine, 1.0, "x",
+                      lease_expired_handler=fired.append)
+        engine.clock.advance(1.1)
+        engine.step()
+        assert fired == ["x"] and not lease.active

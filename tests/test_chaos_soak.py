@@ -1,0 +1,47 @@
+# ISSUE 4 capstone: the chaos soak — the speech pipeline across two
+# runtimes over a ChaosBroker, surviving seeded drops + duplicates +
+# delays, a caller↔serving network partition, and a mid-stream kill of
+# the active serving runtime.  Deterministic under the fixed seed; the
+# scenario itself lives in scripts/chaos_soak.py (also runnable
+# standalone with bigger seeds/frame counts).
+#
+# The suite-wide AIKO_LOCK_CHECK=1 gate (conftest) covers the "no
+# lock-order violations" half of the acceptance criteria; the report
+# asserts the rest: frame loss within policy (zero), no pending hops,
+# no live hop leases left on the engine.
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
+
+from chaos_soak import run_soak  # noqa: E402
+
+
+def test_chaos_soak_speech_two_runtimes():
+    report = run_soak(seed=11, frames=6, horizon=40.0)
+
+    # frame-loss policy: every frame recovers despite the chaos
+    assert report["frames_sent"] == 6
+    assert report["frames_lost"] == 0, report
+    assert report["frames_recovered"] == 6
+    # every reply carried the ASR text output (the decoded text itself
+    # is "" on the noise utterance — texts_nonempty tracks that honestly)
+    assert report["texts_returned"] == 6
+
+    # the chaos actually happened (drops + partition + duplicates) ...
+    faults = report["faults_injected"]
+    assert sum(faults.values()) > 0
+    assert faults.get("partitioned", 0) > 0
+    assert faults.get("duplicate", 0) > 0
+
+    # ... and the recovery machinery is what absorbed it
+    caller = report["caller_recovery"]
+    assert caller["retries"] > 0                # drops/partition retried
+    assert caller["failovers"] >= 1             # the kill redirected hops
+    assert caller["dup_replies"] + \
+        report["serving_recovery"]["dup_requests"] > 0
+
+    # leak checks: nothing pending, no hop lease still ticking
+    assert report["pending_hops"] == 0
+    assert report["leaked_hop_leases"] == 0

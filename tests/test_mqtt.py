@@ -213,13 +213,44 @@ class TestReconnect:
         client.drop()
         # let several attempts fail
         assert wait_for(lambda: client.connect_attempts >= 3)
-        assert message._backoff > message.backoff_min
+        assert message._attempts > 1    # delay has doubled at least once
         assert message.stats["reconnects"] >= 2
         broker.down = False
         assert wait_for(message.connected)
         # backoff resets on success
-        assert message._backoff == message.backoff_min
+        assert message._attempts == 0
         message.disconnect()
+
+    def test_backoff_jitter_is_seeded_and_bounded(self):
+        """Reconnect delays carry seeded jitter: within
+        [base, base * (1 + jitter)], deterministic per seed, different
+        across seeds — a broker restart must not get a fleet redialing
+        in lockstep (ISSUE 4)."""
+        def delay_sequence(seed):
+            broker = FakeBroker()
+            message, client, _ = make_pair(broker, jitter_seed=seed,
+                                           backoff_jitter=0.5)
+            broker.down = True
+            client.drop()               # schedules the first reconnect
+            delays = []
+            for _ in range(3):
+                timer = message._reconnect_timer
+                assert timer is not None
+                delays.append(timer.interval)
+                timer.cancel()
+                with message._lock:
+                    message._reconnect_timer = None
+                message._attempt_reconnect()    # fails -> next delay
+            message.disconnect()
+            return delays
+
+        first = delay_sequence(9)
+        assert first == delay_sequence(9)       # reproducible
+        assert first != delay_sequence(10)      # but seed-dependent
+        base = 0.02
+        for attempt, delay in enumerate(first):
+            low = min(base * 2 ** attempt, 0.1)
+            assert low <= delay <= low * 1.5 + 1e-9, (attempt, delay)
 
     def test_connect_retries_when_broker_initially_down(self):
         broker = FakeBroker()

@@ -7,7 +7,7 @@ import sys
 import pytest
 
 from aiko_services_tpu.lifecycle import LifeCycleClient, LifeCycleManager
-from aiko_services_tpu.process_manager import ProcessManager
+from aiko_services_tpu.process_manager import ProcessManager, RestartPolicy
 from aiko_services_tpu.recorder import Recorder
 from aiko_services_tpu.registrar import Registrar
 from aiko_services_tpu.service import ServiceFilter
@@ -56,6 +56,101 @@ def test_process_manager_duplicate_id(engine):
     manager.terminate()
 
 
+def test_process_manager_failed_launch_not_supervised(engine):
+    manager = ProcessManager(engine)
+    policy = RestartPolicy(backoff=0.05, jitter=0.0)
+    with pytest.raises(OSError):
+        manager.spawn("w", ["/nonexistent/binary"], restart=policy)
+    assert manager.restart_state("w") == {}
+    assert "w" not in manager
+    # id is free again, and the replacement is NOT under the old policy
+    manager.spawn("w", [sys.executable, "-c", "import sys; sys.exit(3)"])
+    assert _drive(engine, lambda: "w" not in manager)
+    assert manager.restart_state("w") == {}
+    manager.terminate()
+
+
+def _drive(engine, predicate, wall_seconds=20.0, advance=0.2):
+    """Real child processes + virtual supervision timers: advance the
+    clock while polling, bounded by wall time."""
+    import time
+    deadline = time.monotonic() + wall_seconds
+    while not predicate() and time.monotonic() < deadline:
+        engine.clock.advance(advance)
+        engine.step()
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_process_manager_restart_policy_respawns(engine):
+    """A supervised child that keeps dying is respawned under backoff
+    (ISSUE 4: restart policies)."""
+    manager = ProcessManager(engine)
+    policy = RestartPolicy(max_restarts=5, window=1e6, backoff=0.05,
+                           backoff_max=0.1, jitter=0.0)
+    manager.spawn("flaky", [sys.executable, "-c",
+                            "import sys; sys.exit(1)"], restart=policy)
+    assert _drive(engine, lambda:
+                  manager.restart_state("flaky").get("recent_exits",
+                                                     0) >= 2), \
+        manager.restart_state("flaky")
+    assert not manager.restart_state("flaky")["crash_looping"]
+    manager.terminate()
+
+
+def test_process_manager_crash_loop_gives_up(engine):
+    """Too many exits inside the policy window is a crash loop: the
+    supervisor stops respawning and reports the terminal exit."""
+    exits, loops = [], []
+    manager = ProcessManager(
+        engine, lambda id, pid, code: exits.append((id, code)),
+        crash_loop_handler=lambda id, times: loops.append(id))
+    policy = RestartPolicy(max_restarts=1, window=1e6, backoff=0.05,
+                           jitter=0.0)
+    manager.spawn("dying", [sys.executable, "-c",
+                            "import sys; sys.exit(3)"], restart=policy)
+    assert _drive(engine, lambda: loops == ["dying"])
+    assert exits == [("dying", 3)]      # only the TERMINAL exit surfaced
+    assert manager.restart_state("dying")["crash_looping"]
+    assert not manager.restart_state("dying")["respawn_pending"]
+    manager.terminate()
+
+
+def test_process_manager_spawn_supersedes_stale_supervision(engine):
+    """Re-spawning an id whose previous incarnation is awaiting respawn
+    replaces supervision outright: the stale pending timer must not
+    resurrect the OLD argv after the new process exits."""
+    manager = ProcessManager(engine)
+    policy = RestartPolicy(max_restarts=5, window=1e6, backoff=5.0,
+                           jitter=0.0)
+    manager.spawn("w", [sys.executable, "-c", "import sys; sys.exit(1)"],
+                  restart=policy)
+    assert _drive(engine, lambda:
+                  manager.restart_state("w").get("respawn_pending", False))
+    assert "w" not in manager            # id free, respawn still pending
+    manager.spawn("w", [sys.executable, "-c", "pass"])   # no policy
+    assert manager.restart_state("w") == {}     # old supervision dropped
+    assert _drive(engine, lambda: "w" not in manager)
+    for _ in range(60):                  # well past the old 5s backoff
+        engine.clock.advance(0.2)
+        engine.step()
+    assert "w" not in manager            # old argv never resurrected
+    assert manager.restart_state("w") == {}
+    manager.terminate()
+
+
+def test_process_manager_clean_exit_not_restarted(engine):
+    """rc == 0 without restart_on_success ends supervision."""
+    exits = []
+    manager = ProcessManager(
+        engine, lambda id, pid, code: exits.append(code))
+    manager.spawn("clean", [sys.executable, "-c", "pass"],
+                  restart=RestartPolicy(backoff=0.05, jitter=0.0))
+    assert _drive(engine, lambda: exits == [0])
+    assert manager.restart_state("clean") == {}     # supervision dropped
+    manager.terminate()
+
+
 # -- lifecycle ---------------------------------------------------------------
 
 def test_lifecycle_fleet_handshake(make_runtime, engine):
@@ -85,6 +180,52 @@ def test_lifecycle_fleet_handshake(make_runtime, engine):
     settle(engine, 8)
     assert manager.ready_count() == 2
     assert len(manager.clients) == 2
+
+
+from aiko_services_tpu.event import settle_virtual as _settle_timed  # noqa: E402
+
+
+def test_lifecycle_restart_policy_replaces_dead_client(make_runtime,
+                                                       engine):
+    """A ready client that crashes (LWT) is replaced under the restart
+    policy; repeated deaths inside the window trip the crash-loop
+    detector and replacement stops (ISSUE 4)."""
+    manager_rt = make_runtime("lcm3_host").initialize()
+    spawned = {}
+
+    def spawner(client_id, manager_topic):
+        rt = make_runtime(f"worker3_{client_id}").initialize()
+        client = LifeCycleClient(rt, f"client3_{client_id}", manager_topic,
+                                 client_id)
+        spawned[client_id] = rt
+        return rt
+
+    manager = LifeCycleManager(
+        manager_rt, "lcm3", spawner,
+        restart_policy=RestartPolicy(max_restarts=2, window=1e6,
+                                     backoff=0.2, jitter=0.0))
+    ids = manager.create_clients(2)
+    _settle_timed(engine, 2.0)
+    assert manager.ready_count() == 2
+
+    spawned[ids[0]].message.crash()             # death 1: replaced
+    _settle_timed(engine, 2.0)
+    assert manager.restart_stats["respawns"] == 1
+    assert manager.ready_count() == 2
+    assert not manager.crash_looping
+
+    replacement = [cid for cid in manager.clients if cid != ids[1]]
+    spawned[replacement[0]].message.crash()     # death 2: replaced
+    _settle_timed(engine, 2.0)
+    assert manager.restart_stats["respawns"] == 2
+    assert manager.ready_count() == 2
+
+    replacement = [cid for cid in manager.clients if cid != ids[1]]
+    spawned[replacement[0]].message.crash()     # death 3: > max_restarts
+    _settle_timed(engine, 2.0)
+    assert manager.crash_looping
+    assert manager.restart_stats["respawns"] == 2   # no replacement
+    assert manager.ready_count() == 1
 
 
 def test_lifecycle_handshake_timeout_deletes(make_runtime, engine):
