@@ -15,7 +15,9 @@
 from __future__ import annotations
 
 import inspect
+import time
 
+from .observe import tracing
 from .service import Service, ServiceFilter, ServiceProtocol
 from .share import ECProducer, ServicesCache
 from .transport import wire
@@ -28,12 +30,17 @@ PROTOCOL_ACTOR = ServiceProtocol("actor")
 
 
 class ActorMessage:
-    __slots__ = ("target", "command", "arguments")
+    __slots__ = ("target", "command", "arguments", "trace")
 
-    def __init__(self, target, command: str, arguments):
+    def __init__(self, target, command: str, arguments, trace=None):
         self.target = target
         self.command = command
         self.arguments = arguments
+        # trace context the message arrived under (envelope header /
+        # sexpr marker): activated for the duration of the call, so
+        # the handler — and anything it spawns — inherits the caller's
+        # trace id and deadline
+        self.trace = trace
 
     def invoke(self, logger=None) -> None:
         method = getattr(self.target, self.command, None)
@@ -45,7 +52,8 @@ class ActorMessage:
                                self.command)
             return
         try:
-            method(*self.arguments)
+            with tracing.activate(self.trace):
+                method(*self.arguments)
         except Exception:
             if logger:
                 logger.exception("actor %s: %s%r raised",
@@ -92,27 +100,67 @@ class Actor(Service):
 
     # -- inbound -----------------------------------------------------------
     def _topic_in_handler(self, _topic, payload) -> None:
+        started = time.perf_counter()
         try:
             if wire.is_envelope(payload):
                 # binary wire envelope: tensors arrive as zero-copy
                 # views, scalars keep sexpr (string) semantics
-                command, params = wire.decode_envelope(payload)
+                command, params, trace_fields = \
+                    wire.decode_envelope(payload, with_trace=True)
             else:
                 command, params = parse(payload)
+                trace_fields = wire.pop_trace(params)
         except Exception:
             self.logger.warning("%s: unparseable payload %r",
                                 self.name, payload)
             return
+        context = None
+        if trace_fields is not None:
+            now = self.runtime.event.clock.now()
+            context = tracing.TraceContext.from_fields(trace_fields, now)
+            trc = tracing.tracer
+            if trc.enabled and context is not None:
+                decode_dur = time.perf_counter() - started
+                if context.sent is not None:
+                    # wire transit (engine-clock seconds — virtual in
+                    # deterministic runs, deliberately: injected chaos
+                    # delays show up here), recordable only when sender
+                    # and receiver clocks are comparable; the span ENDS
+                    # at arrival, so decode/queue/process follow it
+                    transit = now - context.sent
+                    if 0.0 <= transit <= tracing.CLOCK_COMPARABLE_HORIZON:
+                        trc.record("deliver", started - transit, transit,
+                                   context=context, cat="wire",
+                                   proc=self.name,
+                                   span_id=tracing.new_span_id(),
+                                   args={"command": command})
+                trc.record("decode", started, decode_dur,
+                           context=context, cat="wire", proc=self.name,
+                           span_id=tracing.new_span_id(),
+                           args={"command": command})
         if command:
-            self._post_message(command, params)
+            self._post_message(command, params, trace=context)
 
-    def _post_message(self, command: str, arguments) -> None:
+    def _post_message(self, command: str, arguments, trace=None) -> None:
         mailbox = self._mailbox_control if command.startswith("control_") \
             else self._mailbox_in
         self.runtime.event.mailbox_put(
-            mailbox, ActorMessage(self, command, arguments))
+            mailbox, ActorMessage(self, command, arguments, trace=trace))
 
-    def _mailbox_handler(self, _name, message, _put_time) -> None:
+    def _mailbox_handler(self, _name, message, put_time) -> None:
+        trc = tracing.tracer
+        if trc.enabled and message.trace is not None:
+            # mailbox dwell: engine-clock put → drain (the "queue" hop).
+            # Duration is engine-clock seconds — virtual in
+            # deterministic runs, on purpose: the dwell the scheduler
+            # imposed is the signal, not the wall time of the drain.
+            # The span ENDS at the drain, like deliver ends at arrival.
+            waited = max(0.0, self.runtime.event.clock.now() - put_time)
+            now = time.perf_counter()
+            trc.record("queue", now - waited, waited,
+                       context=message.trace, cat="wire", proc=self.name,
+                       span_id=tracing.new_span_id(),
+                       args={"command": message.command})
         message.invoke(self.logger)
 
     # -- local deferred invocation (used by pipelines, tests) --------------
@@ -177,15 +225,35 @@ def get_remote_proxy(runtime, topic_in: str, protocol_class,
     ndarray/bytes values, the call ships as a binary wire envelope
     instead of text — tensors cross without a text round-trip.
     codec_hints ({dict_key: codec}) opts named arrays into a lossy wire
-    codec (see transport/wire.py)."""
+    codec (see transport/wire.py).
+
+    An ambient trace context (observe/tracing.py) at call time rides
+    the wire — envelope header on binary transports, trailing sexpr
+    marker on text — so the receiving actor's dispatch inherits the
+    caller's trace id and remaining deadline."""
     proxy = _RemoteProxy(runtime, topic_in)
     for method_name in get_public_methods(protocol_class):
         def remote_call(*args, _name=method_name, **kwargs):
             if kwargs:
                 raise TypeError("remote calls are positional-only")
-            runtime.publish(topic_in, wire.encode_rpc(
+            context = tracing.current_trace()
+            trace_fields = None
+            if context is not None:
+                trace_fields = context.to_fields(
+                    runtime.event.clock.now())
+            started = time.perf_counter()
+            payload = wire.encode_rpc(
                 _name, list(args), transport=runtime.message,
-                codec_hints=codec_hints))
+                codec_hints=codec_hints, trace=trace_fields)
+            trc = tracing.tracer
+            if trc.enabled and context is not None:
+                trc.record("encode", started,
+                           time.perf_counter() - started,
+                           context=context, cat="wire",
+                           proc=getattr(runtime, "name", ""),
+                           span_id=tracing.new_span_id(),
+                           args={"command": _name})
+            runtime.publish(topic_in, payload)
         setattr(proxy, method_name, remote_call)
     return proxy
 

@@ -23,6 +23,12 @@
 #                         (delivery can re-enter or block under the lock)
 #   lint-jit-hot          jax.jit in per-frame code (a recompile per
 #                         frame-shape: the classic serving latency cliff)
+#   lint-print            bare print( in package (non-test) modules:
+#                         telemetry must flow through utils.logger or
+#                         the observe metrics registry, where it is
+#                         levelled, routable, and exportable — stdout
+#                         is none of those (CLIs and deliberate console
+#                         tools carry per-line waivers)
 #
 # Waivers: a line (or its enclosing statement's first line) containing
 # `graft: disable=<rule-id>` (or `graft: disable=all`) suppresses that
@@ -38,7 +44,7 @@ from .findings import ERROR, Finding
 __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
-              "lint-publish-locked", "lint-jit-hot")
+              "lint-publish-locked", "lint-jit-hot", "lint-print")
 
 _HANDLER_REGISTRARS = {
     "add_timer_handler", "add_oneshot_handler", "add_mailbox_handler",
@@ -170,6 +176,14 @@ class _Linter(ast.NodeVisitor):
 
     # -- module-wide rules -------------------------------------------------
     def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id == "print" \
+                and not self.is_test:
+            self.report(
+                "lint-print", node,
+                "bare print( in package module: route telemetry "
+                "through utils.logger / the observe metrics registry "
+                "(deliberate console output carries a "
+                "`graft: disable=lint-print` waiver)")
         if ast.unparse(node.func) == "threading.Lock":
             self.report(
                 "lint-raw-lock", node,

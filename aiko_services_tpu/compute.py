@@ -200,7 +200,8 @@ class ComputeRuntime(Actor):
                                       max_batch=max_batch,
                                       max_wait=max_wait,
                                       clock=self.runtime.event.clock.now,
-                                      dispatch_gate=gate)
+                                      dispatch_gate=gate,
+                                      metrics_labels={"program": name})
         from collections import deque
         program = CompiledProgram(name, fn, buckets, scheduler, {})
         program.in_flight = in_flight
@@ -274,10 +275,21 @@ class ComputeRuntime(Actor):
     def _publish_stats(self, name: str, scheduler) -> None:
         self.ec_producer.update(f"batch.{name}.batches",
                                 scheduler.stats["batches"])
-        self.ec_producer.update(f"batch.{name}.mean_size",
-                                round(scheduler.mean_batch_size(), 2))
+        mean_size = round(scheduler.mean_batch_size(), 2)
+        mean_wait_ms = round(scheduler.mean_wait() * 1000.0, 2)
+        self.ec_producer.update(f"batch.{name}.mean_size", mean_size)
         self.ec_producer.update(f"batch.{name}.mean_wait_ms",
-                                round(scheduler.mean_wait() * 1000.0, 2))
+                                mean_wait_ms)
+        # rolling levels beside the mirrored cumulative counters: the
+        # dashboard metrics pane and a Prometheus scrape both see them
+        from .observe.metrics import default_registry
+        registry = default_registry()
+        labels = {"program": name}
+        registry.gauge("batch_mean_size",
+                       "mean dispatched batch size", labels).set(mean_size)
+        registry.gauge("batch_mean_wait_ms",
+                       "mean batch-former queue wait",
+                       labels).set(mean_wait_ms)
 
     # -- placement ----------------------------------------------------------
     def place_params(self, params, param_axes, rules=None):

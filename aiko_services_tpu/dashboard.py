@@ -49,11 +49,14 @@ class DashboardState:
         self.runtime = runtime
         self.cache = ServicesCache(runtime)
         self.selected_index = 0
-        self.page = "services"          # services | variables | log | history
+        # services | variables | log | history | metrics
+        self.page = "services"
         self.share: dict = {}
         self._consumer = None
         self._log_topic = None
         self.log_lines: deque = deque(maxlen=_LOG_LIMIT)
+        self.metrics_doc: dict | None = None    # latest snapshot JSON
+        self._metrics_topic = None
         self.history_rows: list = []    # departed ServiceFields
         self._history_topic = None
         self._history_expected = None
@@ -122,6 +125,67 @@ class DashboardState:
             self.runtime.remove_message_handler(self._on_log,
                                                 self._log_topic)
             self._log_topic = None
+
+    # -- metrics pane (ISSUE 5) ---------------------------------------------
+    def open_metrics(self) -> None:
+        """Subscribe to the selected service's PROCESS metrics topic
+        ({namespace}/{host}/{pid}/0/metrics — retained snapshots from
+        observe.MetricsPublisher) and render the latest snapshot."""
+        fields = self.selected()
+        if fields is None:
+            return
+        self.close_metrics()
+        self.metrics_doc = None
+        process_path = fields.topic_path.rsplit("/", 1)[0]
+        from .observe.export import METRICS_TOPIC_SUFFIX
+        self._metrics_topic = f"{process_path}/{METRICS_TOPIC_SUFFIX}"
+        self.runtime.add_message_handler(self._on_metrics,
+                                         self._metrics_topic)
+        self.page = "metrics"
+
+    def _on_metrics(self, _topic, payload) -> None:
+        import json
+        try:
+            self.metrics_doc = json.loads(payload)
+        except (TypeError, ValueError):
+            pass
+
+    def close_metrics(self) -> None:
+        if self._metrics_topic is not None:
+            self.runtime.remove_message_handler(self._on_metrics,
+                                                self._metrics_topic)
+            self._metrics_topic = None
+
+    def metrics_lines(self) -> list:
+        """The metrics page body: the latest published snapshot as
+        aligned text rows (counters/gauges by series, histograms as
+        count / mean / approximate p50+p95 from bucket counts)."""
+        doc = self.metrics_doc
+        if not doc:
+            return ["waiting for a metrics snapshot on "
+                    f"{self._metrics_topic} ..."]
+        from .observe.export import series_key, series_quantile
+        lines = [f"process: {doc.get('process', '?')}  "
+                 f"time: {doc.get('time', '?')}"]
+        snapshot = doc.get("snapshot", {})
+        for name in sorted(snapshot):
+            entry = snapshot[name]
+            for series in entry.get("series", []):
+                shown = series_key(name, series.get("labels", {}))
+                if entry.get("type") == "histogram":
+                    count = series.get("count", 0)
+                    mean = (series.get("sum", 0.0) / count) if count \
+                        else 0.0
+                    p50 = series_quantile(series, 0.5)
+                    p95 = series_quantile(series, 0.95)
+                    lines.append(f"  {shown:46.46s} n={count} "
+                                 f"mean={mean * 1000.0:.2f}ms "
+                                 f"p50<={p50 * 1000.0:.2f}ms "
+                                 f"p95<={p95 * 1000.0:.2f}ms")
+                else:
+                    lines.append(f"  {shown:46.46s} "
+                                 f"{series.get('value', 0)}")
+        return lines
 
     # -- registrar history (reference: dashboard.py:279-509 history table) --
     def open_history(self, count: int = 64) -> None:
@@ -244,6 +308,7 @@ class DashboardState:
         self.close_consumer()
         self.close_log()
         self.close_history()
+        self.close_metrics()
         self.status = ""
         self.page = "services"
 
@@ -298,7 +363,7 @@ def _render(screen, state: DashboardState) -> None:
                     f"{fields.topic_path}")
             screen.addnstr(2 + row, 0, line, width - 1, attribute)
         footer = ("↑/↓ select · ⏎ variables · l log · h history · "
-                  "x kill · q quit")
+                  "m metrics · x kill · q quit")
     elif state.page == "variables":
         fields = state.selected()
         screen.addnstr(1, 0, f"share: {fields.name if fields else '?'}",
@@ -311,6 +376,12 @@ def _render(screen, state: DashboardState) -> None:
         for row, line in enumerate(rows[:height - 3]):
             screen.addnstr(2 + row, 0, line, width - 1)
         footer = "d/i/w/e log-level · b back · q quit"
+    elif state.page == "metrics":
+        screen.addnstr(1, 0, f"metrics: {state._metrics_topic}",
+                       width - 1, curses.A_BOLD)
+        for row, line in enumerate(state.metrics_lines()[:height - 3]):
+            screen.addnstr(2 + row, 0, line, width - 1)
+        footer = "b back · q quit"
     elif state.page == "history":
         header = f"{'DEPARTED SERVICE':32.32s} {'PROTOCOL':24.24s} TOPIC"
         screen.addnstr(1, 0, header, width - 1, curses.A_BOLD)
@@ -364,6 +435,8 @@ def run_dashboard(runtime, tick: float = 0.05) -> None:
                 state.open_log()
             elif key == ord("h") and state.page == "services":
                 state.open_history()
+            elif key == ord("m") and state.page == "services":
+                state.open_metrics()
             elif key == ord("x") and state.page == "services":
                 state.kill_selected()
             elif key == ord("c"):

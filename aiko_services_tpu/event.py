@@ -27,6 +27,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .observe.metrics import default_registry
+
 __all__ = [
     "Clock", "RealClock", "VirtualClock", "EventEngine", "default_engine",
     "add_timer_handler", "remove_timer_handler",
@@ -55,6 +57,23 @@ def _slow_handler_threshold() -> float:
 
 
 SLOW_HANDLER_SECONDS = _slow_handler_threshold()
+
+# Event-loop health on the process-wide metrics registry (ISSUE 5):
+# the runtime counterpart of the AIKO_EVENT_CHECK watchdog — handler
+# latency is ALWAYS histogrammed (cheap: two perf_counter reads + a
+# short bucket scan per handler), the slow-handler counter feeds the
+# per-rung budget calibration the watchdog's log line can't, and the
+# mailbox-depth gauge exposes the backlog each scheduler step drains.
+_registry = default_registry()
+_HANDLER_SECONDS = _registry.histogram(
+    "event_handler_seconds",
+    "wall time per event-engine handler invocation")
+_SLOW_HANDLERS = _registry.counter(
+    "event_slow_handlers_total",
+    "handlers that blocked the loop past AIKO_EVENT_CHECK")
+_MAILBOX_DEPTH = _registry.gauge(
+    "event_mailbox_depth",
+    "items pending across all mailboxes at scheduler-step start")
 
 
 class Clock:
@@ -235,21 +254,22 @@ class EventEngine:
         AIKO_EVENT_CHECK set, handlers that BLOCK the loop past the
         threshold are reported too (wall time: the loop is stalled for
         real regardless of which clock the engine schedules by)."""
-        started = time.perf_counter() if SLOW_HANDLER_SECONDS else 0.0
+        started = time.perf_counter()
         try:
             handler(*args)
         except Exception:
             _logger.exception("event handler %r raised",
                               getattr(handler, "__qualname__", handler))
-        if SLOW_HANDLER_SECONDS:
-            elapsed = time.perf_counter() - started
-            if elapsed > SLOW_HANDLER_SECONDS:
-                _logger.warning(
-                    "event handler %r blocked the loop for %.3fs "
-                    "(threshold %.3fs; every pipeline in this process "
-                    "stalled meanwhile)",
-                    getattr(handler, "__qualname__", handler), elapsed,
-                    SLOW_HANDLER_SECONDS)
+        elapsed = time.perf_counter() - started
+        _HANDLER_SECONDS.observe(elapsed)
+        if SLOW_HANDLER_SECONDS and elapsed > SLOW_HANDLER_SECONDS:
+            _SLOW_HANDLERS.inc()
+            _logger.warning(
+                "event handler %r blocked the loop for %.3fs "
+                "(threshold %.3fs; every pipeline in this process "
+                "stalled meanwhile)",
+                getattr(handler, "__qualname__", handler), elapsed,
+                SLOW_HANDLER_SECONDS)
 
     def step(self) -> bool:
         """Run one scheduler iteration.  Returns True if any work was done."""
@@ -292,6 +312,7 @@ class EventEngine:
         # next iteration once the budget is spent).
         with self._lock:
             budget = sum(len(m.items) for m in self._mailboxes.values())
+        _MAILBOX_DEPTH.set(budget)
         while budget > 0:
             with self._lock:
                 target = None

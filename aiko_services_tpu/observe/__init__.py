@@ -1,0 +1,19 @@
+# observe: the telemetry layer — metrics registry, distributed tracing
+# with deadline propagation, and exporters (ISSUE 5).
+#
+# Near-leaf on purpose: transport, event, and pipeline all record into
+# this package, so it must sit BELOW them in the import graph — the
+# only framework import allowed here is utils (itself a leaf).
+
+from .metrics import (                                      # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, MirroredStats,
+    DEFAULT_LATENCY_BUCKETS, default_registry, log_buckets,
+)
+from .tracing import (                                      # noqa: F401
+    TRACE_MARKER, SpanRecord, TraceContext, Tracer, activate,
+    current_trace, new_trace, tracer,
+)
+from .export import (                                       # noqa: F401
+    METRICS_TOPIC_SUFFIX, MetricsPublisher, chrome_trace,
+    dump_chrome_trace, render_prometheus, series_key, series_quantile,
+)

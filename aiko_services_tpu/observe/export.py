@@ -1,0 +1,180 @@
+# Telemetry export: Prometheus text, Chrome trace events, live publish.
+#
+#   * render_prometheus — the registry snapshot in Prometheus text
+#     exposition format (scrape it from a file, a debug endpoint, or
+#     the published control-plane snapshot);
+#   * chrome_trace / dump_chrome_trace — the Tracer's span buffer as a
+#     Chrome trace-event JSON document (load in Perfetto / about:tracing
+#     to see a frame's hops, retries, and serving spans on a timeline);
+#   * MetricsPublisher — periodic retained snapshot on a control-plane
+#     topic ({topic_path}/0/metrics, beside the process state topic), so
+#     the dashboard's metrics pane and any late-joining scraper see the
+#     latest numbers without asking.
+
+from __future__ import annotations
+
+import json
+
+from .metrics import Histogram, MetricsRegistry, default_registry
+from .tracing import Tracer, tracer as _global_tracer
+
+__all__ = [
+    "render_prometheus", "chrome_trace", "dump_chrome_trace",
+    "MetricsPublisher", "METRICS_TOPIC_SUFFIX", "series_key",
+    "series_quantile",
+]
+
+METRICS_TOPIC_SUFFIX = "0/metrics"
+
+
+def series_key(name: str, labels: dict) -> str:
+    """Display key 'name{k=v,...}' for one snapshot series — the shared
+    flattening used by the soak report and the dashboard pane (plain
+    join, no escaping; Prometheus exposition has its own _label_text)."""
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}" if inner else name
+
+
+def series_quantile(series: dict, q: float) -> float:
+    """Approximate quantile from one snapshot histogram series
+    (bounds/counts/count as emitted by MetricsRegistry.snapshot()),
+    mirroring Histogram.quantile: the upper bound of the bucket holding
+    the q-th observation; diagnostic-grade."""
+    count = series.get("count", 0)
+    bounds = series.get("bounds") or []
+    if not count or not bounds:
+        return 0.0
+    target = q * count
+    running = 0
+    for index, bucket_count in enumerate(series.get("counts", [])):
+        running += bucket_count
+        if running >= target:
+            return bounds[min(index, len(bounds) - 1)]
+    return bounds[-1]
+
+
+def _escape(value: str) -> str:
+    return str(value).replace("\\", r"\\").replace("\n", r"\n") \
+        .replace('"', r'\"')
+
+
+def _label_text(labels: dict, extra: dict | None = None) -> str:
+    merged = {**labels, **(extra or {})}
+    if not merged:
+        return ""
+    inner = ",".join(f'{key}="{_escape(value)}"'
+                     for key, value in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _format_value(value) -> str:
+    if isinstance(value, float):
+        return repr(value)
+    return str(value)
+
+
+def render_prometheus(registry: MetricsRegistry | None = None) -> str:
+    """The registry in Prometheus text exposition format (v0.0.4)."""
+    snapshot = (registry or default_registry()).snapshot()
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        entry = snapshot[name]
+        if entry["help"]:
+            lines.append(f"# HELP {name} {entry['help']}")
+        lines.append(f"# TYPE {name} {entry['type']}")
+        for series in entry["series"]:
+            labels = series["labels"]
+            if entry["type"] == "histogram":
+                cumulative = 0
+                for bound, count in zip(series["bounds"],
+                                        series["counts"]):
+                    cumulative += count
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_label_text(labels, {'le': repr(bound)})} "
+                        f"{cumulative}")
+                lines.append(
+                    f"{name}_bucket{_label_text(labels, {'le': '+Inf'})} "
+                    f"{series['count']}")
+                lines.append(f"{name}_sum{_label_text(labels)} "
+                             f"{_format_value(series['sum'])}")
+                lines.append(f"{name}_count{_label_text(labels)} "
+                             f"{series['count']}")
+            else:
+                lines.append(f"{name}{_label_text(labels)} "
+                             f"{_format_value(series['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def chrome_trace(trace_source: Tracer | None = None) -> dict:
+    """The tracer's span buffer as a Chrome trace-event document
+    (Perfetto-loadable JSON: complete "X" events, µs timestamps, one
+    pid per recording process name, trace/span ids in args)."""
+    source = trace_source or _global_tracer
+    pids: dict[str, int] = {}
+    events: list[dict] = []
+    for span in list(source.spans):
+        proc = span.proc or "aiko"
+        pid = pids.get(proc)
+        if pid is None:
+            pid = pids[proc] = len(pids) + 1
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": proc}})
+        args = {"trace_id": span.trace_id, "span_id": span.span_id,
+                "parent_id": span.parent_id}
+        args.update(span.args)
+        events.append({
+            "name": span.name, "cat": span.cat or "span", "ph": "X",
+            "ts": round(span.ts * 1e6, 3),
+            "dur": max(round(span.dur * 1e6, 3), 0.001),
+            "pid": pid, "tid": 1, "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump_chrome_trace(pathname, trace_source: Tracer | None = None) -> str:
+    """Write the Chrome trace-event document to `pathname`."""
+    document = chrome_trace(trace_source)
+    with open(pathname, "w", encoding="utf-8") as f:
+        json.dump(document, f)
+    return str(pathname)
+
+
+class MetricsPublisher:
+    """Periodic retained metrics snapshots on a control-plane topic.
+
+    Publishes {"process", "topic_path", "time", "snapshot"} as JSON to
+    {runtime.topic_path}/0/metrics every `interval` seconds (engine
+    timer, so virtual-clock tests drive it deterministically).  Retained
+    by default: a dashboard opening the pane later still sees the last
+    snapshot, like the process state topic."""
+
+    def __init__(self, runtime, interval: float = 5.0,
+                 topic: str | None = None,
+                 registry: MetricsRegistry | None = None,
+                 retain: bool = True):
+        self.runtime = runtime
+        self.registry = registry or default_registry()
+        self.topic = topic or \
+            f"{runtime.topic_path}/{METRICS_TOPIC_SUFFIX}"
+        self.retain = retain
+        self.interval = interval
+        self._timer = runtime.event.add_timer_handler(self.publish_now,
+                                                      interval)
+
+    def publish_now(self) -> None:
+        document = {
+            "process": self.runtime.name,
+            "topic_path": self.runtime.topic_path,
+            "time": self.runtime.event.clock.now(),
+            "snapshot": self.registry.snapshot(),
+        }
+        self.runtime.publish(self.topic,
+                             json.dumps(document, default=str),
+                             retain=self.retain)
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self.runtime.event.remove_timer_handler(self._timer)
+            self._timer = None

@@ -66,7 +66,8 @@ class BatchingScheduler:
 
     def __init__(self, process_batch, buckets: ShapeBuckets,
                  max_batch: int = 32, max_wait: float = 0.05,
-                 clock=time.monotonic, dispatch_gate=None):
+                 clock=time.monotonic, dispatch_gate=None,
+                 metrics_labels: dict | None = None):
         self.process_batch = process_batch
         self.buckets = buckets
         self.max_batch = max_batch
@@ -83,9 +84,19 @@ class BatchingScheduler:
         # fed back by the owner via observe_service_time(): the
         # deadline-at-risk test needs to know how long a batch takes
         self._service_ewma: dict[int, float] = {}
-        self.stats = {"batches": 0, "items": 0, "batch_size_sum": 0,
-                      "full_batches": 0, "wait_sum": 0.0,
-                      "gated": 0, "deadline_dispatches": 0}
+        # cumulative counters, mirrored onto the process metrics
+        # registry (batch_scheduler_total{kind=...}); metrics_labels
+        # (e.g. {"program": name}) separates schedulers per series
+        from ..observe.metrics import MirroredStats
+        self.stats = MirroredStats(
+            {"batches": 0, "items": 0, "batch_size_sum": 0,
+             "full_batches": 0, "wait_sum": 0.0,
+             "gated": 0, "deadline_dispatches": 0},
+            metric="batch_scheduler_total",
+            help="continuous-batching scheduler events by kind",
+            labels=metrics_labels,
+            # sums are levels, not events — dict-only (see serving.py)
+            skip=("batch_size_sum", "wait_sum"))
         # rolling queue-wait samples (seconds) for percentile reporting
         self.recent_waits: deque = deque(maxlen=4096)
 

@@ -44,6 +44,8 @@ from typing import Any, Callable
 
 from .actor import Actor, get_remote_proxy
 from .lease import Lease
+from .observe import tracing
+from .observe.metrics import MirroredStats, default_registry
 from .service import ServiceFilter, ServiceProtocol
 from .share import ServicesCache
 from .transport import wire
@@ -355,6 +357,10 @@ class Frame:
     reply_skip: dict | None = None      # original remote inputs: values
                                         # still identical at reply time
                                         # are not echoed back
+    # distributed trace position + end-to-end deadline (ISSUE 5): set
+    # from the ambient context (remote frames arrive under the caller's
+    # context) or minted fresh when the pipeline has a frame_deadline
+    trace: "tracing.TraceContext | None" = None
 
     @property
     def stream_id(self) -> str:
@@ -505,6 +511,12 @@ class _PendingHop:
     sent: bool = False              # a request copy is in flight
     sent_to: str | None = None      # candidate the last copy shipped to
     resend_timer: int | None = None
+    # the hop's child trace context (trace id + inherited deadline);
+    # every attempt's wire copy carries it, retries re-serialize it with
+    # the SHRUNK remaining budget
+    trace: "tracing.TraceContext | None" = None
+    hop_started: float = 0.0        # perf_counter at hop creation
+    attempt_started: float = 0.0    # perf_counter at last wire send
 
     def cancel(self, engine) -> None:
         if self.lease is not None:
@@ -565,7 +577,8 @@ class Pipeline(PipelineElement):
                  remote_backoff_max: float = 4.0,
                  retry_jitter: float = 0.25,
                  retry_seed: int | None = None,
-                 stream_failure_budget: int = 1):
+                 stream_failure_budget: int = 1,
+                 frame_deadline: float = 0.0):
         self._element_classes = element_classes or {}
         self.graph = PipelineGraph.from_definition(definition)
         self.graph.validate(definition)
@@ -616,12 +629,45 @@ class Pipeline(PipelineElement):
         # stream_failure_budget consecutive frame failures stop a stream
         # (1 = legacy: first failure destroys it)
         self.stream_failure_budget = max(1, int(stream_failure_budget))
-        self.recovery_stats = {
+        # frame_deadline > 0 stamps every NEW frame with an end-to-end
+        # deadline (engine-clock seconds): remote hops propagate it,
+        # retry backoff is clamped to what remains, and an exhausted
+        # budget fails the frame fast — charged to the stream failure
+        # budget like any other frame failure (ISSUE 5)
+        self.frame_deadline = max(0.0, float(frame_deadline))
+        # ad-hoc dict preserved for existing readers; increments mirror
+        # into the process-wide metrics registry (observe/metrics.py)
+        self.recovery_stats = MirroredStats({
             "retries": 0, "failovers": 0, "dup_replies": 0,
             "dup_requests": 0, "replayed_replies": 0,
             "frames_failed": 0, "streams_stopped": 0,
-            "one_way_shed": 0,
+            "one_way_shed": 0, "deadline_exceeded": 0,
+            "deadline_rejected": 0,
+        }, metric="pipeline_recovery_total",
+            help="pipeline recovery machinery events by kind",
+            labels={"pipeline": self.name})
+        registry = default_registry()
+        wire_help = "wire envelopes shipped by the remote-hop data plane"
+        self._wire_counters = {
+            "request_envelopes": registry.counter(
+                "pipeline_wire_envelopes_total", wire_help,
+                labels={"pipeline": self.name, "direction": "request"}),
+            "request_frames": registry.counter(
+                "pipeline_wire_frames_total",
+                "frames carried inside wire envelopes",
+                labels={"pipeline": self.name, "direction": "request"}),
+            "reply_envelopes": registry.counter(
+                "pipeline_wire_envelopes_total", wire_help,
+                labels={"pipeline": self.name, "direction": "reply"}),
+            "reply_frames": registry.counter(
+                "pipeline_wire_frames_total",
+                "frames carried inside wire envelopes",
+                labels={"pipeline": self.name, "direction": "reply"}),
         }
+        self._hop_seconds = registry.histogram(
+            "pipeline_hop_seconds",
+            "remote request/response hop latency (send to reply)",
+            labels={"pipeline": self.name})
         self._retired_hops: dict[str, bool] = {}    # reply dedup ring
         self._served_hops: dict = {}    # (reply_topic, hop_id) -> reply
         self._served_reply_bytes = 0    # aggregate pinned reply payload
@@ -843,7 +889,8 @@ class Pipeline(PipelineElement):
             child_swag = dict(parent.swag)
             child_swag.update(_kwargs)      # fan-in renamed inputs
             frame = Frame(stream=stream, frame_id=parent.frame_id,
-                          swag=child_swag, metrics=parent.metrics)
+                          swag=child_swag, metrics=parent.metrics,
+                          trace=parent.trace)
         else:
             stream = self.streams.get(str(frame_or_stream_id))
             if stream is None:
@@ -860,14 +907,30 @@ class Pipeline(PipelineElement):
                                         "stream %s dropped", self.name,
                                         frame_or_stream_id)
                     return FrameOutput(False, diagnostic="unknown stream")
+            # trace context: a remote frame arrives under its caller's
+            # activated context (process_frame_remote / the actor
+            # dispatch); a locally-sourced frame mints a fresh root —
+            # with this pipeline's end-to-end deadline when configured
+            context = tracing.current_trace()
+            if context is None and (self.frame_deadline > 0
+                                    or tracing.tracer.enabled):
+                deadline = None
+                if self.frame_deadline > 0:
+                    deadline = self.runtime.event.clock.now() + \
+                        self.frame_deadline
+                context = tracing.new_trace(deadline=deadline)
             frame = Frame(stream=stream, frame_id=stream.next_frame_id(),
                           swag=dict(swag or {}), reply_to=_reply_to,
-                          reply_skip=_reply_skip)
+                          reply_skip=_reply_skip, trace=context)
         if stream.lease is not None:
             stream.lease.extend()
 
         frame.metrics["time_pipeline_start"] = time.perf_counter()
-        return self._walk(frame, 0)
+        # the walk runs under the frame's trace context: elements,
+        # nested pipelines, remote proxies (envelope headers) and
+        # TraceCollector leaves all inherit it ambiently
+        with tracing.activate(frame.trace):
+            return self._walk(frame, 0)
 
     def resume_frame(self, frame: Frame, node_name: str,
                      outputs: dict | None) -> FrameOutput:
@@ -900,7 +963,8 @@ class Pipeline(PipelineElement):
         if outputs:
             self._merge_outputs(node, self._element_defs[node.name],
                                 outputs, frame.swag)
-        return self._walk(frame, index + 1)
+        with tracing.activate(frame.trace):
+            return self._walk(frame, index + 1)
 
     def _walk(self, frame: Frame, start_index: int) -> FrameOutput:
         swag = frame.swag
@@ -921,7 +985,9 @@ class Pipeline(PipelineElement):
                 ok, outputs = self._process_remote(element, frame,
                                                    inputs, node.name)
                 if not ok:
-                    diagnostic = "remote element absent"
+                    diagnostic = outputs if isinstance(outputs, str) \
+                        else "remote element absent"
+                    outputs = None
             else:
                 try:
                     result = element.process_frame(frame, **inputs)
@@ -1044,16 +1110,29 @@ class Pipeline(PipelineElement):
             return True, {}
         if not placeholder.found and not self._recovery_enabled:
             return False, None
+        # hop trace context: child of the frame's context, inheriting
+        # the end-to-end deadline.  A frame whose budget is ALREADY
+        # spent fails fast here — no send, no retry, the failure
+        # charged to the stream budget like any other frame failure
+        hop_trace = frame.trace.child() if frame.trace is not None \
+            else None
+        now = self.runtime.event.clock.now()
+        if hop_trace is not None and hop_trace.expired(now):
+            self.recovery_stats["deadline_exceeded"] += 1
+            return False, (f"deadline exceeded before remote hop "
+                           f"{node_name} (budget spent "
+                           f"{-hop_trace.remaining(now):.3f}s ago)")
         hop_id = (f"{self.name}.{self._hop_nonce}"
                   f".{next(self._hop_counter)}")
         # keep the sent inputs: the serving side elides identity
         # passthroughs from its reply (no point echoing the payload),
         # so the resume re-merges them from here when declared
         pending = _PendingHop(frame=frame, node_name=node_name,
-                              inputs=inputs)
+                              inputs=inputs, trace=hop_trace,
+                              hop_started=time.perf_counter())
         self._pending_remote[hop_id] = pending
         self._arm_hop_lease(pending, hop_id)
-        entry = [frame.stream_id, inputs, self.topic_in, hop_id]
+        entry = self._hop_entry(pending, hop_id)
         if placeholder.found:
             self._queue_remote(placeholder, entry, one_way=False)
         else:
@@ -1061,11 +1140,31 @@ class Pipeline(PipelineElement):
             self._buffer_entry(placeholder, entry, one_way=False)
         return True, DEFERRED
 
+    def _hop_entry(self, pending: _PendingHop, hop_id: str) -> list:
+        """The wire entry for one request hop.  The trace context is
+        re-serialized per send, so a retry carries the SHRUNK remaining
+        budget, not the original one."""
+        entry = [pending.frame.stream_id, pending.inputs, self.topic_in,
+                 hop_id]
+        if pending.trace is not None:
+            entry.append(pending.trace.to_fields(
+                self.runtime.event.clock.now()))
+        return entry
+
     def _arm_hop_lease(self, pending: _PendingHop, hop_id: str) -> None:
         if pending.lease is not None:
             pending.lease.cancel()
+        timeout = self.remote_timeout
+        if pending.trace is not None:
+            remaining = pending.trace.remaining(
+                self.runtime.event.clock.now())
+            if remaining is not None:
+                # the timeout lease never outlives the frame's deadline:
+                # a hop with 0.3 s of budget left times out (and gets
+                # its fail-fast verdict) at 0.3 s, not remote_timeout
+                timeout = max(0.01, min(timeout, remaining))
         pending.lease = Lease(
-            self.runtime.event, self.remote_timeout, hop_id,
+            self.runtime.event, timeout, hop_id,
             lease_expired_handler=self._remote_hop_expired)
 
     def _purge_buffered_hop(self, node_name: str, hop_id: str) -> None:
@@ -1169,16 +1268,22 @@ class Pipeline(PipelineElement):
         request = [entry for entry, ow in entries
                    if not ow and entry[3] in self._pending_remote]
         if one_way:
+            self._wire_counters["request_envelopes"].inc()
+            self._wire_counters["request_frames"].inc(len(one_way))
             if len(one_way) == 1:
                 placeholder.proxy.process_frame(*one_way[0])
             else:
                 placeholder.proxy.process_frames(one_way)
         if request:
+            sent_at = time.perf_counter()
             for entry in request:
                 hop = self._pending_remote[entry[3]]
                 hop.sent = True
                 hop.sent_to = placeholder.topic_path
+                hop.attempt_started = sent_at
             placeholder.outstanding += len(request)
+            self._wire_counters["request_envelopes"].inc()
+            self._wire_counters["request_frames"].inc(len(request))
             if len(request) == 1:
                 placeholder.proxy.process_frame_remote(*request[0])
             else:
@@ -1203,16 +1308,28 @@ class Pipeline(PipelineElement):
         if pending.sent:
             pending.sent = False
             self._hop_settled(pending.node_name)
+        self._record_attempt_span(pending, hop_id, "timeout")
+        budget = None
+        if pending.trace is not None:
+            budget = pending.trace.remaining(
+                self.runtime.event.clock.now())
         if pending.attempts < self.remote_retries:
             # bounded retry: exponential backoff + seeded jitter, and
             # rotate to another discovered candidate first — a timeout
             # against a wedged service recovers via its peer
-            pending.attempts += 1
-            self.recovery_stats["retries"] += 1
             delay = jittered_backoff(
-                self.remote_backoff, pending.attempts,
+                self.remote_backoff, pending.attempts + 1,
                 self.remote_backoff_max, self.retry_jitter,
                 self._retry_rng)
+            if budget is not None and budget <= delay:
+                # deadline propagation (ISSUE 5): the backoff would
+                # land past the frame's end-to-end SLO — never schedule
+                # a retry past the budget; fail fast instead, charged
+                # to the stream failure budget below
+                self._fail_hop_deadline(pending, hop_id, budget, delay)
+                return
+            pending.attempts += 1
+            self.recovery_stats["retries"] += 1
             placeholder = self._remote.get(pending.node_name)
             if placeholder is None or pending.sent_to is None \
                     or pending.sent_to == placeholder.topic_path:
@@ -1224,14 +1341,71 @@ class Pipeline(PipelineElement):
             pending.resend_timer = self.runtime.event.add_oneshot_handler(
                 lambda: self._resend_hop(hop_id), delay)
             return
+        if budget is not None and budget <= 0:
+            self._fail_hop_deadline(pending, hop_id, budget, 0.0)
+            return
         self._pending_remote.pop(hop_id, None)
         self._retire_hop(hop_id)
         self._purge_buffered_hop(pending.node_name, hop_id)
+        self._record_hop_span(pending, hop_id, "timeout")
         detail = f" after {pending.attempts} retries" \
             if pending.attempts else ""
         self.resume_frame(pending.frame, pending.node_name, TimeoutError(
             f"remote element {pending.node_name}: no reply within "
             f"{self.remote_timeout}s{detail}"))
+
+    def _fail_hop_deadline(self, pending: _PendingHop, hop_id: str,
+                           budget: float, delay: float) -> None:
+        """Retire a hop whose end-to-end deadline budget is exhausted:
+        fail the frame fast with a diagnostic instead of retrying past
+        the SLO.  The failure flows through resume_frame → _fail_frame,
+        so it is charged to the stream failure budget."""
+        self._pending_remote.pop(hop_id, None)
+        self.recovery_stats["deadline_exceeded"] += 1
+        self._retire_hop(hop_id)
+        self._purge_buffered_hop(pending.node_name, hop_id)
+        self._record_hop_span(pending, hop_id, "deadline")
+        if delay > 0:
+            detail = (f"remaining budget {max(budget, 0.0):.3f}s < "
+                      f"next backoff {delay:.3f}s")
+        else:
+            detail = f"remaining budget {max(budget, 0.0):.3f}s"
+        self.resume_frame(pending.frame, pending.node_name, TimeoutError(
+            f"remote element {pending.node_name}: deadline exhausted "
+            f"after {pending.attempts} retries ({detail})"))
+
+    # -- hop span recording (tracer-gated, ISSUE 5) -------------------------
+    def _record_attempt_span(self, pending: _PendingHop, hop_id: str,
+                             outcome: str) -> None:
+        """One wire attempt settled (reply, or timeout before retry)."""
+        trc = tracing.tracer
+        if not trc.enabled or pending.trace is None \
+                or not pending.attempt_started:
+            return
+        now = time.perf_counter()
+        trc.record(f"hop_attempt:{pending.node_name}",
+                   pending.attempt_started, now - pending.attempt_started,
+                   context=pending.trace, cat="hop", proc=self.name,
+                   span_id=tracing.new_span_id(),
+                   args={"hop_id": hop_id, "attempt": pending.attempts,
+                         "outcome": outcome,
+                         "sent_to": pending.sent_to or ""})
+        pending.attempt_started = 0.0
+
+    def _record_hop_span(self, pending: _PendingHop, hop_id: str,
+                         outcome: str) -> None:
+        """The whole request/response hop settled (every exit path)."""
+        duration = time.perf_counter() - pending.hop_started \
+            if pending.hop_started else 0.0
+        self._hop_seconds.observe(duration)
+        trc = tracing.tracer
+        if not trc.enabled or pending.trace is None:
+            return
+        trc.record(f"hop:{pending.node_name}", pending.hop_started,
+                   duration, context=pending.trace, cat="hop",
+                   proc=self.name,
+                   args={"hop_id": hop_id, "attempts": pending.attempts,
+                         "outcome": outcome})
 
     def _rotate_candidate(self, node_name: str) -> None:
         """Advance a remote node to its next discovered candidate (no-op
@@ -1269,8 +1443,7 @@ class Pipeline(PipelineElement):
         self._arm_hop_lease(pending, hop_id)
         # drop any still-buffered copy of this hop before re-queueing
         self._purge_buffered_hop(pending.node_name, hop_id)
-        entry = [pending.frame.stream_id, pending.inputs, self.topic_in,
-                 hop_id]
+        entry = self._hop_entry(pending, hop_id)
         if pending.sent:
             # the in-flight copy is being superseded; release its slot
             pending.sent = False
@@ -1314,7 +1487,11 @@ class Pipeline(PipelineElement):
         self._retire_hop(hop_id)
         if was_sent:
             self._hop_settled(node_name)
-        if str(ok) not in ("true", "True"):
+        replied_ok = str(ok) in ("true", "True")
+        outcome = "ok" if replied_ok else "failed"
+        self._record_attempt_span(pending, hop_id, outcome)
+        self._record_hop_span(pending, hop_id, outcome)
+        if not replied_ok:
             self.resume_frame(frame, node_name, RuntimeError(
                 f"remote element {node_name} failed: {outputs!r}"))
             return
@@ -1331,7 +1508,8 @@ class Pipeline(PipelineElement):
             if isinstance(entry, (list, tuple)) and len(entry) >= 2:
                 self.resume_remote_frame(*entry[:4])
 
-    def process_frame_remote(self, stream_id, inputs, reply_topic, hop_id):
+    def process_frame_remote(self, stream_id, inputs, reply_topic, hop_id,
+                             trace=None):
         """Serving entry: walk a frame for a remote caller and reply with
         the final swag when it completes (including through DEFERRED
         elements).
@@ -1340,7 +1518,13 @@ class Pipeline(PipelineElement):
         the same hop twice: the first request walks, a duplicate while
         the walk is still running is skipped (its reply goes out when
         the walk completes), and a duplicate of a COMPLETED hop replays
-        the cached reply — the original may have been lost on the wire."""
+        the cached reply — the original may have been lost on the wire.
+
+        `trace` (optional trailing entry field) is the caller's hop
+        trace context: the walk runs under it — its spans share the
+        caller's trace id — and a request arriving with its deadline
+        budget already spent is rejected fast instead of walked (the
+        caller has, by definition, stopped waiting)."""
         key = (str(reply_topic), str(hop_id))
         if key in self._served_hops:
             self.recovery_stats["dup_requests"] += 1
@@ -1348,6 +1532,9 @@ class Pipeline(PipelineElement):
             if cached is not None:
                 self._replay_reply(cached)
             return
+        now = self.runtime.event.clock.now()
+        context = tracing.TraceContext.from_fields(trace, now) \
+            if trace is not None else tracing.current_trace()
         self._served_hops[key] = None       # walk in progress
         while len(self._served_hops) > _SERVED_HOP_CAP:
             # evict oldest COMPLETED entry: an in-progress (None) entry
@@ -1358,12 +1545,21 @@ class Pipeline(PipelineElement):
             if stale is None:
                 break
             self._served_reply_bytes -= self._served_hops.pop(stale)[3]
+        if context is not None and context.expired(now):
+            # the failure reply is cached in the dedup ring, so a
+            # duplicate of this dead request replays the verdict
+            self.recovery_stats["deadline_rejected"] += 1
+            self._shim_failure_reply(
+                key, stream_id,
+                f"deadline exceeded before processing (hop {hop_id})")
+            return
         inputs = dict(inputs or {})
         try:
-            result = self.process_frame(stream_id, inputs,
-                                        _reply_to=(str(reply_topic),
-                                                   str(hop_id)),
-                                        _reply_skip=inputs)
+            with tracing.activate(context):
+                result = self.process_frame(stream_id, inputs,
+                                            _reply_to=(str(reply_topic),
+                                                       str(hop_id)),
+                                            _reply_skip=inputs)
         except Exception as exc:
             self._shim_failure_reply(key, stream_id, repr(exc))
             raise
@@ -1431,10 +1627,12 @@ class Pipeline(PipelineElement):
 
     def process_frames_remote(self, entries):
         """Coalesced request/response entry: one envelope, many
-        (stream_id, inputs, reply_topic, hop_id) frames."""
+        (stream_id, inputs, reply_topic, hop_id[, trace]) frames —
+        each frame's OWN trace context rides its entry, so coalescing
+        never mixes trace ids or deadlines."""
         for entry in entries or []:
             if isinstance(entry, (list, tuple)) and len(entry) >= 4:
-                self.process_frame_remote(*entry[:4])
+                self.process_frame_remote(*entry[:5])
 
     def _fail_frame(self, frame, node_name, diagnostic) -> None:
         self.logger.error("pipeline %s stream %s frame %s: element %s "
@@ -1468,6 +1666,17 @@ class Pipeline(PipelineElement):
     def _send_remote_reply(self, frame, ok: bool, outputs: dict) -> None:
         import numpy as _np
         topic, hop_id = frame.reply_to
+        trc = tracing.tracer
+        if trc.enabled and frame.trace is not None:
+            # the serving-side "process" span: walk start → reply out
+            # (DEFERRED parking included), child of the caller's hop
+            now = time.perf_counter()
+            started = frame.metrics.get("time_pipeline_start", now)
+            trc.record("process", started, now - started,
+                       context=frame.trace, cat="serving",
+                       proc=self.name, span_id=tracing.new_span_id(),
+                       args={"hop_id": str(hop_id), "ok": bool(ok),
+                             "stream": frame.stream_id})
         elided: list = []
         if frame.reply_skip:
             # don't echo untouched binary inputs back over the wire
@@ -1526,6 +1735,8 @@ class Pipeline(PipelineElement):
             else:
                 payload = wire.encode_envelope("resume_remote_frames",
                                                [entries])
+            self._wire_counters["reply_envelopes"].inc()
+            self._wire_counters["reply_frames"].inc(len(entries))
             self.runtime.publish(topic, payload)
 
     def stop(self) -> None:

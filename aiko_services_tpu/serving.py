@@ -639,12 +639,25 @@ class ContinuousDecoder:
         self._kv_bytes_per_t = (2 * config.num_layers * max_slots *
                                 config.num_kv_heads * config.head_dim *
                                 itemsize)
-        self.stats = {"steps": 0, "rounds": 0, "completed": 0,
-                      "prefills": 0, "occupancy_sum": 0.0,
-                      "prefill_s": 0.0, "decode_s": 0.0,
-                      "useful_steps": 0, "wasted_steps": 0,
-                      "bytes_moved": 0, "prefill_chunks": 0,
-                      "chunk_admits": 0, "round_prefill_tokens_max": 0}
+        # cumulative decode-loop counters, mirrored onto the process
+        # metrics registry (serving_decoder_total{kind=...}) so the
+        # bench and the dashboard metrics pane read the SAME numbers
+        # the decoder increments (ISSUE 5)
+        from .observe.metrics import MirroredStats
+        self.stats = MirroredStats(
+            {"steps": 0, "rounds": 0, "completed": 0,
+             "prefills": 0, "occupancy_sum": 0.0,
+             "prefill_s": 0.0, "decode_s": 0.0,
+             "useful_steps": 0, "wasted_steps": 0,
+             "bytes_moved": 0, "prefill_chunks": 0,
+             "chunk_admits": 0, "round_prefill_tokens_max": 0},
+            metric="serving_decoder_total",
+            help="continuous-decoder events by kind",
+            # levels and time-sums stay dict-only: a high-water mark or
+            # a seconds accumulator inside an events-by-kind counter
+            # family would make rate()/sum() over the family meaningless
+            skip=("occupancy_sum", "prefill_s", "decode_s",
+                  "round_prefill_tokens_max"))
         # SLO samples (seconds): TTFT per request, mean inter-token
         # latency per retired request, and each request's worst
         # inter-sync stall — the number chunked prefill bounds

@@ -7,15 +7,21 @@
 # (instance attributes shadow class methods) and reversible.
 #
 # Beyond the reference: TraceCollector records structured spans (name,
-# wall time, nesting depth) instead of printing — feeding the same
-# metrics surface the pipeline uses (SURVEY.md §5.1: the reference has
-# "no span/trace IDs").
+# wall time, nesting depth) instead of printing — and it is the LOCAL
+# LEAF of the distributed tracing model (observe/tracing.py): finished
+# spans also feed the process-wide Tracer, stamped with the ambient
+# TraceContext's trace id, so a method call made while serving a remote
+# frame shows up in the same Perfetto timeline as the hop that caused
+# it (SURVEY.md §5.1: the reference has "no span/trace IDs").
 
 from __future__ import annotations
 
 import functools
 import itertools
+import threading
 import time
+
+from .observe import tracing as _tracing
 
 __all__ = ["trace_all_methods", "untrace", "print_tracer",
            "TraceCollector", "Span"]
@@ -42,18 +48,33 @@ class Span:
 
 
 class TraceCollector:
-    """Interceptor that records spans with caller/callee nesting."""
+    """Interceptor that records spans with caller/callee nesting.
+
+    The nesting stack is THREAD-LOCAL: spans recorded concurrently from
+    the event-loop thread and a caller thread (e.g. a batching
+    scheduler's drive thread resolving a deferred while the engine
+    walks the next frame) each nest under their own thread's open span
+    — a shared stack would cross-link parents between threads and pop
+    the wrong span on exit."""
 
     def __init__(self, clock=time.perf_counter):
         self.clock = clock
-        self.spans: list[Span] = []
-        self._stack: list[Span] = []
+        self.spans: list[Span] = []         # append-only (GIL-safe)
+        self._local = threading.local()
+
+    @property
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     def __call__(self, name, method, args, kwargs):
-        parent = self._stack[-1].span_id if self._stack else None
+        stack = self._stack
+        parent = stack[-1].span_id if stack else None
         span = Span(next(_span_ids), parent, name, self.clock())
         self.spans.append(span)
-        self._stack.append(span)
+        stack.append(span)
         try:
             return method(*args, **kwargs)
         except Exception as exc:
@@ -61,16 +82,27 @@ class TraceCollector:
             raise
         finally:
             span.duration = self.clock() - span.start
-            self._stack.pop()
+            stack.pop()
+            # local leaf of the distributed model: finished spans feed
+            # the process Tracer under the ambient trace context
+            tracer = _tracing.tracer
+            if tracer.enabled:
+                tracer.record(
+                    f"call:{name}", span.start, span.duration,
+                    context=_tracing.current_trace(), cat="method",
+                    span_id=_tracing.new_span_id(),
+                    args={"error": span.error or ""})
 
 
 def print_tracer(name, method, args, kwargs):
-    """The reference's proxy_trace equivalent: enter/exit prints."""
-    print(f"TRACE enter {name}{args!r}")
+    """The reference's proxy_trace equivalent: enter/exit prints.
+    Deliberately console-bound (it exists to eyeball a live object),
+    hence the lint-print waivers."""
+    print(f"TRACE enter {name}{args!r}")      # graft: disable=lint-print
     try:
         return method(*args, **kwargs)
     finally:
-        print(f"TRACE exit  {name}")
+        print(f"TRACE exit  {name}")          # graft: disable=lint-print
 
 
 def trace_all_methods(instance, interceptor, only=None) -> list[str]:

@@ -40,10 +40,10 @@
 from __future__ import annotations
 
 import random
-from collections import Counter
 from dataclasses import dataclass, field
 from fnmatch import fnmatchcase
 
+from ..observe.metrics import MirroredStats
 from .memory import MemoryBroker
 from .message import Message, topic_matches
 
@@ -146,7 +146,13 @@ class FaultPlan:
         self.rng = random.Random(seed)
         self.rules: list[FaultRule] = list(rules)
         self.partitions: list[_Partition] = []
-        self.stats: Counter = Counter()
+        # Counter-compatible (missing keys read 0); injected-fault
+        # counts also mirror onto the metrics registry, so a soak's
+        # telemetry snapshot shows chaos_faults_total beside the
+        # recovery counters it provoked
+        self.stats = MirroredStats(
+            metric="chaos_faults_total",
+            help="chaos faults injected by kind")
 
     # -- authoring ---------------------------------------------------------
     def add(self, rule: FaultRule) -> FaultRule:

@@ -25,6 +25,7 @@ import threading
 from collections import deque
 from typing import Callable
 
+from ..observe.metrics import MirroredStats
 from ..utils.lock import Lock
 from .message import Message, topic_matches
 
@@ -132,8 +133,14 @@ class MemoryBroker:
         self.data_queue_limit = data_queue_limit
         # best-effort counters: delivered/dropped increment outside the
         # broker lock (per-client paths), so concurrent publishers may
-        # lose the odd count — they are diagnostics, not invariants
-        self.stats = {"routed": 0, "delivered": 0, "dropped": 0}
+        # lose the odd count — they are diagnostics, not invariants.
+        # Mirrored onto the process metrics registry (ISSUE 5):
+        # broker_messages_total{kind=...} aggregates across every
+        # broker instance in the process
+        self.stats = MirroredStats(
+            {"routed": 0, "delivered": 0, "dropped": 0},
+            metric="broker_messages_total",
+            help="in-memory broker routing events by kind")
 
     # -- client management -------------------------------------------------
     def attach(self, client: "MemoryMessage") -> None:
@@ -286,7 +293,12 @@ class MemoryMessage(Message):
             self.wills.append((lwt_topic, lwt_payload, lwt_retain))
         self._connected = False
         self.drop_policy = drop_policy
-        self.stats = {"received": 0, "dropped": 0}
+        # per-client dict; the registry mirror aggregates across
+        # clients (no per-client label: client ids are unbounded)
+        self.stats = MirroredStats(
+            {"received": 0, "dropped": 0},
+            metric="transport_client_messages_total",
+            help="per-client transport deliveries/sheds, aggregated")
         # two FIFO lanes with a shared sequence so the drain preserves
         # global arrival order: the data lane is the bounded one, and
         # shedding is O(1) (popleft), never a scan

@@ -97,8 +97,15 @@ class MQTTMessage(Message):
         self._lock = threading.RLock()
         self._pending = deque(maxlen=buffer_limit)   # (topic, payload, retain)
         self._reconnect_timer = None
-        self.stats = {"reconnects": 0, "buffered": 0, "dropped": 0,
-                      "last_error": None}
+        # counter increments mirror onto the metrics registry
+        # (mqtt_client_events_total{kind=...}); last_error is a string
+        # and stays dict-only
+        from ..observe.metrics import MirroredStats
+        self.stats = MirroredStats(
+            {"reconnects": 0, "buffered": 0, "dropped": 0,
+             "last_error": None},
+            metric="mqtt_client_events_total",
+            help="MQTT client lifecycle/buffering events by kind")
 
         self._client = (client_factory or _paho_factory)()
         # paho's network-loop thread auto-reconnects; give it our backoff

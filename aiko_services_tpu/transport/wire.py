@@ -41,17 +41,26 @@ import struct
 
 import numpy as np
 
+from ..observe.tracing import TRACE_MARKER
 from ..utils.sexpr import generate, generate_sexpr, parse_sexpr
 
 __all__ = [
     "MAGIC", "WIRE_VERSION", "WireError", "is_envelope", "contains_binary",
     "encode_envelope", "decode_envelope", "encode_rpc", "supports_binary",
     "WIRE_CODECS", "WIRE_CODEC_DTYPES", "WIRE_CODEC_RANK", "codec_legal",
+    "pop_trace",
 ]
 
 MAGIC = b"AIKW"
 WIRE_VERSION = 1
 _MARKER = "__aikb__"
+# Trace-context header marker (ISSUE 5): a trailing parameter
+# ["__aikt__", trace_id, span_id, remaining, sent] rides in the
+# envelope header (or appended to the sexpr params on text transports)
+# and is stripped back out on decode — existing RPC consumers never see
+# it.  The canonical constant lives in observe/tracing.py (which has no
+# transport dependency, so the import cannot cycle).
+_TRACE = TRACE_MARKER
 _HEAD = struct.Struct("<BI")            # version, header_len
 _COUNT = struct.Struct("<I")
 _BUFLEN = struct.Struct("<Q")
@@ -230,14 +239,32 @@ def _extract(obj, buffers, key=None, codec_hints=None):
     return obj
 
 
-def encode_envelope(command: str, parameters=(), codec_hints=None) -> bytes:
+def pop_trace(parameters):
+    """Strip a trailing trace-context marker from a decoded parameter
+    list; returns the marker's field list or None.  Shared by the
+    envelope decoder and the text-path consumers (actor layer), so both
+    wire forms shed the header identically."""
+    if isinstance(parameters, list) and parameters:
+        last = parameters[-1]
+        if isinstance(last, (list, tuple)) and last and \
+                isinstance(last[0], str) and last[0] == _TRACE:
+            return list(parameters.pop())
+    return None
+
+
+def encode_envelope(command: str, parameters=(), codec_hints=None,
+                    trace=None) -> bytes:
     """RPC (command, params) -> one binary envelope payload.
 
     codec_hints: {dict_key: codec_name} — arrays stored under a hinted
-    dict key ship through that codec (lossy, opt-in)."""
+    dict key ship through that codec (lossy, opt-in).
+    trace: an optional trace-context field list (observe/tracing.py
+    TraceContext.to_fields) carried in the envelope header."""
     buffers: list[memoryview] = []
     extracted = [_extract(p, buffers, codec_hints=codec_hints)
                  for p in parameters]
+    if trace:
+        extracted.append([str(f) for f in trace])
     header = generate(command, extracted).encode("utf-8")
     parts = [MAGIC, _HEAD.pack(WIRE_VERSION, len(header)), header,
              _COUNT.pack(len(buffers))]
@@ -294,11 +321,13 @@ def _restore(obj, buffers, payload_nbytes=0):
     return obj
 
 
-def decode_envelope(payload):
-    """One binary envelope payload -> (command, params).
+def decode_envelope(payload, with_trace: bool = False):
+    """One binary envelope payload -> (command, params), or
+    (command, params, trace_fields|None) when with_trace=True.
 
     ndarrays come back as read-only views over `payload` (zero-copy);
-    everything else keeps S-expression semantics (strings)."""
+    everything else keeps S-expression semantics (strings).  A trace
+    header (see encode_envelope) is always stripped from the params."""
     view = memoryview(payload).cast("B")
     if view.nbytes < 4 + _HEAD.size or bytes(view[:4]) != MAGIC:
         raise WireError("not a binary envelope (bad magic / truncated)")
@@ -330,23 +359,32 @@ def decode_envelope(payload):
     except Exception as exc:
         raise WireError(f"envelope header parse failed: {exc}") from exc
     if isinstance(expr, str):
-        return expr, []
+        return (expr, [], None) if with_trace else (expr, [])
     if not isinstance(expr, list) or not expr or \
             not isinstance(expr[0], str):
         raise WireError(f"envelope header is not an RPC: {header!r}")
-    return expr[0], [_restore(p, buffers, view.nbytes)
-                     for p in expr[1:]]
+    params = [_restore(p, buffers, view.nbytes) for p in expr[1:]]
+    trace = pop_trace(params)
+    if with_trace:
+        return expr[0], params, trace
+    return expr[0], params
 
 
 def encode_rpc(command: str, parameters=(), transport=None,
-               codec_hints=None):
+               codec_hints=None, trace=None):
     """Pick the wire representation for an outbound RPC: the binary
     envelope when the transport can carry bytes AND the params hold
     binary values; S-expression text otherwise (control-plane messages
-    stay human-readable, non-binary transports keep working)."""
+    stay human-readable, non-binary transports keep working).  A trace
+    field list rides the envelope header on the binary path and as a
+    trailing marker parameter on the text path — decoders strip it
+    either way (pop_trace)."""
     if supports_binary(transport) and contains_binary(parameters):
         return encode_envelope(command, parameters,
-                               codec_hints=codec_hints)
-    return generate(command, [
+                               codec_hints=codec_hints, trace=trace)
+    text_params = [
         p if not _is_arraylike(p) or isinstance(p, (str, int, float, bool))
-        else generate_sexpr(np.asarray(p).tolist()) for p in parameters])
+        else generate_sexpr(np.asarray(p).tolist()) for p in parameters]
+    if trace:
+        text_params.append([str(f) for f in trace])
+    return generate(command, text_params)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from collections import deque
 
 __all__ = ["get_logger", "get_log_level_name", "TransportLoggingHandler"]
@@ -62,19 +63,32 @@ class TransportLoggingHandler(logging.Handler):
         self.message = message
         self.topic = topic
         self._ring: deque = deque(maxlen=_RING_SIZE)
+        # re-entrancy guard (per thread): transport.publish may itself
+        # log (broker diagnostics, slow-consumer warnings) and that
+        # record would arrive right back here — drop it instead of
+        # recursing until the stack dies
+        self._emitting = threading.local()
+        self.dropped_reentrant = 0
 
     def _transport(self):
         return self.message() if callable(self.message) else self.message
 
     def emit(self, record):
-        try:
-            payload = self.format(record)
-        except Exception:
+        if getattr(self._emitting, "active", False):
+            self.dropped_reentrant += 1
             return
-        transport = self._transport()
-        if transport is not None and transport.connected():
-            while self._ring:
-                transport.publish(self.topic, self._ring.popleft())
-            transport.publish(self.topic, payload)
-        else:
-            self._ring.append(payload)
+        self._emitting.active = True
+        try:
+            try:
+                payload = self.format(record)
+            except Exception:
+                return
+            transport = self._transport()
+            if transport is not None and transport.connected():
+                while self._ring:
+                    transport.publish(self.topic, self._ring.popleft())
+                transport.publish(self.topic, payload)
+            else:
+                self._ring.append(payload)
+        finally:
+            self._emitting.active = False

@@ -861,25 +861,35 @@ class WirePipelineBench(PipelineBench):
             remote_timeout=900.0, coalesce_frames=coalesce_frames)
         self.pipeline.add_frame_handler(self._on_frame)
 
-        # envelope accounting: publishes that carried frames to the
-        # serving pipeline (coalescing ratio = frames / envelopes)
-        self.wire_publishes = [0]
-        serving_in = f"{self.serving.topic_path}/in"
-        original_publish = call_rt.message.publish
-
-        def counting_publish(topic, payload, retain=False, wait=False):
-            if topic == serving_in:
-                self.wire_publishes[0] += 1
-            return original_publish(topic, payload, retain=retain,
-                                    wait=wait)
-
-        call_rt.message.publish = counting_publish
-
+        # envelope accounting now comes from the metrics registry
+        # (ISSUE 5): the SAME pipeline_wire_envelopes_total /
+        # pipeline_wire_frames_total / pipeline_recovery_total counters
+        # the runtime increments, read per rung via wire_counters() —
+        # no publish monkeypatching, and retries are visible too
         self._init_load_accounting()
         if not self.engine.run_until(
                 self.pipeline.remote_elements_ready, timeout=30.0):
             raise RuntimeError(
                 "wire bench: remote ASR element never discovered")
+
+    def wire_counters(self) -> dict:
+        """Snapshot of the caller pipeline's wire telemetry from the
+        process metrics registry: request envelopes/frames and retry
+        count — cumulative, so rungs diff before/after."""
+        from aiko_services_tpu.observe import default_registry
+        registry = default_registry()
+        caller = self.pipeline.name
+        return {
+            "envelopes": registry.value(
+                "pipeline_wire_envelopes_total",
+                {"pipeline": caller, "direction": "request"}),
+            "frames": registry.value(
+                "pipeline_wire_frames_total",
+                {"pipeline": caller, "direction": "request"}),
+            "retries": registry.value(
+                "pipeline_recovery_total",
+                {"pipeline": caller, "kind": "retries"}),
+        }
 
 
 class PE_BenchImageSource:
@@ -1606,7 +1616,7 @@ def bench_latency():
         program.scheduler.recent_waits.clear()
         program.recent_service.clear()
         deadline_before = program.scheduler.stats["deadline_dispatches"]
-        envelopes_before = bench.wire_publishes[0]
+        wire_before = bench.wire_counters()
         ok, p50, done, mean_batch = bench.measure(
             n, PIPELINE_SECONDS, drain_budget=2.0)
         ordered = sorted(bench._latencies) or [float("inf")]
@@ -1615,7 +1625,12 @@ def bench_latency():
         queue_p50 = waits[len(waits) // 2]
         service = sorted(s for _, s in program.recent_service) or [0.0]
         service_p50 = service[len(service) // 2]
-        envelopes = bench.wire_publishes[0] - envelopes_before
+        # retry-aware coalescing telemetry straight from the metrics
+        # registry — the counters the runtime itself increments
+        wire_after = bench.wire_counters()
+        envelopes = wire_after["envelopes"] - wire_before["envelopes"]
+        wire_frames = wire_after["frames"] - wire_before["frames"]
+        wire_retries = wire_after["retries"] - wire_before["retries"]
         return {
             "lat_wire_streams": n,
             "lat_wire_sustained": bool(ok),
@@ -1632,8 +1647,9 @@ def bench_latency():
                 program.scheduler.stats["deadline_dispatches"] -
                 deadline_before,
             "lat_wire_envelopes": envelopes,
-            "lat_wire_frames_per_envelope": round(done / envelopes, 2)
-            if envelopes else 0.0,
+            "lat_wire_retries": wire_retries,
+            "lat_wire_frames_per_envelope": round(
+                wire_frames / envelopes, 2) if envelopes else 0.0,
             "lat_wire_budget_met": bool(
                 ok and p50 <= LATENCY_BUDGET and n >= 200),
         }

@@ -45,6 +45,28 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from aiko_services_tpu.event import settle_virtual as _settle  # noqa: E402
 
 
+def _counter_series(snapshot: dict, names) -> dict:
+    """Flatten counter families out of a registry snapshot:
+    {"name{k=v,...}": value} for the requested family names."""
+    from aiko_services_tpu.observe.export import series_key
+    flat = {}
+    for name in names:
+        entry = snapshot.get(name)
+        if not entry:
+            continue
+        for series in entry.get("series", []):
+            flat[series_key(name, series.get("labels", {}))] = \
+                series.get("value", 0)
+    return flat
+
+
+_TELEMETRY_FAMILIES = (
+    "chaos_faults_total", "pipeline_recovery_total",
+    "broker_messages_total", "transport_client_messages_total",
+    "pipeline_wire_envelopes_total", "pipeline_wire_frames_total",
+)
+
+
 def _serving_definition(compute_name: str = "compute"):
     return {
         "version": 0, "name": "serve_asr", "runtime": "jax",
@@ -107,7 +129,19 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     from aiko_services_tpu.transport.chaos import ChaosBroker, FaultPlan
     from aiko_services_tpu.transport.memory import MemoryMessage
 
+    from aiko_services_tpu.observe import default_registry, tracing
+
     wall_start = time.monotonic()
+    # telemetry (ISSUE 5): span recording ON for the whole scenario and
+    # a registry snapshot taken before/after, so the report embeds the
+    # metric DELTAS this run caused (the registry is process-wide and
+    # cumulative) — soak regressions diff on these numbers
+    trc = tracing.tracer
+    tracer_was_enabled = trc.enabled
+    trc.enable()
+    trc.clear()
+    metrics_before = _counter_series(default_registry().snapshot(),
+                                     _TELEMETRY_FAMILIES)
     engine = EventEngine(VirtualClock())
     plan = FaultPlan(seed)
     broker = ChaosBroker(plan, engine)
@@ -239,6 +273,23 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
         "virtual_seconds": round(engine.clock.now() - base, 2),
         "wall_seconds": round(time.monotonic() - wall_start, 2),
     }
+
+    # -- telemetry snapshot (ISSUE 5) ------------------------------------
+    metrics_after = _counter_series(default_registry().snapshot(),
+                                    _TELEMETRY_FAMILIES)
+    metric_deltas = {
+        key: value - metrics_before.get(key, 0)
+        for key, value in sorted(metrics_after.items())
+        if value - metrics_before.get(key, 0)}
+    report["telemetry"] = {
+        "metrics": metric_deltas,
+        "spans": {name: {"count": stats["count"],
+                         "total_ms": round(stats["total_s"] * 1000.0, 2),
+                         "mean_ms": round(stats["mean_s"] * 1000.0, 3)}
+                  for name, stats in trc.stats().items()},
+    }
+    if not tracer_was_enabled:
+        trc.disable()
 
     # -- teardown (serving1 already crashed; leave its corpse be) --------
     caller.stop()
