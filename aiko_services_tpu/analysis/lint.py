@@ -11,7 +11,9 @@
 #                         / blocking socket ops inside an event-loop
 #                         context (process_frame, start_stream,
 #                         stop_stream, or any function registered via
-#                         add_*_handler)
+#                         add_*_handler — including add_message_handler,
+#                         so transport-inbound and peer-handshake
+#                         handlers are covered)
 #   lint-raw-lock         threading.Lock() where the diagnostic
 #                         utils.lock.Lock is required (named holder,
 #                         misuse errors, lock-order cycle detection);
@@ -49,6 +51,10 @@ LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
 _HANDLER_REGISTRARS = {
     "add_timer_handler", "add_oneshot_handler", "add_mailbox_handler",
     "add_queue_handler", "add_flatout_handler",
+    # transport-inbound handlers run on the event loop too: a blocking
+    # call in a message handler — the peer handshake handlers included
+    # (transport/peer.py, ISSUE 6) — stalls every pipeline the same way
+    "add_message_handler",
 }
 _FRAME_METHODS = {"process_frame", "start_stream", "stop_stream"}
 _BLOCKING_ATTRS = {

@@ -143,7 +143,9 @@ class PE_WhisperASR(PipelineElement):
     "compute").  Emits {"tokens": int32[T], "text": str}."""
 
     contracts = {
-        "in:mel": "f32[*,80] | bf16[*,80]",
+        # float mel, or pre-packed i8mel rows ([T, 80+4]: int8 codes +
+        # per-row f32 scale bytes — the ASR wire codec, ops/audio.py)
+        "in:mel": "f32[*,80] | bf16[*,80] | i8mel-i8[*,84]",
         # raw float samples, 16-bit PCM, or pre-encoded µ-law codes
         "in:audio": "f32[*] | i16[*] | mulaw-u8[*]",
         "out:tokens": "i32[*]",
@@ -422,8 +424,17 @@ class PE_WhisperASR(PipelineElement):
             batch = np.zeros((rows(len(payloads)), bucket,
                               self.config.n_mels), dtype="float32")
             for i, mel in enumerate(payloads):
+                mel = np.asarray(mel)
+                if mel.dtype == np.int8 and \
+                        mel.shape[-1] == self.config.n_mels + 4:
+                    # pre-encoded i8mel codes (an ingest element packed
+                    # once, or a pipeline shipped packed rows end to
+                    # end): per-row scales ride the trailing 4 bytes —
+                    # expand on the host, no per-frame transcode upstream
+                    from ..ops.audio import mel_i8_unpack
+                    mel = mel_i8_unpack(mel)
                 t = min(mel.shape[0], bucket)
-                batch[i, :t] = np.asarray(mel)[:t]
+                batch[i, :t] = mel[:t]
             return jnp.asarray(batch, jnp.bfloat16)
 
         def split(results, count):

@@ -170,6 +170,63 @@ def mulaw_decode(codes):
         jnp.abs(x) * jnp.log1p(MULAW_MU)) * (1.0 / MULAW_MU)
 
 
+# -- 8-bit mel wire format (ISSUE 6 satellite) -------------------------------
+# The ASR wire after the frontend split carries log-mel features: f32
+# [T, 80] is 320 bytes per mel frame.  Absmax int8 with one scale PER
+# MEL FRAME (row) quantizes each 10 ms slice against its own dynamic
+# range — a quiet frame next to a plosive keeps its resolution, unlike
+# one whole-chunk scale — at 80 + 4 bytes per frame (3.8× smaller).
+# The packed layout rides the generic binary envelope as a single int8
+# buffer: [T, num_mels + 4], the trailing 4 bytes per row being the f32
+# scale reinterpreted as int8 (transport/wire.py codec tag "i8mel").
+# All host-side numpy: the transport never touches the accelerator.
+
+def mel_i8_encode(mel):
+    """float [T, M] log-mel → (int8 codes [T, M], float32 scales [T]).
+    Non-finite entries saturate (±inf) or zero (NaN) instead of
+    poisoning the row's scale."""
+    x = np.asarray(mel, dtype=np.float32)
+    if x.ndim != 2:
+        raise ValueError(f"mel_i8_encode wants [T, M], got {x.shape}")
+    finite = np.where(np.isfinite(x), np.abs(x), 0.0)
+    scales = finite.max(axis=1) / 127.0 if x.shape[1] else \
+        np.zeros((x.shape[0],), np.float32)
+    scales = np.where((scales > 0.0) & np.isfinite(scales),
+                      scales, 1.0).astype(np.float32)
+    bound = 127.0 * scales[:, None]
+    x = np.clip(np.nan_to_num(x, nan=0.0, posinf=np.inf,
+                              neginf=-np.inf), -bound, bound)
+    codes = np.round(x / scales[:, None]).astype(np.int8)
+    return codes, scales
+
+
+def mel_i8_decode(codes, scales):
+    """(int8 codes [T, M], float32 scales [T]) → float32 [T, M]."""
+    return np.asarray(codes, np.float32) * \
+        np.asarray(scales, np.float32)[:, None]
+
+
+def mel_i8_pack(mel):
+    """float [T, M] → packed int8 [T, M + 4] (codes + per-row scale
+    bytes) — the single-buffer form the wire envelope ships."""
+    codes, scales = mel_i8_encode(mel)
+    scale_bytes = scales.view(np.int8).reshape(-1, 4)
+    return np.concatenate([codes, scale_bytes], axis=1)
+
+
+def mel_i8_unpack(packed):
+    """packed int8 [T, M + 4] → float32 [T, M] (inverse of
+    mel_i8_pack, up to the codec's quantization loss)."""
+    packed = np.asarray(packed, np.int8)
+    if packed.ndim != 2 or packed.shape[1] < 5:
+        raise ValueError(
+            f"mel_i8_unpack wants packed [T, M+4], got {packed.shape}")
+    codes = packed[:, :-4]
+    scales = np.ascontiguousarray(packed[:, -4:]).view(
+        np.float32).reshape(-1)
+    return mel_i8_decode(codes, scales)
+
+
 # -- inverse path: spectrogram → waveform (the TTS vocoder leg) --------------
 
 def stft_complex(audio, n_fft: int = WHISPER_N_FFT, hop: int = WHISPER_HOP):

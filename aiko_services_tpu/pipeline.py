@@ -46,7 +46,7 @@ from .actor import Actor, get_remote_proxy
 from .lease import Lease
 from .observe import tracing
 from .observe.metrics import MirroredStats, default_registry
-from .service import ServiceFilter, ServiceProtocol
+from .service import ServiceFilter, ServiceProtocol, ServiceTags
 from .share import ServicesCache
 from .transport import wire
 from .utils import (
@@ -479,13 +479,16 @@ class _RemoteElementPlaceholder:
     `candidates` keeps EVERY currently-discovered matching service (in
     discovery order), not just the active one: when the active proxy
     leaves — or a hop times out against it — the pipeline fails over to
-    the next candidate instead of erroring frames."""
+    the next candidate instead of erroring frames.  Values are each
+    candidate's advertised peer-endpoint tag (None when the service has
+    no peer data plane), consumed by Pipeline._negotiate_peer."""
 
     def __init__(self, definition: PipelineElementDefinition):
         self.definition = definition
         self.proxy = None
         self.topic_path = None
-        self.candidates: dict[str, bool] = {}   # topic_path -> True
+        # topic_path -> peer endpoint tag value | None
+        self.candidates: dict[str, str | None] = {}
         self.buffer: list = []          # (entry, one_way) pending sends
         self.outstanding = 0            # request/response hops in flight
         self.flush_scheduled = False
@@ -736,6 +739,40 @@ class Pipeline(PipelineElement):
     def _recovery_enabled(self) -> bool:
         return self.remote_retries > 0
 
+    @property
+    def _peer_host(self):
+        """The runtime's peer data plane, when enabled (ISSUE 6)."""
+        return getattr(self.runtime, "peer", None)
+
+    def _negotiate_peer(self, topic_path: str) -> None:
+        """Open a direct data-plane channel to the service at
+        `topic_path` when both sides speak peer: our requests to its
+        /in topic and its replies to our topic_in pin to the channel.
+        No-op (broker path stays) when either side lacks an endpoint —
+        and on refusal/death the PeerHost falls back by itself."""
+        host = self._peer_host
+        if host is None:
+            return
+        endpoint = None
+        for placeholder in self._remote.values():
+            if topic_path in placeholder.candidates:
+                endpoint = placeholder.candidates[topic_path]
+                break
+        if not endpoint:
+            return
+        try:
+            host.negotiate(topic_path, endpoint,
+                           pin_topics=[f"{topic_path}/in"],
+                           reply_topics=[self.topic_in])
+        except Exception:
+            # a broken advertisement must not abort _activate_remote —
+            # the failover redirect and buffered-frame flush that
+            # follow it are correctness, the peer channel is only an
+            # optimization
+            self.logger.exception(
+                "pipeline %s: peer negotiation with %s failed; "
+                "staying on the broker path", self.name, topic_path)
+
     def _watch_remote(self, node_name: str, element_def) -> None:
         """Swap the placeholder for a live proxy when the remote pipeline
         service appears (reference: pipeline.py:591-620).  Every matching
@@ -750,11 +787,24 @@ class Pipeline(PipelineElement):
         def handler(command, fields):
             placeholder = self._remote[node_name]
             if command == "add":
-                placeholder.candidates[fields.topic_path] = True
+                # candidates map topic_path → advertised peer endpoint
+                # tag (None when the service has no peer data plane)
+                endpoint = ServiceTags.to_dict(fields.tags).get("peer")
+                placeholder.candidates[fields.topic_path] = endpoint
                 if not placeholder.found:
                     self._activate_remote(node_name, fields.topic_path)
+                elif placeholder.topic_path == fields.topic_path:
+                    # re-registration of the ACTIVE service (fresh
+                    # incarnation, peer enabled late): re-negotiate the
+                    # data plane with the current endpoint facts
+                    self._negotiate_peer(fields.topic_path)
             elif command == "remove":
                 placeholder.candidates.pop(fields.topic_path, None)
+                if self._peer_host is not None:
+                    # the service left: its channel (if any) is a
+                    # corpse — unpin so traffic rides the broker to
+                    # whatever candidate activation picks next
+                    self._peer_host.release(f"{fields.topic_path}/in")
                 if placeholder.topic_path == fields.topic_path:
                     placeholder.proxy = None
                     placeholder.topic_path = None
@@ -777,6 +827,10 @@ class Pipeline(PipelineElement):
         placeholder.proxy = get_remote_proxy(
             self.runtime, f"{topic_path}/in", Pipeline,
             codec_hints=self._remote_wire_codecs)
+        # peer data plane (ISSUE 6): first hop to a discovered proxy
+        # negotiates a direct channel through the control plane; data
+        # envelopes pin to it, with the broker as the standing fallback
+        self._negotiate_peer(topic_path)
         if failover:
             self.recovery_stats["failovers"] += 1
             self.logger.warning(

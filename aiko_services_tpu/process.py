@@ -71,6 +71,7 @@ class ProcessRuntime:
 
         self._transport_factory = transport_factory or self._default_factory
         self.message = None
+        self.peer = None        # PeerHost once enable_peer() is called
         self._message_handlers: list[tuple[str, object]] = []
         self._exact_handlers: dict[str, list] = {}
         self._wildcard_handlers: list[tuple[str, object]] = []
@@ -118,6 +119,9 @@ class ProcessRuntime:
     def terminate(self, graceful: bool = True) -> None:
         # stop() overrides run teardown (e.g. a primary registrar clears its
         # retained boot record and announces "(primary absent)")
+        if self.peer is not None:
+            self.peer.close()
+            self.peer = None
         for service_id, service in list(self._services.items()):
             stop = getattr(service, "stop", None)
             if stop:
@@ -137,12 +141,19 @@ class ProcessRuntime:
         self.connection.update(ConnectionState.NONE)
 
     # -- inbound message path ---------------------------------------------
-    def _on_transport_message(self, topic: str, payload) -> None:
-        # may be called on a transport thread: marshal onto the event engine
-        self.event.queue_put(self._queue_name, (topic, payload))
+    def _on_transport_message(self, topic: str, payload,
+                              ack=None) -> None:
+        # may be called on a transport thread: marshal onto the event
+        # engine.  `ack` (optional) is invoked when the item is drained
+        # — the peer data plane uses it to bound its in-flight window
+        self.event.queue_put(self._queue_name,
+                             (topic, payload) if ack is None
+                             else (topic, payload, ack))
 
     def _on_message_queue(self, _name, item, _put_time) -> None:
-        topic, payload = item
+        topic, payload = item[0], item[1]
+        if len(item) > 2:
+            item[2]()           # delivery ack: the queue slot is free
         if isinstance(payload, bytes) and \
                 not self._is_binary_topic(topic) and \
                 not wire_is_envelope(payload):
@@ -205,7 +216,32 @@ class ProcessRuntime:
 
     def publish(self, topic: str, payload, retain: bool = False,
                 wait: bool = False) -> None:
+        # peer data plane (ISSUE 6): binary envelopes bound for a topic
+        # with a live negotiated channel bypass the broker entirely;
+        # everything else — control text, retained state, unpinned
+        # topics, dead channels — falls through to the broker path
+        if self.peer is not None and not retain and \
+                self.peer.maybe_send(topic, payload):
+            return
         self.message.publish(topic, payload, retain, wait)
+
+    # -- peer data plane (ISSUE 6) ----------------------------------------
+    def enable_peer(self, kinds=("mem",), **kwargs):
+        """Turn on the peer data plane for this runtime: services
+        registered by this process advertise a direct-channel endpoint
+        (tag "peer=..."), inbound handshakes are answered, and
+        publish() pins negotiated data-plane traffic off the broker.
+        Idempotent; returns the PeerHost."""
+        if self.peer is None:
+            from .transport.peer import PeerHost
+            self.peer = PeerHost(self, kinds=kinds, **kwargs)
+            # services registered before enabling re-advertise with the
+            # endpoint tag so existing discovery records pick it up
+            for service in self._services.values():
+                service.add_tags([self.peer.tag])
+                if self.registrar is not None and self.message is not None:
+                    self._register_service(service)
+        return self.peer
 
     # -- service table -----------------------------------------------------
     def add_service(self, service) -> int:
@@ -216,6 +252,10 @@ class ProcessRuntime:
         # returned
         service.service_id = service_id
         service.topic_path = f"{self.topic_path}/{service_id}"
+        if self.peer is not None and self.peer.tag not in service.tags:
+            # every service of a peer-enabled runtime advertises the
+            # direct-channel endpoint in its discovery record
+            service.tags.append(self.peer.tag)
         if self.registrar is not None:
             self._register_service(service)
         return service_id

@@ -160,7 +160,15 @@ class Registrar(Service):
                 fields = ServiceFields.from_record(params)
             except Exception:
                 return
+            existing = self.services.get(fields.topic_path)
             self.services.add(fields)
+            if existing == fields:
+                # idempotent re-registration (reconnect replay, periodic
+                # re-announce): the table is already right — do not storm
+                # every cache in the fleet with a no-op event.  A CHANGED
+                # record (e.g. a peer data-plane endpoint advertised
+                # after the fact, ISSUE 6) still propagates.
+                return
             self.runtime.publish(
                 self.topic_out,
                 generate("add", [fields.to_record()]))

@@ -4,6 +4,10 @@ from .chaos import (                                        # noqa: F401
 from .message import Message, topic_matches                 # noqa: F401
 from .memory import MemoryBroker, MemoryMessage, default_broker  # noqa: F401
 from .mqtt import MQTT_AVAILABLE, MQTTMessage               # noqa: F401
+from .peer import (                                         # noqa: F401
+    ChaosPeerChannel, MemoryPeerChannel, PeerChannel, PeerHost,
+    SocketPeerChannel,
+)
 from .wire import (                                         # noqa: F401
     WIRE_CODECS, WireError, contains_binary, decode_envelope,
     encode_envelope, encode_rpc, is_envelope, supports_binary,

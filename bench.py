@@ -777,7 +777,7 @@ class WirePipelineBench(PipelineBench):
                  chunk_seconds: float = CHUNK_SECONDS,
                  max_tokens: int = MAX_TOKENS,
                  deadline_ms: float = 0.0, coalesce_frames: int = 32,
-                 depth: int = 0):
+                 depth: int = 0, peer: bool = True):
         from aiko_services_tpu.compute import ComputeRuntime
         from aiko_services_tpu.event import EventEngine
         from aiko_services_tpu.pipeline import Pipeline, \
@@ -809,6 +809,12 @@ class WirePipelineBench(PipelineBench):
 
         serve_rt = make_rt("bench_serve")
         self.runtime = serve_rt
+        if peer:
+            # peer data plane (ISSUE 6): data envelopes bypass the
+            # broker over a registrar-negotiated direct channel; the
+            # broker keeps discovery/control only.  peer=False A/Bs the
+            # broker-only path at the same stream count.
+            serve_rt.enable_peer()
         self.compute = ComputeRuntime(serve_rt, "compute")
         frames = int(chunk_seconds * FRAMES_PER_SECOND)
         serving_def = parse_pipeline_definition({
@@ -840,6 +846,8 @@ class WirePipelineBench(PipelineBench):
                                 auto_create_streams=True)
 
         call_rt = make_rt("bench_call")
+        if peer:
+            call_rt.enable_peer()
         caller_def = parse_pipeline_definition({
             "version": 0, "name": "p_bench_call", "runtime": "jax",
             "graph": ["(PE_BenchWireSource (asr))"],
@@ -861,6 +869,8 @@ class WirePipelineBench(PipelineBench):
             remote_timeout=900.0, coalesce_frames=coalesce_frames)
         self.pipeline.add_frame_handler(self._on_frame)
 
+        self._broker = broker
+        self._call_rt = call_rt
         # envelope accounting now comes from the metrics registry
         # (ISSUE 5): the SAME pipeline_wire_envelopes_total /
         # pipeline_wire_frames_total / pipeline_recovery_total counters
@@ -889,7 +899,19 @@ class WirePipelineBench(PipelineBench):
             "retries": registry.value(
                 "pipeline_recovery_total",
                 {"pipeline": caller, "kind": "retries"}),
+            # the control/data split made measurable (ISSUE 6): peer
+            # channel envelopes vs messages the broker still routed —
+            # in steady state the broker count stays flat while the
+            # peer counter carries the data plane
+            "peer_sent": registry.value("peer_events_total",
+                                        {"kind": "sent"}),
+            "broker_routed": self._broker.stats["routed"],
         }
+
+    def peer_pinned(self) -> bool:
+        peer_host = getattr(self._call_rt, "peer", None)
+        return peer_host is not None and \
+            peer_host.pinned(f"{self.serving.topic_path}/in")
 
 
 class PE_BenchImageSource:
@@ -1650,6 +1672,13 @@ def bench_latency():
             "lat_wire_retries": wire_retries,
             "lat_wire_frames_per_envelope": round(
                 wire_frames / envelopes, 2) if envelopes else 0.0,
+            # data-plane split accounting (ISSUE 6): envelopes on the
+            # direct peer channel vs broker-routed messages this rung
+            "lat_wire_peer_envelopes":
+                wire_after["peer_sent"] - wire_before["peer_sent"],
+            "lat_wire_broker_routed":
+                wire_after["broker_routed"] - wire_before["broker_routed"],
+            "lat_wire_peer_pinned": bench.peer_pinned(),
             "lat_wire_budget_met": bool(
                 ok and p50 <= LATENCY_BUDGET and n >= 200),
         }
@@ -1686,10 +1715,12 @@ def bench_latency():
         "lat_wire_batch": WIRE_BATCH,
         "lat_wire_round_chained_ms": round(
             wire_round_chained * 1000.0, 1),
-        "lat_wire_path": "binary envelope over indexed MemoryBroker: "
-                         "caller pipeline -> remote hop (zero-copy "
-                         "µ-law uint8, coalesced) -> serving pipeline "
-                         "-> device; replies coalesced",
+        "lat_wire_path": "binary envelope over registrar-negotiated "
+                         "PEER channel (broker = discovery/control + "
+                         "fallback): caller pipeline -> direct channel "
+                         "(zero-copy µ-law uint8, coalesced) -> serving "
+                         "pipeline -> device; replies coalesced on the "
+                         "same channel",
     }
     met_wire = result.get("lat_wire_budget_met", False)
     result["latency_budget_met"] = bool(met_wire or dev_met)

@@ -64,6 +64,7 @@ _TELEMETRY_FAMILIES = (
     "chaos_faults_total", "pipeline_recovery_total",
     "broker_messages_total", "transport_client_messages_total",
     "pipeline_wire_envelopes_total", "pipeline_wire_frames_total",
+    "peer_events_total",
 )
 
 
@@ -113,8 +114,17 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
              kill_at: float = 4.0, frame_interval: float = 0.4,
              remote_timeout: float = 1.5, retries: int = 6,
              failure_budget: int = 4, horizon: float = 60.0,
-             wav_path: str | None = None) -> dict:
-    """Run the scenario; returns the JSON-able report."""
+             wav_path: str | None = None, peer: bool = False,
+             peer_kill_at: float | None = None) -> dict:
+    """Run the scenario; returns the JSON-able report.
+
+    peer=True runs the data plane over registrar-negotiated direct
+    peer channels (ISSUE 6): every runtime enables the peer host with
+    the SAME FaultPlan (so drops/partitions hit peer sends too), the
+    caller ships mel as i8mel codes, and at `peer_kill_at` (default:
+    1.5 s before kill_at) every open peer channel is killed mid-stream
+    — traffic must degrade to the broker without losing a frame, then
+    re-negotiate back onto direct channels."""
     import numpy as np
 
     from aiko_services_tpu.compute import ComputeRuntime
@@ -171,6 +181,8 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     servings = []
     for index in (1, 2):
         serve_rt = make_runtime(f"serving{index}")
+        if peer:
+            serve_rt.enable_peer(fault_plan=plan, jitter_seed=seed)
         ComputeRuntime(serve_rt, f"compute{index}")
         pipeline = Pipeline(
             serve_rt,
@@ -179,12 +191,17 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
             auto_create_streams=True, stream_lease_time=30.0)
         servings.append((serve_rt, pipeline))
     call_rt = make_runtime("caller")
+    if peer:
+        call_rt.enable_peer(fault_plan=plan, jitter_seed=seed)
     caller = Pipeline(
         call_rt, parse_pipeline_definition(_calling_definition()),
         services_cache=ServicesCache(call_rt), stream_lease_time=0,
         remote_timeout=remote_timeout, remote_retries=retries,
         remote_backoff=0.25, remote_backoff_max=2.0, retry_seed=seed,
-        stream_failure_budget=failure_budget)
+        stream_failure_budget=failure_budget,
+        # the ASR wire codec (ISSUE 6 satellite): mel crosses as i8
+        # codes with per-row scales — 3.8x fewer host→serving bytes
+        remote_wire_codecs={"mel": "i8mel"} if peer else None)
     _settle(engine, 2.0)
     assert caller.remote_elements_ready(), "setup: discovery failed"
 
@@ -200,12 +217,19 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     plan.partition([["caller"], ["serving*"]],
                    start=base + partition[0], stop=base + partition[1])
     kill_time = base + kill_at
+    # peer scenario: sever every open channel mid-stream — after the
+    # partition heals, before the serving-process kill — so the run
+    # exercises degrade-to-broker AND the re-negotiation climb-back
+    peer_kill_time = base + (peer_kill_at if peer_kill_at is not None
+                             else max(kill_at - 1.5, 0.5))
 
     # -- drive -----------------------------------------------------------
     done = []
     caller.add_frame_handler(done.append)
     posted: list[str] = []
     killed = False
+    peer_killed = False
+    peer_kills = 0
     next_frame = 0
     deadline = base + horizon
     while engine.clock.now() < deadline:
@@ -218,6 +242,9 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
             caller.post("process_frame", stream_id, {})
             posted.append(stream_id)
             next_frame += 1
+        if peer and not peer_killed and now >= peer_kill_time:
+            peer_killed = True
+            peer_kills = call_rt.peer.kill_channels("mid-stream-kill")
         if not killed and now >= kill_time:
             killed = True
             # transport-level crash: LWTs fire through the chaos broker
@@ -225,6 +252,9 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
             # corpse is silenced — anything the dead runtime's handlers
             # still try to send vanishes
             servings[0][0].message.crash()
+            if peer:
+                # a dead process takes its peer channels with it
+                servings[0][0].peer.kill_channels("process-kill")
             plan.drop(sender="serving1", start=now)
         while engine.step():
             pass
@@ -273,6 +303,15 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
         "virtual_seconds": round(engine.clock.now() - base, 2),
         "wall_seconds": round(time.monotonic() - wall_start, 2),
     }
+    if peer:
+        caller_info = call_rt.peer.info()
+        report["peer"] = {
+            "mid_stream_kills": peer_kills,
+            "caller": caller_info["stats"],
+            "caller_pins": caller_info["pins"],
+            "serving": {f"serving{i + 1}": rt.peer.info()["stats"]
+                        for i, (rt, _) in enumerate(servings)},
+        }
 
     # -- telemetry snapshot (ISSUE 5) ------------------------------------
     metrics_after = _counter_series(default_registry().snapshot(),
@@ -296,6 +335,10 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     call_rt.terminate()
     servings[1][1].stop()
     servings[1][0].terminate()
+    if peer and servings[0][0].peer is not None:
+        # the corpse's peer host: channels are dead, but unregister its
+        # endpoint so repeated in-process runs don't accumulate entries
+        servings[0][0].peer.close()
     registrar_rt.terminate()
     if own_tmpdir is not None:
         shutil.rmtree(own_tmpdir, ignore_errors=True)
@@ -316,9 +359,14 @@ def main(argv=None) -> int:
                         help="virtual-seconds budget")
     parser.add_argument("--max-lost", type=int, default=0,
                         help="frame-loss policy: exit 1 beyond this")
+    parser.add_argument("--peer", action="store_true",
+                        help="run the data plane over negotiated peer "
+                             "channels (chaos-wrapped), including a "
+                             "mid-stream channel kill")
     args = parser.parse_args(argv)
     report = run_soak(seed=args.seed, frames=args.frames, drop=args.drop,
-                      retries=args.retries, horizon=args.horizon)
+                      retries=args.retries, horizon=args.horizon,
+                      peer=args.peer)
     print(json.dumps(report, indent=2))
     return 0 if report["frames_lost"] <= args.max_lost else 1
 

@@ -229,6 +229,29 @@ class TestLint:
             "    engine.add_timer_handler(on_tick, 1.0)\n")
         assert ("lint-blocking-call", 4) in rules
 
+    def test_blocking_in_message_handler(self):
+        # transport-inbound handlers (add_message_handler) run on the
+        # event loop too — the peer handshake handlers (ISSUE 6) are
+        # the motivating case
+        rules = self._rules_at(
+            "import time\n"
+            "class Host:\n"
+            "    def setup(self, runtime):\n"
+            "        runtime.add_message_handler(self._peer_handler,\n"
+            "                                    'ns/p/0/peer')\n"
+            "    def _peer_handler(self, topic, payload):\n"
+            "        time.sleep(0.1)\n")
+        assert ("lint-blocking-call", 7) in rules
+
+    def test_socket_recv_in_message_handler(self):
+        rules = self._rules_at(
+            "class Host:\n"
+            "    def setup(self, runtime):\n"
+            "        runtime.add_message_handler(self._on_open, 't')\n"
+            "    def _on_open(self, topic, payload):\n"
+            "        self.sock.recv(4096)\n")
+        assert ("lint-blocking-call", 5) in rules
+
     def test_thread_target_not_flagged(self):
         rules = self._rules_at(
             "import time, threading\n"

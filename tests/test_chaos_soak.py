@@ -45,3 +45,35 @@ def test_chaos_soak_speech_two_runtimes():
     # leak checks: nothing pending, no hop lease still ticking
     assert report["pending_hops"] == 0
     assert report["leaked_hop_leases"] == 0
+
+
+def test_chaos_soak_peer_data_plane():
+    # ISSUE 6 capstone: the same scenario with the data plane on
+    # registrar-negotiated peer channels (chaos-wrapped, mel as i8mel
+    # codes) plus a mid-stream kill of every open channel — traffic
+    # must degrade to the broker without losing a frame, re-negotiate,
+    # and still survive the serving-process kill and partition
+    report = run_soak(seed=11, frames=6, horizon=40.0, peer=True)
+
+    assert report["frames_sent"] == 6
+    assert report["frames_lost"] == 0, report
+    assert report["frames_recovered"] == 6
+    assert report["texts_returned"] == 6
+
+    peer = report["peer"]
+    # channels were negotiated, carried data, and were killed mid-run
+    assert peer["mid_stream_kills"] >= 1
+    assert peer["caller"]["sent"] > 0
+    assert peer["caller"]["received"] > 0
+    assert peer["caller"]["closed"] >= 1
+    # the kill degraded to the broker, then climbed back
+    assert peer["caller"]["renegotiations"] >= 1
+
+    # chaos still applied, recovery still absorbed it
+    faults = report["faults_injected"]
+    assert faults.get("partitioned", 0) > 0
+    assert report["caller_recovery"]["retries"] > 0
+
+    # leak checks hold on the peer path too
+    assert report["pending_hops"] == 0
+    assert report["leaked_hop_leases"] == 0

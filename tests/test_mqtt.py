@@ -97,9 +97,11 @@ class FakePaho:
 
     # -- messaging ----------------------------------------------------------
     def subscribe(self, topic):
-        new = topic not in self.subscriptions
+        # real brokers resend retained state on EVERY SUBSCRIBE packet
+        # (not just the first): a late-joining host must receive the
+        # retained registrar boot record on its connect resubscribe
         self.subscriptions.add(topic)
-        if new and self.connected_to_broker:
+        if self.connected_to_broker:
             self.broker.send_retained(self, topic)
 
     def unsubscribe(self, topic):
@@ -370,3 +372,106 @@ class TestLWTChange:
         assert client.will == ("ns/me/state", "(gone v2)", False)
         client.drop()
         assert ("ns/me/state", "(gone v2)") in seen
+
+
+class TestEnvelopeSoakOverMQTT:
+    """The BINARY data plane over transport/mqtt.py against the looped
+    broker seam (the PR 4 follow-up): a remote tensor pipeline — caller
+    runtime → binary wire envelopes through MQTTMessage/FakePaho →
+    serving runtime → coalesced envelope replies — with every payload
+    on the wire verified to be an envelope, not sexpr text."""
+
+    def test_remote_tensor_pipeline_envelopes_over_mqtt(self):
+        import numpy as np
+
+        from aiko_services_tpu import EventEngine, Registrar
+        from aiko_services_tpu.pipeline import (
+            Frame, FrameOutput, Pipeline, PipelineElement,
+            parse_pipeline_definition)
+        from aiko_services_tpu.share import ServicesCache
+        from aiko_services_tpu.transport import wire
+
+        engine = EventEngine()
+        broker = FakeBroker()
+        wire_log = {"envelopes": 0, "text": 0}
+        original_route = broker.route
+
+        def sniffing_route(topic, payload, retain=False):
+            if topic.endswith("/in"):
+                if wire.is_envelope(payload):
+                    wire_log["envelopes"] += 1
+                else:
+                    wire_log["text"] += 1
+            original_route(topic, payload, retain)
+
+        broker.route = sniffing_route
+        helper = TestRuntimeOverMQTT()
+        reg_rt = helper.make_runtime(engine, broker, "mq_reg") \
+            .initialize()
+        registrar = Registrar(reg_rt)
+        assert engine.run_until(lambda: registrar.is_primary,
+                                timeout=6.0)
+
+        class PE_Src(PipelineElement):
+            def process_frame(self, frame: Frame, **_) -> FrameOutput:
+                return FrameOutput(True, {
+                    "data": np.arange(16, dtype=np.float32)})
+
+        class PE_Sum(PipelineElement):
+            def process_frame(self, frame: Frame, data=None,
+                              **_) -> FrameOutput:
+                return FrameOutput(True, {
+                    "total": np.asarray(data).sum(keepdims=True)})
+
+        def element(name, inputs=(), outputs=(), deploy=None):
+            return {"name": name,
+                    "input": [{"name": n} for n in inputs],
+                    "output": [{"name": n} for n in outputs],
+                    "deploy": deploy or {}}
+
+        serve_rt = helper.make_runtime(engine, broker,
+                                       "mq_serve").initialize()
+        serving = Pipeline(
+            serve_rt, parse_pipeline_definition({
+                "version": 0, "name": "mq_serve_pipe",
+                "runtime": "python", "graph": ["(PE_Sum)"],
+                "elements": [element("PE_Sum", ["data"], ["total"])]}),
+            element_classes={"PE_Sum": PE_Sum},
+            auto_create_streams=True, stream_lease_time=0)
+        call_rt = helper.make_runtime(engine, broker,
+                                      "mq_call").initialize()
+        caller = Pipeline(
+            call_rt, parse_pipeline_definition({
+                "version": 0, "name": "mq_call_pipe",
+                "runtime": "python", "graph": ["(PE_Src (hop))"],
+                "elements": [
+                    element("PE_Src", (), ["data"]),
+                    element("hop", ["data"], ["total"],
+                            deploy={"remote": {"service_filter":
+                                    {"name": "mq_serve_pipe"}}})]}),
+            element_classes={"PE_Src": PE_Src},
+            services_cache=ServicesCache(call_rt),
+            stream_lease_time=0, remote_timeout=10.0)
+        assert engine.run_until(caller.remote_elements_ready,
+                                timeout=6.0)
+
+        done = []
+        caller.add_frame_handler(done.append)
+        caller.create_stream("s1", lease_time=0)
+        frames = 12
+        for _ in range(frames):
+            caller.post("process_frame", "s1", {})
+            engine.run_until(lambda: False, timeout=0.01)
+        assert engine.run_until(lambda: len(done) >= frames,
+                                timeout=10.0)
+        assert all(float(f.swag["total"][0]) == 120.0 for f in done)
+        # the data plane really was binary end to end: tensor hops and
+        # replies crossed as envelopes (MQTTMessage is BINARY), and no
+        # tensor fell back to sexpr text
+        assert wire_log["envelopes"] >= 2 * frames
+        assert not caller._pending_remote
+        caller.stop()
+        serving.stop()
+        call_rt.terminate()
+        serve_rt.terminate()
+        reg_rt.terminate()

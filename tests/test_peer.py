@@ -1,0 +1,439 @@
+# Peer data plane (ISSUE 6): registrar-negotiated direct binary
+# channels, end-to-end across runtimes on one deterministic engine.
+# Covers the negotiation protocol's edge cases — refusal → broker
+# fallback, channel death mid-stream → in-flight redirect +
+# re-negotiation, duplicate handshake replies, stale-nonce rejection,
+# candidate failover — plus the chaos seam (FaultPlan over peer sends)
+# and the control/data split itself (broker counter flat while the
+# channel carries the envelopes).
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.event import settle_virtual
+from aiko_services_tpu.pipeline import (
+    Frame, FrameOutput, Pipeline, PipelineElement,
+    parse_pipeline_definition)
+from aiko_services_tpu.process import ProcessRuntime
+from aiko_services_tpu.registrar import Registrar
+from aiko_services_tpu.share import ServicesCache
+from aiko_services_tpu.transport.chaos import ChaosBroker, FaultPlan
+from aiko_services_tpu.transport.memory import MemoryBroker, MemoryMessage
+from aiko_services_tpu.transport.peer import parse_endpoints
+
+
+class PE_Src(PipelineElement):
+    def process_frame(self, frame: Frame, **_) -> FrameOutput:
+        return FrameOutput(True, {"data": np.arange(8, dtype=np.float32)})
+
+
+class PE_Double(PipelineElement):
+    def process_frame(self, frame: Frame, data=None, **_) -> FrameOutput:
+        return FrameOutput(True, {"out": np.asarray(data) * 2.0})
+
+
+def element(name, inputs=(), outputs=(), deploy=None):
+    return {"name": name, "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": deploy or {}}
+
+
+def serving_definition(name="serve"):
+    return parse_pipeline_definition({
+        "version": 0, "name": name, "runtime": "python",
+        "graph": ["(PE_Double)"],
+        "elements": [element("PE_Double", ["data"], ["out"])]})
+
+
+def calling_definition():
+    return parse_pipeline_definition({
+        "version": 0, "name": "call", "runtime": "python",
+        "graph": ["(PE_Src (hop))"],
+        "elements": [
+            element("PE_Src", (), ["data"]),
+            element("hop", ["data"], ["out"],
+                    deploy={"remote": {"service_filter":
+                                       {"name": "serve"}}})]})
+
+
+def settle(engine, steps=60):
+    for _ in range(steps):
+        engine.step()
+
+
+class System:
+    """Registrar + N peer-enabled serving runtimes + a peer-enabled
+    caller, all on one broker + virtual-clock engine."""
+
+    def __init__(self, engine, broker=None, servings=1, caller_peer=True,
+                 serving_peer=True, accept_handler=None,
+                 caller_plan=None, serving_plan=None, retries=0,
+                 remote_timeout=5.0, failure_budget=1):
+        self.engine = engine
+        self.broker = broker if broker is not None else MemoryBroker()
+        self.runtimes = []
+
+        def make_runtime(name):
+            def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+                return MemoryMessage(
+                    on_message=on_message, broker=self.broker,
+                    lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                    lwt_retain=lwt_retain, client_id=name)
+            runtime = ProcessRuntime(
+                name=name, engine=engine,
+                transport_factory=factory).initialize()
+            self.runtimes.append(runtime)
+            return runtime
+
+        reg_rt = make_runtime("reg")
+        Registrar(reg_rt)
+        engine.clock.advance(2.1)
+        settle(engine)
+        self.servings = []
+        for index in range(servings):
+            serve_rt = make_runtime(f"serve_rt{index + 1}")
+            if serving_peer:
+                serve_rt.enable_peer(accept_handler=accept_handler,
+                                     fault_plan=serving_plan)
+            serving = Pipeline(
+                serve_rt, serving_definition(),
+                element_classes={"PE_Double": PE_Double},
+                auto_create_streams=True, stream_lease_time=0)
+            self.servings.append((serve_rt, serving))
+        self.serve_rt, self.serving = self.servings[0]
+        self.call_rt = make_runtime("call_rt")
+        if caller_peer:
+            self.call_rt.enable_peer(fault_plan=caller_plan)
+        self.caller = Pipeline(
+            self.call_rt, calling_definition(),
+            element_classes={"PE_Src": PE_Src},
+            services_cache=ServicesCache(self.call_rt),
+            stream_lease_time=0, remote_timeout=remote_timeout,
+            remote_retries=retries, remote_backoff=0.2,
+            remote_backoff_max=1.0, retry_seed=3,
+            stream_failure_budget=failure_budget)
+        settle(engine, 100)
+        self.done = []
+        self.caller.add_frame_handler(self.done.append)
+        self.caller.create_stream("s1", lease_time=0)
+
+    def post(self, frames=1, steps=60):
+        for _ in range(frames):
+            self.caller.post("process_frame", "s1", {})
+            settle(self.engine, steps)
+
+    def serving_in(self, index=0):
+        return f"{self.servings[index][1].topic_path}/in"
+
+    def teardown(self):
+        for runtime in self.runtimes:
+            try:
+                if runtime.message is not None and \
+                        runtime.message.connected():
+                    runtime.terminate()
+                elif runtime.peer is not None:
+                    runtime.peer.close()
+            except Exception:
+                pass
+
+
+@pytest.fixture
+def system_factory(engine):
+    built = []
+
+    def factory(**kwargs):
+        system = System(engine, **kwargs)
+        built.append(system)
+        return system
+
+    yield factory
+    for system in built:
+        system.teardown()
+
+
+def test_data_plane_pins_and_broker_stays_flat(engine, system_factory):
+    system = system_factory()
+    assert system.caller.remote_elements_ready()
+    assert system.call_rt.peer.pinned(system.serving_in())
+    # serving side pinned the reply topic back to the same channel
+    assert system.serve_rt.peer.pinned(f"{system.caller.topic_path}/in")
+
+    routed_before = system.broker.stats["routed"]
+    system.post(frames=5)
+    assert len(system.done) == 5
+    assert np.allclose(system.done[0].swag["out"],
+                       np.arange(8, dtype=np.float32) * 2.0)
+    # the control/data split: every data envelope rode the channel,
+    # the broker routed NOTHING during steady state
+    assert system.broker.stats["routed"] == routed_before
+    assert system.call_rt.peer.stats["sent"] == 5      # requests
+    assert system.serve_rt.peer.stats["sent"] == 5     # replies
+    assert system.call_rt.peer.stats["received"] == 5
+
+
+def test_serving_without_peer_stays_on_broker(engine, system_factory):
+    system = system_factory(serving_peer=False)
+    assert system.caller.remote_elements_ready()
+    assert not system.call_rt.peer.pinned(system.serving_in())
+    routed_before = system.broker.stats["routed"]
+    system.post(frames=2)
+    assert len(system.done) == 2
+    assert system.broker.stats["routed"] > routed_before
+    assert system.call_rt.peer.stats["handshakes"] == 0
+
+
+def test_handshake_refused_falls_back_to_broker(engine, system_factory):
+    system = system_factory(
+        accept_handler=lambda name, kind: "caller-not-allowed")
+    assert system.caller.remote_elements_ready()
+    assert not system.call_rt.peer.pinned(system.serving_in())
+    # one refusal per discovery event that re-triggered negotiation
+    # (share-snapshot sync + live add) — never a retry storm
+    assert 1 <= system.serve_rt.peer.stats["refused"] <= 2
+    routed_before = system.broker.stats["routed"]
+    system.post(frames=3)
+    assert len(system.done) == 3            # broker path carried them
+    assert system.broker.stats["routed"] > routed_before
+    assert system.call_rt.peer.stats["sent"] == 0
+
+
+def test_stale_nonce_from_restarted_incarnation_rejected(
+        engine, system_factory):
+    system = system_factory()
+    host = system.call_rt.peer
+    # forge a stale discovery record: the endpoint token is current but
+    # the nonce belongs to a previous serving incarnation
+    kind, address, _ = parse_endpoints(
+        system.serve_rt.peer.tag.split("=", 1)[1])[0]
+    stale_tag = f"{kind}:{address}:deadbee1"
+    host.release(system.serving_in())       # drop the good channel
+    settle(engine)
+    before = dict(system.serve_rt.peer.stats)
+    host.negotiate(system.serving.topic_path, stale_tag,
+                   pin_topics=[system.serving_in()],
+                   reply_topics=[f"{system.caller.topic_path}/in"])
+    settle(engine, 80)
+    assert system.serve_rt.peer.stats["rejected_stale"] == \
+        before["rejected_stale"] + 1
+    assert not host.pinned(system.serving_in())
+    # the stale negotiation record is dropped — no retry loop
+    assert system.serving.topic_path not in host._negotiations
+    system.post(frames=1)
+    assert len(system.done) == 1            # broker path still serves
+
+
+def test_duplicate_handshake_replies_deduped(engine):
+    # chaos-duplicate the peer_accept reply: the first copy pins the
+    # channel, the duplicate is counted and ignored — one channel, no
+    # crash, no double pin
+    plan = FaultPlan(seed=5)
+    broker = ChaosBroker(plan, engine)
+    plan.duplicate(payload_match="peer_accept", count=1, copies=1)
+    system = System(engine, broker=broker)
+    try:
+        assert system.call_rt.peer.stats["dup_accepts"] == 1
+        assert len(system.call_rt.peer._channels) == 1
+        system.post(frames=2)
+        assert len(system.done) == 2
+        assert system.call_rt.peer.stats["sent"] == 2
+    finally:
+        system.teardown()
+
+
+def test_duplicate_peer_open_replays_accept_one_channel(engine):
+    # chaos-duplicate the peer_open REQUEST: the serving side must
+    # replay the same accept, never build a second channel pair
+    plan = FaultPlan(seed=6)
+    broker = ChaosBroker(plan, engine)
+    plan.duplicate(payload_match="peer_open", count=1, copies=1)
+    system = System(engine, broker=broker)
+    try:
+        assert len(system.serve_rt.peer._channels) == 1
+        assert system.serve_rt.peer.stats["accepted"] == 1
+        # the replayed accept deduped on the caller
+        assert system.call_rt.peer.stats["dup_accepts"] == 1
+        assert len(system.call_rt.peer._channels) == 1
+        system.post(frames=2)
+        assert len(system.done) == 2
+    finally:
+        system.teardown()
+
+
+def test_dropped_accepts_leak_no_channels(engine):
+    # every peer_accept is dropped: the handshake retries its bounded
+    # budget and gives up — and the serving-side channels registered
+    # for those handshakes are torn down when they expire (no leaked
+    # channels, pins, or offered ends)
+    plan = FaultPlan(seed=8)
+    broker = ChaosBroker(plan, engine)
+    plan.drop(payload_match="peer_accept")
+    system = System(engine, broker=broker)
+    try:
+        settle_virtual(engine, 10.0)        # all handshake attempts
+        host = system.call_rt.peer
+        assert not host.pinned(system.serving_in())
+        assert host.stats["expired_handshakes"] >= 1
+        assert not host._offered                # orphans closed
+        assert not host._pending
+        assert not system.serve_rt.peer._channels   # serving torn down
+        assert not system.serve_rt.peer._pins
+        system.post(frames=2)               # broker path still serves
+        assert len(system.done) == 2
+    finally:
+        system.teardown()
+
+
+def test_channel_death_mid_stream_redirects_and_renegotiates(
+        engine, system_factory):
+    # the request envelope is dropped ON the channel (chaos), the
+    # channel is then killed while the hop is in flight: the retry must
+    # redirect to the broker path, the frame completes, and after the
+    # renegotiate delay the data plane climbs back onto a fresh channel
+    plan = FaultPlan(seed=9)
+    system = system_factory(caller_plan=plan, retries=2,
+                            remote_timeout=1.0, failure_budget=2)
+    plan.drop(topic=system.serving_in(), count=1)
+    assert system.call_rt.peer.pinned(system.serving_in())
+
+    system.caller.post("process_frame", "s1", {})
+    settle(engine, 10)                      # send happened, reply won't
+    assert len(system.caller._pending_remote) == 1
+    killed = system.call_rt.peer.kill_channels("mid-stream-kill")
+    assert killed == 1
+    assert not system.call_rt.peer.pinned(system.serving_in())
+
+    routed_before = system.broker.stats["routed"]
+    settle_virtual(engine, 2.0)             # hop timeout + retry
+    assert len(system.done) == 1            # redirected via broker
+    assert system.broker.stats["routed"] > routed_before
+    assert system.caller.recovery_stats["retries"] >= 1
+    assert not system.caller._pending_remote
+
+    settle_virtual(engine, 1.0)             # renegotiate_delay elapsed
+    assert system.call_rt.peer.pinned(system.serving_in())
+    assert system.call_rt.peer.stats["renegotiations"] >= 1
+    sent_before = system.call_rt.peer.stats["sent"]
+    system.post(frames=1)
+    assert len(system.done) == 2
+    assert system.call_rt.peer.stats["sent"] > sent_before
+
+
+def test_failover_renegotiates_with_next_candidate(engine,
+                                                   system_factory):
+    system = system_factory(servings=2, retries=3, remote_timeout=1.0,
+                            failure_budget=3)
+    assert system.call_rt.peer.pinned(system.serving_in(0))
+    system.post(frames=1)
+    assert len(system.done) == 1
+
+    # the active serving dies: transport crash (LWT → registrar purge)
+    # plus its peer channels — like a real process kill
+    system.serve_rt.message.crash()
+    system.serve_rt.peer.kill_channels("process-kill")
+    settle(engine, 80)
+    system.caller.post("process_frame", "s1", {})
+    settle_virtual(engine, 3.0)
+    assert len(system.done) == 2            # failover served the frame
+    assert system.caller.recovery_stats["failovers"] >= 1
+    # and the data plane re-pinned onto the SECOND serving's channel
+    settle_virtual(engine, 1.0)
+    assert system.call_rt.peer.pinned(system.serving_in(1))
+
+
+def test_chaos_peer_drops_recovered_by_retries(engine, system_factory):
+    # FaultPlan gets the same control over peer channels it has over
+    # the broker: seeded drops on the channel, recovered by the hop
+    # retry machinery — zero lost frames, faults accounted
+    plan = FaultPlan(seed=13)
+    system = system_factory(caller_plan=plan, retries=4,
+                            remote_timeout=0.5, failure_budget=4)
+    plan.drop(topic=system.serving_in(), count=2)
+    for _ in range(4):
+        system.caller.post("process_frame", "s1", {})
+        settle_virtual(engine, 3.0)
+    assert len(system.done) == 4
+    assert plan.stats["drop"] == 2
+    assert system.caller.recovery_stats["retries"] >= 2
+    assert system.call_rt.peer.pinned(system.serving_in())
+
+
+@pytest.mark.slow
+def test_socket_channel_roundtrip_and_death():
+    # the same-host flavor: a unix-domain-socket channel negotiated
+    # through the control plane, real clock (reader threads are wall
+    # time).  Forcing kinds=("uds",) on the serving side keeps the
+    # caller from taking the in-process shortcut.
+    import socket as socket_module
+    import time
+
+    from aiko_services_tpu.event import EventEngine
+    if not hasattr(socket_module, "AF_UNIX"):
+        pytest.skip("no AF_UNIX on this platform")
+    engine = EventEngine()          # real clock
+    broker = MemoryBroker()
+    runtimes = []
+
+    def make_runtime(name):
+        def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+            return MemoryMessage(
+                on_message=on_message, broker=broker, lwt_topic=lwt_topic,
+                lwt_payload=lwt_payload, lwt_retain=lwt_retain,
+                client_id=name)
+        runtime = ProcessRuntime(name=name, engine=engine,
+                                 transport_factory=factory).initialize()
+        runtimes.append(runtime)
+        return runtime
+
+    sender, receiver = make_runtime("uds_a"), make_runtime("uds_b")
+    try:
+        sender.enable_peer(kinds=())    # mem endpoint only
+        receiver.enable_peer(kinds=("uds",))
+        # strip the mem descriptor so the caller must dial the socket
+        uds_only = ",".join(
+            desc for desc in
+            receiver.peer.tag.split("=", 1)[1].split(",")
+            if desc.startswith("uds:"))
+        assert uds_only
+        topic = f"{receiver.topic_path}/7/in"
+        got = []
+        receiver.add_message_handler(
+            lambda t, p: got.append((t, p)), topic)
+        sender.peer.negotiate(f"{receiver.topic_path}/7", uds_only,
+                              pin_topics=[topic], reply_topics=[])
+        assert engine.run_until(lambda: sender.peer.pinned(topic),
+                                timeout=5.0)
+        from aiko_services_tpu.transport import wire
+        payload = wire.encode_envelope(
+            "ping", [{"x": np.arange(4, dtype=np.float32)}])
+        sender.publish(topic, payload)
+        assert engine.run_until(lambda: len(got) == 1, timeout=5.0)
+        assert bytes(got[0][1]) == payload
+        assert sender.peer.stats["sent"] == 1
+        # death propagates across the socket: close the receiving end,
+        # the sender's reader sees EOF, unpins, and would renegotiate
+        receiver.peer.kill_channels("test-kill")
+        deadline = time.monotonic() + 5.0
+        while sender.peer.pinned(topic) and time.monotonic() < deadline:
+            engine.step()
+            time.sleep(0.01)
+        assert not sender.peer.pinned(topic)
+        # broker fallback still delivers
+        sender.publish(topic, payload)
+        assert engine.run_until(lambda: len(got) >= 2, timeout=5.0)
+    finally:
+        for runtime in runtimes:
+            runtime.terminate()
+
+
+def test_peer_host_closes_with_runtime(engine, system_factory):
+    from aiko_services_tpu.transport.peer import _MEM_ENDPOINTS
+    system = system_factory()
+    token = system.call_rt.peer.token
+    assert token in _MEM_ENDPOINTS
+    host = system.call_rt.peer
+    system.call_rt.terminate()
+    assert host.closed
+    assert token not in _MEM_ENDPOINTS
+    # the serving side saw the close and unpinned the reply topic
+    assert not system.serve_rt.peer.pinned(
+        f"{system.caller.topic_path}/in")

@@ -176,6 +176,64 @@ class TestWireEnvelope:
         assert np.abs(decoded["mel"] - mel).max() <= \
             np.abs(mel).max() / 127 + 1e-6
 
+    def test_i8mel_codec_tag(self):
+        # the ASR wire codec (ISSUE 6 satellite): per-ROW scales packed
+        # into the buffer — a quiet mel frame next to a loud one keeps
+        # its own resolution, unlike the one-scale generic i8
+        rng = np.random.default_rng(0)
+        mel = (rng.standard_normal((50, 80)) *
+               np.linspace(0.01, 4.0, 50)[:, None]).astype(np.float32)
+        payload = wire.encode_envelope("f", [{"mel": mel}],
+                                       codec_hints={"mel": "i8mel"})
+        assert len(payload) < mel.nbytes / 3       # ~3.8x smaller
+        _, (decoded,) = wire.decode_envelope(payload)
+        assert decoded["mel"].dtype == np.float32
+        assert decoded["mel"].shape == mel.shape
+        # per-row error bound: each row quantized against ITS absmax
+        row_bounds = np.abs(mel).max(axis=1, keepdims=True) / 127 + 1e-6
+        assert (np.abs(decoded["mel"] - mel) <= row_bounds).all()
+        # strictly better than the global-scale i8 on mixed dynamics
+        _, (global_decoded,) = self.roundtrip(
+            "f", [{"mel": mel}], codec_hints={"mel": "i8"})
+        def mse(a):
+            return float(((a - mel) ** 2).mean())
+        assert mse(decoded["mel"]) < mse(global_decoded["mel"])
+
+    def test_i8mel_rejects_wrong_rank_and_handles_nonfinite(self):
+        with np.testing.assert_raises(wire.WireError):
+            wire.encode_envelope(
+                "f", [{"mel": np.zeros((8,), np.float32)}],
+                codec_hints={"mel": "i8mel"})
+        mel = np.random.default_rng(1).standard_normal(
+            (6, 80)).astype(np.float32)
+        mel[2, 3] = np.inf
+        mel[4, 5] = np.nan
+        _, (decoded,) = self.roundtrip("f", [{"mel": mel}],
+                                       codec_hints={"mel": "i8mel"})
+        assert np.isfinite(decoded["mel"]).all()
+        # only the poisoned rows lose accuracy; the rest stay tight
+        clean = [0, 1, 3, 5]
+        bounds = np.abs(mel[clean]).max(axis=1, keepdims=True) / 127 \
+            + 1e-6
+        assert (np.abs(decoded["mel"][clean] - mel[clean])
+                <= bounds).all()
+
+    def test_i8mel_packed_rows_accepted_by_asr_collate_shape(self):
+        # mel_i8_pack → mel_i8_unpack is the contract PE_WhisperASR's
+        # collate relies on for pre-encoded int8 [T, M+4] payloads
+        from aiko_services_tpu.ops.audio import mel_i8_pack, \
+            mel_i8_unpack
+        mel = np.random.default_rng(2).standard_normal(
+            (20, 80)).astype(np.float32)
+        packed = mel_i8_pack(mel)
+        assert packed.dtype == np.int8 and packed.shape == (20, 84)
+        back = mel_i8_unpack(packed)
+        assert back.shape == mel.shape
+        assert np.abs(back - mel).max() <= np.abs(mel).max() / 127 + 1e-6
+        # empty chunk round-trips
+        assert mel_i8_unpack(mel_i8_pack(
+            np.zeros((0, 80), np.float32))).shape == (0, 80)
+
     def test_dct8_codec_matches_device_decoder(self):
         from aiko_services_tpu.ops.image_wire import (dct8_decode,
                                                       dct8_encode)
