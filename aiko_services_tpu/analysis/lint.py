@@ -25,12 +25,26 @@
 #                         (delivery can re-enter or block under the lock)
 #   lint-jit-hot          jax.jit in per-frame code (a recompile per
 #                         frame-shape: the classic serving latency cliff)
+#   lint-hot-alloc        numpy/jnp array CONSTRUCTION (np.zeros,
+#                         jnp.full, arange, ...) inside a function
+#                         marked `# graft: hot-path` — the serving pump
+#                         loop's per-round allocations are death by a
+#                         thousand cuts at high round rates; preallocate
+#                         in __init__ and refill in place.  Transfers
+#                         (np.asarray / jnp.array of an existing
+#                         buffer) are NOT flagged: moving bytes to the
+#                         device is the round's job, allocating fresh
+#                         host arrays per round is not.
 #   lint-print            bare print( in package (non-test) modules:
 #                         telemetry must flow through utils.logger or
 #                         the observe metrics registry, where it is
 #                         levelled, routable, and exportable — stdout
 #                         is none of those (CLIs and deliberate console
 #                         tools carry per-line waivers)
+#
+# Hot-path marking: a `graft: hot-path` comment on (or directly above)
+# a `def` line opts that function into the allocation rule — purely
+# lexical, like the waivers, so it works on user element files too.
 #
 # Waivers: a line (or its enclosing statement's first line) containing
 # `graft: disable=<rule-id>` (or `graft: disable=all`) suppresses that
@@ -46,7 +60,17 @@ from .findings import ERROR, Finding
 __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
-              "lint-publish-locked", "lint-jit-hot", "lint-print")
+              "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
+              "lint-print")
+
+_HOT_MARKER = "graft: hot-path"
+# array CONSTRUCTORS (fresh allocation per call).  asarray/array are
+# deliberately absent: in a hot loop they are host→device transfers of
+# existing buffers, which the round cannot avoid.
+_ALLOC_TAILS = {"zeros", "ones", "empty", "full", "zeros_like",
+                "ones_like", "full_like", "empty_like", "arange",
+                "linspace", "eye"}
+_ALLOC_MODULES = {"np", "numpy", "jnp", "jax.numpy"}
 
 _HANDLER_REGISTRARS = {
     "add_timer_handler", "add_oneshot_handler", "add_mailbox_handler",
@@ -109,14 +133,18 @@ def _mentions_lock(node: ast.AST) -> bool:
 
 
 class _ContextScanner(ast.NodeVisitor):
-    """Scan one event-loop-context function body for blocking calls and
-    jit use.  Nested function definitions and lambdas are NOT descended
-    into: a nested thread target may legitimately block, and nested
-    registered handlers get their own scan from the module linter."""
+    """Scan one event-loop-context (and/or hot-path) function body for
+    blocking calls, jit use, and per-round allocations.  Nested
+    function definitions and lambdas are NOT descended into: a nested
+    thread target may legitimately block, and nested registered
+    handlers get their own scan from the module linter."""
 
-    def __init__(self, lint, context_name):
+    def __init__(self, lint, context_name, event: bool = True,
+                 hot: bool = False):
         self.lint = lint
         self.context = context_name
+        self.event = event
+        self.hot = hot
 
     def scan(self, node):
         for child in ast.iter_child_nodes(node):
@@ -131,23 +159,33 @@ class _ContextScanner(ast.NodeVisitor):
     def visit_Call(self, node):
         tail = _func_tail(node.func)
         target = ast.unparse(node.func)
-        if target == "time.sleep":
+        if self.event:
+            if target == "time.sleep":
+                self.lint.report(
+                    "lint-blocking-call", node,
+                    f"time.sleep in event-loop context {self.context!r} "
+                    f"stalls every pipeline in the process (use a timer "
+                    f"handler)")
+            elif tail in _BLOCKING_ATTRS:
+                self.lint.report(
+                    "lint-blocking-call", node,
+                    f".{tail}() in event-loop context {self.context!r}: "
+                    f"{_BLOCKING_ATTRS[tail]}")
+            if target in ("jax.jit", "jit"):
+                self.lint.report(
+                    "lint-jit-hot", node,
+                    f"jax.jit in per-frame context {self.context!r}: "
+                    f"build the jitted program once in __init__/_setup "
+                    f"(per-frame jit recompiles per shape)")
+        if self.hot and tail in _ALLOC_TAILS and \
+                target.rpartition(".")[0] in _ALLOC_MODULES:
             self.lint.report(
-                "lint-blocking-call", node,
-                f"time.sleep in event-loop context {self.context!r} "
-                f"stalls every pipeline in the process (use a timer "
-                f"handler)")
-        elif tail in _BLOCKING_ATTRS:
-            self.lint.report(
-                "lint-blocking-call", node,
-                f".{tail}() in event-loop context {self.context!r}: "
-                f"{_BLOCKING_ATTRS[tail]}")
-        if target in ("jax.jit", "jit"):
-            self.lint.report(
-                "lint-jit-hot", node,
-                f"jax.jit in per-frame context {self.context!r}: build "
-                f"the jitted program once in __init__/_setup (per-frame "
-                f"jit recompiles per shape)")
+                "lint-hot-alloc", node,
+                f"{target}() allocates a fresh array every pass through "
+                f"hot path {self.context!r}: preallocate in "
+                f"__init__/_setup and refill in place (per-round host "
+                f"allocations are the pump loop's death by a thousand "
+                f"cuts)")
         self.generic_visit(node)
 
 
@@ -222,10 +260,24 @@ class _Linter(ast.NodeVisitor):
                 "away under python -O — raise ValueError/RuntimeError")
         self.generic_visit(node)
 
-    # -- event-loop contexts -----------------------------------------------
+    # -- event-loop / hot-path contexts ------------------------------------
+    def _hot_marked(self, node) -> bool:
+        """`graft: hot-path` on the def line (or the line above —
+        decorator or standalone comment) opts the function into the
+        allocation rule."""
+        for line_number in (node.lineno, node.lineno - 1):
+            if 1 <= line_number <= len(self.lines) and \
+                    _HOT_MARKER in self.lines[line_number - 1]:
+                return True
+        return False
+
     def visit_FunctionDef(self, node):
-        if node.name in _FRAME_METHODS or node.name in self.handler_names:
-            _ContextScanner(self, node.name).scan(node)
+        event = node.name in _FRAME_METHODS or \
+            node.name in self.handler_names
+        hot = self._hot_marked(node)
+        if event or hot:
+            _ContextScanner(self, node.name, event=event,
+                            hot=hot).scan(node)
         self.generic_visit(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef

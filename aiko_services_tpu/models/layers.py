@@ -27,6 +27,7 @@ __all__ = [
     "conv1d_init", "conv1d", "conv1d_axes",
     "mha_init", "mha", "mha_axes", "precompute_kv", "init_kv_cache",
     "update_kv_cache", "quantize_linear", "quantize_linear_tree",
+    "quantize_kv_cache", "dequantize_kv_cache",
     "linear_logits",
     "sinusoid_position_encoding", "gelu", "rope_frequencies", "apply_rope",
 ]
@@ -329,6 +330,37 @@ def dequantize_kv(kv, dtype):
     """Inverse of quantize_kv; passes plain arrays through."""
     if isinstance(kv, dict) and "q" in kv:
         return (kv["q"].astype(dtype) * kv["s"].astype(dtype))
+    return kv
+
+
+def quantize_kv_cache(tensor):
+    """Symmetric int8 for the SERVING KV cache (continuous batching):
+    one f32 scale per (..., position) — for a [S, H, T, D] slot cache
+    that is per (slot, head, position), the finest grain whose dequant
+    still FOLDS instead of materializing.  Unlike quantize_kv's
+    "position" mode (a [..., T, 1] broadcast-multiply the decode scan
+    re-materializes every step, measured −24%), this scale's shape
+    [..., T] is consumed by serving's decode attention as a fold along
+    the score/weight time axis: scores·s_k on the QK pass and
+    weights·s_v before the PV pass — exact algebra, so the int8 buffer
+    stays the dot operand (the convert fuses) and the cache read is
+    halved, which is the HBM-bound decode step's dominant byte.
+
+    Returns {"q": int8 [..., T, D], "s": f32 [..., T]}."""
+    scale = (jnp.max(jnp.abs(tensor), axis=-1).astype(jnp.float32)
+             / 127.0 + 1e-12)
+    q = jnp.clip(jnp.round(tensor.astype(jnp.float32) /
+                           scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def dequantize_kv_cache(kv, dtype):
+    """Inverse of quantize_kv_cache; passes plain arrays through.  The
+    materializing path — serving's prefill-extend uses it OFF the
+    decode critical path; the decode scan folds instead."""
+    if isinstance(kv, dict) and "q" in kv:
+        return kv["q"].astype(dtype) * kv["s"][..., None].astype(dtype)
     return kv
 
 

@@ -17,7 +17,26 @@
 #     and scattered into a free slot's cache rows;
 #   * K decode steps run per device round via lax.scan
 #     (steps_per_sync), so the host syncs [K, S] tokens instead of
-#     round-tripping per token — the tunnel/PCIe cost amortizes.
+#     round-tripping per token — the tunnel/PCIe cost amortizes;
+#   * prefill runs OFF the decode critical path (ISSUE 7): each pump
+#     round dispatches the decode scan FIRST, then queues admit/extend
+#     device calls BEHIND it — they execute while the host syncs the
+#     scan and resolves tokens, so a decode round's sync never waits on
+#     prefill (the Sarathi-Serve stall-free discipline).  A freshly
+#     admitted slot's first token resolves from the admit program's own
+#     output at the NEXT round's sync — the compiled decode step no
+#     longer carries the deferred-admit resolution;
+#   * the KV cache is storable as int8 with per-(slot, head, position)
+#     scales (kv_cache_dtype="int8"): admits/extends write quantized
+#     rows, the decode scan folds the scales into scores/weights
+#     (layers.quantize_kv_cache) — the HBM-bound step's dominant read
+#     is halved;
+#   * self-speculative multi-token decoding (speculate_k=k): a
+#     prompt-lookup n-gram drafter over a device-side context buffer
+#     proposes k tokens per slot, one widened forward verifies the
+#     (1+k)-token block, and greedy acceptance advances each slot by
+#     its accepted run — provably the same tokens as the
+#     non-speculative path, but up to 1+k tokens per weight-stream.
 #
 # The reference has no generation serving at all (its LLM hop is a
 # blocking HTTP call: reference examples/speech/speech_elements.py:
@@ -26,6 +45,7 @@
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 import time
 from collections import deque
@@ -49,28 +69,37 @@ def measure_device_step(decoder, steps_per_sync: int = 64,
     back-to-back rounds, ONE host sync at the end — separates device
     compute from the tunnel's ~0.1 s per-round dispatch+sync.  The
     single methodology behind the bench's llama_device_step_ms and
-    tools/ab_w8.py, so the two cannot drift."""
+    tools/ab_w8.py, so the two cannot drift.  Probes the decoder's OWN
+    configuration (int8 KV layout, speculative step) — in speculative
+    mode the number is per VERIFY iteration, which emits up to
+    1 + speculate_k tokens."""
     config = decoder.config
     slots = decoder.max_slots
-    shape = (slots, config.num_kv_heads, decoder._cache_t,
-             config.head_dim)
-    k_probe = [jnp.zeros(shape, config.dtype)
-               for _ in range(config.num_layers)]
-    v_probe = [jnp.zeros(shape, config.dtype)
-               for _ in range(config.num_layers)]
+    k_probe = decoder._zero_caches()
+    v_probe = decoder._zero_caches()
     tokens = jnp.ones((slots,), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
     active = jnp.ones((slots,), bool)
     budgets = jnp.full((slots,), 1 << 30, jnp.int32)
+    context = jnp.zeros((slots, decoder.max_seq), jnp.int32) \
+        if decoder.speculate_k else None
 
     def chain(rounds):
-        nonlocal k_probe, v_probe, tokens, lengths
+        nonlocal k_probe, v_probe, tokens, lengths, context
         out = None
         for _ in range(rounds):
-            out = decoder._step(decoder.params, tokens, lengths,
-                                active, budgets, k_probe, v_probe,
-                                num_steps=steps_per_sync, eos=-1)
-            _, _, _, tokens, lengths, k_probe, v_probe = out
+            if decoder.speculate_k:
+                out = decoder._step(decoder.params, tokens, lengths,
+                                    active, budgets, context, k_probe,
+                                    v_probe, num_steps=steps_per_sync,
+                                    eos=-1)
+                (_, _, tokens, lengths, context, k_probe,
+                 v_probe) = out
+            else:
+                out = decoder._step(decoder.params, tokens, lengths,
+                                    active, budgets, k_probe, v_probe,
+                                    num_steps=steps_per_sync, eos=-1)
+                _, _, tokens, lengths, k_probe, v_probe = out
         np.asarray(out[0][-1])          # one sync for the chain
     chain(1)                             # warm (compile cache hit)
     start = time.perf_counter()
@@ -267,6 +296,83 @@ def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
             k_cache, v_cache)
 
 
+def _kv_planes(cache, dtype):
+    """(dot-operand values, fold scale or None) for a main-cache leaf.
+    int8 caches (layers.quantize_kv_cache) keep the int8 buffer as the
+    dot operand — the convert fuses, nothing re-materializes — and
+    hand back the per-(slot, head, position) scale for folding into
+    scores (K) and weights (V): the same fold discipline as
+    layers.mha's quantized cross-KV, at serving's per-position
+    grain."""
+    if isinstance(cache, dict):
+        return cache["q"].astype(dtype), cache["s"]
+    return cache, None
+
+
+def _cache_time(cache) -> int:
+    """Time-axis extent of a main-cache leaf (array or int8 dict)."""
+    return (cache["q"] if isinstance(cache, dict) else cache).shape[2]
+
+
+def _grouped_block_attention(layer, config: LlamaConfig, x, cos, sin,
+                             k_cache, v_cache, k_side, v_side,
+                             entry_lengths, lengths, write_index,
+                             side_valid):
+    """Shared core of the block-KV decode attentions: project QKV for
+    the [S, W] block at per-slot positions `lengths`, write this
+    block's K/V into the side buffers at `write_index`, and attend
+    over read-only main cache (positions < entry_lengths — causally
+    visible to every query) + side entries selected by the caller's
+    `side_valid` mask ([S,1,1,W,P]-broadcastable).  The ONE place the
+    greedy numerics live: the plain scan (W=1) and the speculative
+    verify (W=1+k) must stay the same computation or the
+    greedy-equivalence invariant breaks.  int8 main caches
+    (layers.quantize_kv_cache) keep the int8 buffer as the dot operand
+    and fold their per-(slot, head, position) scales into the main
+    scores (K) and weights (V); the side buffers stay in the compute
+    dtype (they are one round wide — quantizing them would save
+    nothing and cost an int8 round-trip every step)."""
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    q, k, v = _project_qkv(layer, config, x)
+    q = L.apply_rope(q, cos, sin, lengths)
+    k = L.apply_rope(k, cos, sin, lengths)
+    k_side = jax.lax.dynamic_update_slice_in_dim(k_side, k, write_index,
+                                                 axis=2)
+    v_side = jax.lax.dynamic_update_slice_in_dim(v_side, v, write_index,
+                                                 axis=2)
+
+    slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
+    group = num_heads // num_kv
+    q_grouped = q.reshape(slots_n, num_kv, group, num_q, head_dim)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    k_main, k_fold = _kv_planes(k_cache, x.dtype)
+    v_main, v_fold = _kv_planes(v_cache, x.dtype)
+    main_t = k_main.shape[2]
+    main_valid = (jnp.arange(main_t)[None] <
+                  entry_lengths[:, None])[:, None, None, None]
+    scores_main = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_main,
+                             preferred_element_type=jnp.float32) * scale
+    if k_fold is not None:
+        scores_main = scores_main * k_fold[:, :, None, None, :]
+    scores_side = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_side,
+                             preferred_element_type=jnp.float32) * scale
+    scores = jnp.concatenate(
+        [jnp.where(main_valid, scores_main, -1e30),
+         jnp.where(side_valid, scores_side, -1e30)], axis=-1)
+    weights = jax.nn.softmax(scores, axis=-1)
+    w_main = weights[..., :main_t]
+    if v_fold is not None:
+        w_main = w_main * v_fold[:, :, None, None, :]
+    out = jnp.einsum("skgqt,sktd->skgqd", w_main.astype(v_main.dtype),
+                     v_main, preferred_element_type=jnp.float32) + \
+        jnp.einsum("skgqt,sktd->skgqd",
+                   weights[..., main_t:].astype(v_side.dtype), v_side,
+                   preferred_element_type=jnp.float32)
+    out = out.reshape(slots_n, num_heads, num_q, head_dim).astype(x.dtype)
+    return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
+            k_side, v_side)
+
+
 def _slot_attention_block(layer, config: LlamaConfig, x, cos, sin,
                           k_cache, v_cache, k_side, v_side,
                           entry_lengths, lengths, step_index):
@@ -275,42 +381,35 @@ def _slot_attention_block(layer, config: LlamaConfig, x, cos, sin,
     buffers at scan indices [0, step_index].  The new token's K/V is
     written to side[:, :, step_index] — a slot-uniform index, so XLA
     keeps the update in place instead of rewriting the whole cache."""
-    num_heads, num_kv = config.num_heads, config.num_kv_heads
-    q, k, v = _project_qkv(layer, config, x)
-    q = L.apply_rope(q, cos, sin, lengths)
-    k = L.apply_rope(k, cos, sin, lengths)
-    k_side = jax.lax.dynamic_update_slice_in_dim(k_side, k, step_index,
-                                                 axis=2)
-    v_side = jax.lax.dynamic_update_slice_in_dim(v_side, v, step_index,
-                                                 axis=2)
-
-    slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
-    group = num_heads // num_kv
-    q_grouped = q.reshape(slots_n, num_kv, group, num_q, head_dim)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
-    main_valid = (jnp.arange(k_cache.shape[2])[None] <
-                  entry_lengths[:, None])[:, None, None, None]
     side_positions = jnp.arange(k_side.shape[2])
     side_valid = ((side_positions[None] <= step_index) &
                   (side_positions[None] <
                    (lengths - entry_lengths + 1)[:, None])
                   )[:, None, None, None]
-    scores_main = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_cache,
-                             preferred_element_type=jnp.float32) * scale
-    scores_side = jnp.einsum("skgqd,sktd->skgqt", q_grouped, k_side,
-                             preferred_element_type=jnp.float32) * scale
-    scores = jnp.concatenate(
-        [jnp.where(main_valid, scores_main, -1e30),
-         jnp.where(side_valid, scores_side, -1e30)], axis=-1)
-    weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    main_t = k_cache.shape[2]
-    out = jnp.einsum("skgqt,sktd->skgqd", weights[..., :main_t],
-                     v_cache, preferred_element_type=jnp.float32) + \
-        jnp.einsum("skgqt,sktd->skgqd", weights[..., main_t:], v_side,
-                   preferred_element_type=jnp.float32)
-    out = out.reshape(slots_n, num_heads, num_q, head_dim).astype(x.dtype)
-    return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
-            k_side, v_side)
+    return _grouped_block_attention(layer, config, x, cos, sin,
+                                    k_cache, v_cache, k_side, v_side,
+                                    entry_lengths, lengths, step_index,
+                                    side_valid)
+
+
+def _slot_attention_spec(layer, config: LlamaConfig, x, cos, sin,
+                         k_cache, v_cache, k_side, v_side, pos_side,
+                         entry_lengths, lengths, base):
+    """Widened block-KV attention for the speculative verify step: `x`
+    carries w = 1 + speculate_k tokens per slot at absolute positions
+    lengths + [0, w).  The round's tokens live in the side buffers
+    tagged with their ABSOLUTE cache positions (`pos_side` — rejected
+    drafts are invalidated to an out-of-bounds position and never
+    attended), so causality inside and across verify blocks is one
+    comparison: pos_side <= q_pos."""
+    width = x.shape[1]
+    q_pos = lengths[:, None] + jnp.arange(width)[None]       # [S, w]
+    side_valid = (pos_side[:, None, :] <=
+                  q_pos[:, :, None])[:, None, None]      # [S,1,1,w,P]
+    return _grouped_block_attention(layer, config, x, cos, sin,
+                                    k_cache, v_cache, k_side, v_side,
+                                    entry_lengths, lengths, base,
+                                    side_valid)
 
 
 def _fuse_decode_projections(params):
@@ -379,34 +478,39 @@ def _project_qkv(layer, config: LlamaConfig, x):
     return q, k, v
 
 
+def _token_block_argmax(params, config: LlamaConfig, token_block,
+                        attend):
+    """Shared transformer pass over a [S, W] token block: `attend(i,
+    layer, normed)` supplies each layer's attention output (and owns
+    the cache-write strategy).  Returns the per-position argmax
+    [S, W] — bf16 operand reads (an f32 UPCAST of the [dim, vocab]
+    head would double the step's largest weight read), f32
+    accumulation KEPT f32 into the argmax: rounding the logits to
+    bf16 first can flip near-ties against the f32 oracle.  W is 1 for
+    the plain decode step and 1 + speculate_k for the verify step."""
+    x = L.embedding(params["embed"], token_block).astype(config.dtype)
+    for i, layer in enumerate(params["layers"]):
+        x = x + attend(i, layer, L.rms_norm(layer["ln_attn"], x))
+        normed = L.rms_norm(layer["ln_mlp"], x)
+        # dense SwiGLU or MoE per the config — MoE llama serves
+        # through the same continuous-batching step
+        x = x + llama_ffn(layer, config, normed)
+    x = L.rms_norm(params["ln_out"], x)
+    logits = L.linear_logits(params["lm_head"], x)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def _build_step(config: LlamaConfig):
     """One decode iteration for every slot; jitted once, caches donated
     so the slot buffers update in place on device.  Params are an
     ARGUMENT, not a closure capture — captured trees get baked into the
     compiled program as constants (gigabytes for real checkpoints,
-    duplicated per recompile)."""
+    duplicated per recompile).  Since ISSUE 7 the step does NOT return
+    the entry tokens: deferred admits resolve from the admit program's
+    own output at the next round's sync, so the decode scan carries
+    nothing on behalf of prefill."""
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
-
-    def run_layers(params, tokens, attend):
-        """Shared per-token transformer pass: `attend(i, layer,
-        normed)` supplies each layer's attention output (and owns the
-        cache-write strategy)."""
-        x = L.embedding(params["embed"],
-                        tokens[:, None]).astype(config.dtype)
-        for i, layer in enumerate(params["layers"]):
-            x = x + attend(i, layer, L.rms_norm(layer["ln_attn"], x))
-            normed = L.rms_norm(layer["ln_mlp"], x)
-            # dense SwiGLU or MoE per the config — MoE llama serves
-            # through the same continuous-batching step
-            x = x + llama_ffn(layer, config, normed)
-        x = L.rms_norm(params["ln_out"], x)
-        # bf16 operand reads (an f32 UPCAST of the [dim, vocab] head
-        # would double the step's largest weight read), f32
-        # accumulation KEPT f32 into the argmax — rounding the logits
-        # to bf16 first can flip near-ties against the f32 oracle
-        logits = L.linear_logits(params["lm_head"], x)
-        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
 
     def one_token(params, tokens, lengths, active, k_caches, v_caches):
         new_k, new_v = [], []
@@ -419,7 +523,8 @@ def _build_step(config: LlamaConfig):
             new_v.append(v_c)
             return attn_out
 
-        next_tokens = run_layers(params, tokens, attend)
+        next_tokens = _token_block_argmax(params, config,
+                                          tokens[:, None], attend)[:, 0]
         return next_tokens, new_k, new_v
 
     def step_k(params, tokens, lengths, active, budgets, k_caches,
@@ -443,15 +548,11 @@ def _build_step(config: LlamaConfig):
             return ((next_tokens, lengths, still, budgets, k_caches,
                      v_caches), (next_tokens, active))
 
-        tokens_in = tokens
         (tokens, lengths, active, budgets, k_caches, v_caches), \
             (emitted, emitted_active) = jax.lax.scan(
                 body, (tokens, lengths, active, budgets, k_caches,
                        v_caches), None, length=num_steps)
-        # tokens_in rides along so deferred admits resolve their first
-        # token on THIS round's host sync instead of paying their own
-        # device round-trip (see _admit_group)
-        return (emitted, emitted_active, tokens_in, tokens, lengths,
+        return (emitted, emitted_active, tokens, lengths,
                 k_caches, v_caches)
 
     def step_k_block(params, tokens, lengths, active, budgets,
@@ -461,7 +562,10 @@ def _build_step(config: LlamaConfig):
         K/V land in [S, H, num_steps, D] side buffers at the scan
         index, and one per-slot merge runs after the scan.  Removes
         the per-step full-cache writes that made each step touch the
-        KV ~4x (measured slope 37.9 us/T vs a 10.2 read-only floor)."""
+        KV ~4x (measured slope 37.9 us/T vs a 10.2 read-only floor).
+        int8 main caches (kv_cache_dtype="int8") are read via the
+        scale fold and the merge quantizes the side rows ONCE per
+        round — the scan itself never touches int8 encode."""
         entry_lengths = lengths
         entry_active = active
         slots_n = tokens.shape[0]
@@ -485,7 +589,8 @@ def _build_step(config: LlamaConfig):
                 new_v.append(v_s)
                 return attn_out
 
-            next_tokens = run_layers(params, tokens, attend)
+            next_tokens = _token_block_argmax(
+                params, config, tokens[:, None], attend)[:, 0]
             next_tokens = jnp.where(active, next_tokens, tokens)
             lengths = jnp.where(active, lengths + 1, lengths)
             budgets = jnp.where(active, budgets - 1, budgets)
@@ -493,7 +598,6 @@ def _build_step(config: LlamaConfig):
             return ((next_tokens, lengths, still, budgets, new_k,
                      new_v), (next_tokens, active))
 
-        tokens_in = tokens
         (tokens, lengths, active, budgets, k_sides, v_sides), \
             (emitted, emitted_active) = jax.lax.scan(
                 body, (tokens, lengths, active, budgets, k_sides,
@@ -509,10 +613,23 @@ def _build_step(config: LlamaConfig):
         # extend chunks are writing (the same corruption the select
         # mode's write_mask guards against).
         merge_at = jnp.minimum(entry_lengths,
-                               k_caches[0].shape[2] - num_steps)
+                               _cache_time(k_caches[0]) - num_steps)
         keep = entry_active[:, None, None, None]
+        keep_s = entry_active[:, None, None]
 
         def merge(cache, side):
+            if isinstance(cache, dict):
+                quant = L.quantize_kv_cache(side)
+                new_q = jax.vmap(
+                    lambda row, srow, off: jax.lax.dynamic_update_slice(
+                        row, srow, (0, off, 0)))(cache["q"], quant["q"],
+                                                 merge_at)
+                new_s = jax.vmap(
+                    lambda row, srow, off: jax.lax.dynamic_update_slice(
+                        row, srow, (0, off)))(cache["s"], quant["s"],
+                                              merge_at)
+                return {"q": jnp.where(keep, new_q, cache["q"]),
+                        "s": jnp.where(keep_s, new_s, cache["s"])}
             updated = jax.vmap(
                 lambda row, srow, off: jax.lax.dynamic_update_slice(
                     row, srow, (0, off, 0)))(cache, side, merge_at)
@@ -522,7 +639,7 @@ def _build_step(config: LlamaConfig):
                         for i in range(config.num_layers)]
         new_v_caches = [merge(v_caches[i], v_sides[i])
                         for i in range(config.num_layers)]
-        return (emitted, emitted_active, tokens_in, tokens, lengths,
+        return (emitted, emitted_active, tokens, lengths,
                 new_k_caches, new_v_caches)
 
     return jax.jit(step_k_block if KV_WRITE == "block" else step_k,
@@ -530,14 +647,214 @@ def _build_step(config: LlamaConfig):
                    donate_argnames=("k_caches", "v_caches"))
 
 
+@functools.lru_cache(maxsize=16)
+def _step_for(config: LlamaConfig, kv_write: str, attention_impl: str):
+    """Process-wide cache of compiled step builders: decoders sharing
+    a config share ONE jit object, so the XLA executables inside it
+    (keyed by shapes / static args) are reused across instances —
+    rebuilding a decoder, or building several in one process (tests,
+    A/B tools, multi-tenant serving), pays no recompile.  Keyed on the
+    module toggles too, so tools that flip serving.KV_WRITE /
+    ATTENTION_IMPL (ab_decode_attention) still get the variant they
+    set."""
+    return _build_step(config)
+
+
+# invalid side-buffer / context position: far past any legal cache
+# index, so pos-based causal masks fail and scatter merges drop it
+# (mode="drop") instead of corrupting a live row
+_POS_INVALID = 1 << 30
+
+
+def _build_spec_step(config: LlamaConfig, k_spec: int, ngram: int):
+    """Self-speculative decode scan (speculate_k): each iteration
+    drafts `k_spec` tokens per slot by prompt lookup — an n-gram match
+    against the slot's OWN device-side context buffer, no second
+    model (Leviathan et al. 2023 acceptance over a self-drafter) —
+    then scores the (1 + k_spec)-token block in ONE widened forward
+    and advances each slot by its accepted run.  Greedy acceptance:
+    draft j survives iff the model's argmax after consuming tokens
+    < j equals it, and the first miss is replaced by the model's own
+    argmax — so the emitted stream is PROVABLY the non-speculative
+    greedy stream; speculation only changes how many tokens one
+    weight-stream yields (the decode step is HBM-bound: the widened
+    matmuls re-read the same weights once).
+
+    Block-KV discipline with absolute positions: the main cache stays
+    read-only through the scan; the round's tokens land in side
+    buffers tagged `pos_side` (rejected drafts invalidated to
+    _POS_INVALID) and scatter-merge into the main cache once per
+    round, out-of-bounds entries dropping on the floor."""
+    cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
+                                  config.rope_theta)
+    width = k_spec + 1
+
+    def draft(context, tokens, lengths):
+        """Prompt-lookup drafts [S, k_spec]: match the last `ngram`
+        tokens (the pending token + ngram-1 history tokens) at every
+        history position, take the LATEST hit, and propose the tokens
+        that followed it.  A miss proposes zeros — certain rejection,
+        which costs nothing extra: the verify block runs at width
+        1 + k_spec regardless, and acceptance never affects WHICH
+        tokens are emitted, only how many per iteration."""
+        ctx_len = context.shape[1]
+        pos = jnp.arange(ctx_len)[None]                      # [1, C]
+        hit = (pos >= ngram - 1) & (pos < lengths[:, None]) & \
+            (context == tokens[:, None])
+        for i in range(1, ngram):
+            prev = jnp.take_along_axis(
+                context, jnp.maximum(lengths[:, None] - i, 0), axis=1)
+            # roll never wraps into the valid region: hit requires
+            # pos >= ngram-1 >= i
+            hit = hit & (jnp.roll(context, i, axis=1) == prev)
+        # prefer the latest hit whose continuation is FULLY written
+        # history (k real tokens follow it); fall back to the latest
+        # with at least one — a frontier hit would draft unwritten
+        # garbage and waste the verify width on certain rejections
+        full = hit & (pos <= lengths[:, None] - 1 - k_spec)
+        some = hit & (pos < lengths[:, None] - 1)
+        best_full = jnp.max(jnp.where(full, pos, -1), axis=1)
+        best_some = jnp.max(jnp.where(some, pos, -1), axis=1)
+        best = jnp.where(best_full >= 0, best_full, best_some)  # [S]
+        take = jnp.clip(best[:, None] + 1 + jnp.arange(k_spec)[None],
+                        0, ctx_len - 1)
+        drafts = jnp.take_along_axis(context, take, axis=1)
+        return jnp.where(best[:, None] >= 0, drafts, 0)
+
+    def spec_step(params, tokens, lengths, active, budgets, context,
+                  k_caches, v_caches, num_steps, eos):
+        entry_lengths = lengths
+        slots_n = tokens.shape[0]
+        side_len = num_steps * width
+        side_shape = (slots_n, config.num_kv_heads, side_len,
+                      config.head_dim)
+        k_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        v_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        pos_side = jnp.full((slots_n, side_len), _POS_INVALID,
+                            jnp.int32)
+        col = jnp.arange(width)[None]                        # [1, w]
+        row = jnp.arange(slots_n)[:, None]                   # [S, 1]
+
+        def body(carry, step_index):
+            (tokens, lengths, active, budgets, context, k_sides,
+             v_sides, pos_side) = carry
+            drafts = draft(context, tokens, lengths)
+            seq = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            base = step_index * width
+            q_pos = lengths[:, None] + col                   # [S, w]
+            # provisional: the whole block is live while it attends to
+            # itself; rejected entries are invalidated after acceptance
+            pos_side = jax.lax.dynamic_update_slice(pos_side, q_pos,
+                                                    (0, base))
+            new_k, new_v = [], []
+
+            def attend(i, layer, normed):
+                attn_out, k_s, v_s = _slot_attention_spec(
+                    layer, config, normed, cos, sin, k_caches[i],
+                    v_caches[i], k_sides[i], v_sides[i], pos_side,
+                    entry_lengths, lengths, base)
+                new_k.append(k_s)
+                new_v.append(v_s)
+                return attn_out
+
+            block_argmax = _token_block_argmax(params, config, seq,
+                                               attend)      # [S, w]
+            k_sides, v_sides = new_k, new_v
+            # greedy acceptance: argmax after consuming seq[:j] must
+            # reproduce draft j; the first miss takes the model's own
+            # token (always emitted — that is the non-speculative step)
+            match = (drafts == block_argmax[:, :-1])
+            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                           axis=1), axis=1)  # [S]
+            can = (col <= accepted[:, None]) & \
+                (col < budgets[:, None]) & active[:, None]
+            stop = (block_argmax == eos) & can
+            keep = jnp.cumprod(1 - stop.astype(jnp.int32), axis=1)
+            keep_excl = jnp.concatenate(
+                [jnp.ones((slots_n, 1), jnp.int32), keep[:, :-1]],
+                axis=1)
+            emit = can & (keep_excl > 0)
+            emitted_n = jnp.sum(emit, axis=1).astype(jnp.int32)
+            last = jnp.take_along_axis(
+                block_argmax, jnp.maximum(emitted_n - 1, 0)[:, None],
+                axis=1)[:, 0]
+            tokens = jnp.where(emitted_n > 0, last, tokens)
+            # context gets the whole block for active slots: entries
+            # past the consumed run are garbage BEYOND the new length,
+            # overwritten by the next iteration before the drafter
+            # (masked to pos < length) could ever read them
+            ctx_pos = jnp.where(active[:, None], q_pos, _POS_INVALID)
+            context = context.at[row, ctx_pos].set(seq, mode="drop")
+            lengths = lengths + emitted_n
+            budgets = budgets - emitted_n
+            active = active & (budgets > 0) & \
+                ~jnp.any(stop & emit, axis=1)
+            final_pos = jnp.where(col < emitted_n[:, None], q_pos,
+                                  _POS_INVALID)
+            pos_side = jax.lax.dynamic_update_slice(pos_side, final_pos,
+                                                    (0, base))
+            return ((tokens, lengths, active, budgets, context,
+                     k_sides, v_sides, pos_side),
+                    (block_argmax, emit))
+
+        (tokens, lengths, active, budgets, context, k_sides, v_sides,
+         pos_side), (emitted, emit_mask) = jax.lax.scan(
+            body, (tokens, lengths, active, budgets, context, k_sides,
+                   v_sides, pos_side), jnp.arange(num_steps))
+
+        # scatter-merge: each consumed side entry lands at its absolute
+        # position; _POS_INVALID entries (rejected drafts, inactive
+        # slots, mid-prefill slots) drop instead of clamping into a
+        # live row
+        def merge(cache, side):
+            if isinstance(cache, dict):
+                quant = L.quantize_kv_cache(side)
+                new_q = jax.vmap(
+                    lambda c, s, p: c.at[:, p, :].set(s, mode="drop"))(
+                    cache["q"], quant["q"], pos_side)
+                new_s = jax.vmap(
+                    lambda c, s, p: c.at[:, p].set(s, mode="drop"))(
+                    cache["s"], quant["s"], pos_side)
+                return {"q": new_q, "s": new_s}
+            return jax.vmap(
+                lambda c, s, p: c.at[:, p, :].set(s, mode="drop"))(
+                cache, side, pos_side)
+
+        new_k_caches = [merge(k_caches[i], k_sides[i])
+                        for i in range(config.num_layers)]
+        new_v_caches = [merge(v_caches[i], v_sides[i])
+                        for i in range(config.num_layers)]
+        return (emitted, emit_mask, tokens, lengths, context,
+                new_k_caches, new_v_caches)
+
+    return jax.jit(spec_step, static_argnames=("num_steps", "eos"),
+                   donate_argnames=("context", "k_caches", "v_caches"))
+
+
+@functools.lru_cache(maxsize=16)
+def _spec_step_for(config: LlamaConfig, k_spec: int, ngram: int,
+                   kv_write: str):
+    """Same process-wide sharing as _step_for, for the speculative
+    variant (kv_write in the key for symmetry — the builder requires
+    block mode, enforced at construction)."""
+    return _build_spec_step(config, k_spec, ngram)
+
+
 class ContinuousDecoder:
     """Iteration-level scheduler over a fixed slot pool.
 
     submit() enqueues a request; drive it from the event engine
-    (attach()) or call pump() manually.  Each pump round: admit pending
-    prompts into free slots (bucketed prefill), run steps_per_sync
-    decode iterations on device, sync the emitted tokens, retire
-    EOS/max-length slots through their callbacks."""
+    (attach()) or call pump() manually.  Each pump round, decode-first:
+    dispatch steps_per_sync decode iterations, dispatch prefill work
+    (bucketed admits + chunk extends) BEHIND the scan so it runs in
+    the host's sync gap, sync the emitted tokens plus earlier rounds'
+    admit outputs, retire EOS/max-length slots through their
+    callbacks.  Opt-in levers: kv_cache_dtype="int8" (half the cache
+    read of the HBM-bound step), speculate_k=k (multi-token decoding
+    via self-drafted prompt lookup, greedy-equivalent), weight_quant,
+    fuse_projections."""
 
     def __init__(self, params, config: LlamaConfig, max_slots: int = 8,
                  max_seq: int | None = None, eos_token: int | None = None,
@@ -546,8 +863,50 @@ class ContinuousDecoder:
                  prefill_budget: int | None = None,
                  weight_quant: bool = False,
                  fuse_projections: bool = False,
+                 kv_cache_dtype: str | None = None,
+                 speculate_k: int = 0, speculate_ngram: int = 2,
                  name: str = "decoder"):
         self.config = config
+        # int8 KV cache (ISSUE 7): the slot caches store int8 values
+        # with per-(slot, head, position) f32 scales
+        # (layers.quantize_kv_cache).  Admits/extends write quantized
+        # rows off the decode critical path; the decode scan reads the
+        # int8 buffer as the dot operand and FOLDS the scales into
+        # scores/weights — the HBM-bound step's dominant read halves.
+        # Greedy outputs are NOT bit-identical to the full-precision
+        # cache (int8 rounding of stored K/V), so the mode is opt-in
+        # like weight_quant.
+        dtype_norm = (kv_cache_dtype or "native").lower()
+        if dtype_norm not in ("native", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None/'native'/'int8', got "
+                f"{kv_cache_dtype!r}")
+        self.kv_int8 = dtype_norm == "int8"
+        if self.kv_int8 and KV_WRITE != "block":
+            raise ValueError(
+                "kv_cache_dtype='int8' requires the block KV write "
+                "mode (AIKO_DECODE_KV=block): the select mode rewrites "
+                "the whole cache per step, which would re-encode int8 "
+                "every iteration")
+        # self-speculative decoding (ISSUE 7): each scan iteration
+        # drafts speculate_k tokens by prompt lookup over a device-side
+        # context buffer and verifies the widened block in one forward;
+        # greedy acceptance makes the emitted stream identical to the
+        # non-speculative path.  The side buffers grow to
+        # steps_per_sync * (1 + speculate_k) entries — size
+        # steps_per_sync for the same per-round token output, not on
+        # top of it.
+        self.speculate_k = int(speculate_k or 0)
+        if self.speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got "
+                             f"{speculate_k}")
+        self.speculate_ngram = int(speculate_ngram)
+        if self.speculate_k and self.speculate_ngram < 1:
+            raise ValueError("speculate_ngram must be >= 1")
+        if self.speculate_k and KV_WRITE != "block":
+            raise ValueError(
+                "speculate_k requires the block KV write mode "
+                "(AIKO_DECODE_KV=block)")
         # weight-only int8 (W8A16): every linear's weight tree-rewritten
         # to {w8, s} once here — linear()/linear_logits consume it
         # transparently across prefill, chunked extends, and the
@@ -609,21 +968,38 @@ class ContinuousDecoder:
         # worth of cache (an in-program slice doesn't help: it
         # materializes, measured 3× attention bytes).
         self._cache_t = min(self.t_block, self.max_seq)
-        shape = (max_slots, config.num_kv_heads, self._cache_t,
-                 config.head_dim)
-        self._k = [jnp.zeros(shape, config.dtype)
-                   for _ in range(config.num_layers)]
-        self._v = [jnp.zeros(shape, config.dtype)
-                   for _ in range(config.num_layers)]
+        self._k = self._zero_caches()
+        self._v = self._zero_caches()
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._lengths = jnp.zeros((max_slots,), jnp.int32)
+        # device-side token history per slot, written by admits /
+        # extends / the verify scan — what the speculative drafter
+        # matches against.  A [1, 1] stub when speculation is off so
+        # the admit/extend programs keep ONE signature either way
+        # (threaded through and returned unchanged).
+        self._context = jnp.zeros(
+            (max_slots, self.max_seq) if self.speculate_k else (1, 1),
+            jnp.int32)
         self._resize_fns: dict = {}
 
-        self._step = _build_step(config)
+        self._step = _spec_step_for(config, self.speculate_k,
+                                    self.speculate_ngram, KV_WRITE) \
+            if self.speculate_k else _step_for(config, KV_WRITE,
+                                               ATTENTION_IMPL)
         self._prefill_fns: dict = {}
         self._slots: list[DecodeRequest | None] = [None] * max_slots
         self._pending: list[DecodeRequest] = []
+        # admit/extend output stash: (firsts device array, [(row,
+        # request), ...]) per dispatch — resolved at the NEXT round's
+        # sync, by which point the prefill program has run behind the
+        # decode scan (single in-order device stream), so the fetch
+        # never stalls the round
+        self._admit_waves: list = []
         self._timer = None
+        # preallocated per-round host buffers: pump/_round_plan are the
+        # per-step hot path (graft-check lint-hot-alloc polices them)
+        self._active_np = np.zeros((max_slots,), bool)
+        self._budgets_np = np.zeros((max_slots,), np.int32)
         # HBM-traffic model for roofline reporting: every decode step
         # streams the full weight set (embed excluded — it's a gather
         # of S rows) plus the capped KV read
@@ -636,19 +1012,29 @@ class ContinuousDecoder:
             for path, leaf in jax.tree_util.tree_leaves_with_path(params)
             if "embed" not in str(path[0]) and
             not any("qkv" in str(part) for part in path)))
+        # int8 cache: D int8 values + one f32 scale per (slot, head,
+        # position) — ~(D+4)/(2D) of the bf16 bytes
+        per_position = (config.head_dim + 4) if self.kv_int8 \
+            else config.head_dim * itemsize
         self._kv_bytes_per_t = (2 * config.num_layers * max_slots *
-                                config.num_kv_heads * config.head_dim *
-                                itemsize)
+                                config.num_kv_heads * per_position)
         # cumulative decode-loop counters, mirrored onto the process
         # metrics registry (serving_decoder_total{kind=...}) so the
         # bench and the dashboard metrics pane read the SAME numbers
-        # the decoder increments (ISSUE 5)
+        # the decoder increments (ISSUE 5).  tokens_decode /
+        # tokens_prefill split what the old single token flow hid:
+        # decode-scan emissions vs prompt tokens prefilled — the
+        # overhead ISSUE 7 moves off the decode round is exactly their
+        # ratio.
         from .observe.metrics import MirroredStats
         self.stats = MirroredStats(
             {"steps": 0, "rounds": 0, "completed": 0,
              "prefills": 0, "occupancy_sum": 0.0,
              "prefill_s": 0.0, "decode_s": 0.0,
              "useful_steps": 0, "wasted_steps": 0,
+             "tokens_decode": 0, "tokens_prefill": 0,
+             "spec_proposed": 0, "spec_accepted": 0,
+             "accepted_per_step": 0.0,
              "bytes_moved": 0, "prefill_chunks": 0,
              "chunk_admits": 0, "round_prefill_tokens_max": 0},
             metric="serving_decoder_total",
@@ -657,7 +1043,7 @@ class ContinuousDecoder:
             # a seconds accumulator inside an events-by-kind counter
             # family would make rate()/sum() over the family meaningless
             skip=("occupancy_sum", "prefill_s", "decode_s",
-                  "round_prefill_tokens_max"))
+                  "accepted_per_step", "round_prefill_tokens_max"))
         # SLO samples (seconds): TTFT per request, mean inter-token
         # latency per retired request, and each request's worst
         # inter-sync stall — the number chunked prefill bounds
@@ -722,153 +1108,26 @@ class ContinuousDecoder:
         K/V prefixes, first tokens, and lengths into the slot buffers
         on device.  The host syncs a single [width] token array per
         group — not one round-trip per request (the per-request admit
-        was a throughput cliff under bursty arrivals on thin links)."""
+        was a throughput cliff under bursty arrivals on thin links).
+        Shared process-wide via _admit_fn_for, like the decode step."""
         key = (bucket, width)
-        if key in self._prefill_fns:
-            return self._prefill_fns[key]
-        from .models.llama import init_llama_caches, llama_hidden
-
-        def admit(params, k_caches, v_caches, tokens, lengths,
-                  prompts, true_lens, slots, valid):
-            # prompts: [A, bucket]; slots: [A] DISTINCT slot ids (pad
-            # rows point at other distinct slots and write back their
-            # own current content — a no-op); valid: [A] bool.
-            caches = init_llama_caches(self.config, width, bucket)
-            hidden, caches = llama_hidden(params, self.config,
-                                          prompts, caches)
-            idx = jnp.maximum(true_lens - 1, 0)
-            # select each prompt's last position BEFORE the vocab
-            # projection: full prefill logits are [A, bucket, vocab] —
-            # gigabytes at serving widths
-            last_hidden = jnp.take_along_axis(
-                hidden, idx[:, None, None], axis=1)[:, 0]
-            last = L.linear_logits(params["lm_head"], last_hidden)
-            firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            mask = valid[:, None, None, None]
-            for i, cache in enumerate(caches):
-                cur_k = k_caches[i][slots][:, :, :bucket]
-                cur_v = v_caches[i][slots][:, :, :bucket]
-                k_caches[i] = k_caches[i].at[slots, :, :bucket].set(
-                    jnp.where(mask, cache["k"], cur_k))
-                v_caches[i] = v_caches[i].at[slots, :, :bucket].set(
-                    jnp.where(mask, cache["v"], cur_v))
-            tokens = tokens.at[slots].set(
-                jnp.where(valid, firsts, tokens[slots]))
-            lengths = lengths.at[slots].set(
-                jnp.where(valid, true_lens, lengths[slots]))
-            return firsts, k_caches, v_caches, tokens, lengths
-
-        compiled = jax.jit(
-            admit, donate_argnames=("k_caches", "v_caches", "tokens",
-                                    "lengths"))
-        self._prefill_fns[key] = compiled
-        return compiled
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = _admit_fn_for(
+                self.config, bucket, width, self.kv_int8,
+                bool(self.speculate_k))
+        return self._prefill_fns[key]
 
     def _extend_fn(self, width: int):
-        """Compiled once per (chunk, admit-width, cache_t): advances up
-        to `width` mid-prefill slots by one `prefill_chunk`-token chunk
-        of their prompt — computes the chunk's K/V against the already
-        -written cache prefix and scatters it in at each row's own
-        offset.  Rows flagged `finish` also run the lm_head on their
-        prompt's last position and land their first token + length in
-        the device buffers, exactly like a single-shot admit — the
-        first token then rides the next decode round's tokens_in sync.
-
-        No reference counterpart: the reference's pipeline blocks a
-        whole stream per frame (reference pipeline.py:650-712); chunked
-        prefill is how an iteration-level scheduler keeps decode ITL
-        flat under prompt-heavy load."""
+        """Compiled once per (chunk, admit-width): advances up to
+        `width` mid-prefill slots by one `prefill_chunk`-token chunk of
+        their prompt — see _extend_fn_for.  Shared process-wide."""
         key = ("extend", width)
-        if key in self._prefill_fns:
-            return self._prefill_fns[key]
-        config = self.config
-        chunk_len = self.prefill_chunk
-        cos, sin = L.rope_frequencies(config.head_dim,
-                                      config.max_seq_len,
-                                      config.rope_theta)
-        num_heads, num_kv = config.num_heads, config.num_kv_heads
-        group = num_heads // num_kv
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = _extend_fn_for(
+                self.config, self.prefill_chunk, width, self.kv_int8,
+                bool(self.speculate_k))
+        return self._prefill_fns[key]
 
-        def extend(params, k_caches, v_caches, tokens, lengths,
-                   chunk_tokens, offsets, slots, valid, finish,
-                   final_idx):
-            # chunk_tokens: [A, C]; offsets/slots/final_idx: [A];
-            # valid/finish: [A] bool.  Pad rows (valid=False) point at
-            # DISTINCT spare slots and write back their own content.
-            x = L.embedding(params["embed"],
-                            chunk_tokens).astype(config.dtype)
-            t_cap = k_caches[0].shape[2]
-            # causal over prefix + chunk: query j (absolute position
-            # offsets+j) sees cache positions <= offsets+j — earlier
-            # chunks' rows are already in the cache, this chunk's are
-            # written below before attending
-            q_pos = offsets[:, None] + jnp.arange(chunk_len)[None, :]
-            mask = (jnp.arange(t_cap)[None, None, :] <=
-                    q_pos[:, :, None])[:, None, None]   # [A,1,1,C,T]
-            scale = 1.0 / jnp.sqrt(jnp.asarray(config.head_dim,
-                                               jnp.float32))
-
-            def write_rows(rows, chunk_kv, offs):
-                # per-row dynamic_update_slice (vmapped): offsets stay
-                # in-bounds by construction — the host slides a final
-                # chunk BACK (recomputing overlap, idempotent) so
-                # offset+C never exceeds the prompt length
-                return jax.vmap(
-                    lambda row, kv, off: jax.lax.dynamic_update_slice(
-                        row, kv, (0, off, 0)))(rows, chunk_kv, offs)
-
-            for i, layer in enumerate(params["layers"]):
-                normed = L.rms_norm(layer["ln_attn"], x)
-                q = L._split_heads(L.linear(layer["attn"]["q"], normed),
-                                   num_heads)
-                k = L._split_heads(L.linear(layer["attn"]["k"], normed),
-                                   num_kv)
-                v = L._split_heads(L.linear(layer["attn"]["v"], normed),
-                                   num_kv)
-                q = L.apply_rope(q, cos, sin, offsets)
-                k = L.apply_rope(k, cos, sin, offsets)
-                orig_k = k_caches[i][slots]        # [A, kv, T, D]
-                orig_v = v_caches[i][slots]
-                k_rows = write_rows(orig_k, k, offsets)
-                v_rows = write_rows(orig_v, v, offsets)
-                q_grouped = q.reshape(q.shape[0], num_kv, group,
-                                      chunk_len, config.head_dim)
-                scores = jnp.einsum(
-                    "akgcd,aktd->akgct", q_grouped, k_rows,
-                    preferred_element_type=jnp.float32) * scale
-                scores = jnp.where(mask, scores, -1e30)
-                weights = jax.nn.softmax(
-                    scores, axis=-1).astype(v_rows.dtype)
-                out = jnp.einsum("akgct,aktd->akgcd", weights, v_rows,
-                                 preferred_element_type=jnp.float32)
-                out = out.reshape(out.shape[0], num_heads, chunk_len,
-                                  config.head_dim).astype(x.dtype)
-                x = x + L.linear(layer["attn"]["o"], L._merge_heads(out))
-                x = x + llama_ffn(layer, config,
-                                  L.rms_norm(layer["ln_mlp"], x))
-                keep = valid[:, None, None, None]
-                k_caches[i] = k_caches[i].at[slots].set(
-                    jnp.where(keep, k_rows, orig_k))
-                v_caches[i] = v_caches[i].at[slots].set(
-                    jnp.where(keep, v_rows, orig_v))
-            x = L.rms_norm(params["ln_out"], x)
-            last_hidden = jnp.take_along_axis(
-                x, final_idx[:, None, None], axis=1)[:, 0]
-            last = L.linear_logits(params["lm_head"], last_hidden)
-            firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            apply = valid & finish
-            tokens = tokens.at[slots].set(
-                jnp.where(apply, firsts, tokens[slots]))
-            lengths = lengths.at[slots].set(
-                jnp.where(apply, offsets + final_idx + 1,
-                          lengths[slots]))
-            return k_caches, v_caches, tokens, lengths
-
-        compiled = jax.jit(
-            extend, donate_argnames=("k_caches", "v_caches", "tokens",
-                                     "lengths"))
-        self._prefill_fns[key] = compiled
-        return compiled
 
     def _advance_prefills(self) -> None:
         """Run one prompt chunk for mid-prefill slots (batched, pow2
@@ -936,38 +1195,72 @@ class ContinuousDecoder:
                 else 0
             valid[j] = True
             finish_arr[j] = finish
-        self._k, self._v, self._tokens, self._lengths = \
-            self._extend_fn(width)(
-                self.params, self._k, self._v, self._tokens,
-                self._lengths, jnp.asarray(chunk_tokens),
-                jnp.asarray(offsets),
-                jnp.asarray(slots + pad_slots, jnp.int32),
-                jnp.asarray(valid), jnp.asarray(finish_arr),
-                jnp.asarray(final_idx))
-        for slot, request, offset, finish in batch:
-            request.prefill_pos = len(request.prompt) if finish \
-                else offset + chunk
+        (firsts, self._k, self._v, self._tokens, self._lengths,
+         self._context) = self._extend_fn(width)(
+            self.params, self._k, self._v, self._tokens,
+            self._lengths, self._context, jnp.asarray(chunk_tokens),
+            jnp.asarray(offsets),
+            jnp.asarray(slots + pad_slots, jnp.int32),
+            jnp.asarray(valid), jnp.asarray(finish_arr),
+            jnp.asarray(final_idx))
+        wave = []
+        for j, (slot, request, offset, finish) in enumerate(batch):
+            new_pos = len(request.prompt) if finish else offset + chunk
+            self.stats["tokens_prefill"] += max(
+                0, new_pos - request.prefill_pos)
+            request.prefill_pos = new_pos
             if finish:
                 request.prefilling = False
-                request.generated = []    # first token owed (tokens_in)
+                request.generated = []    # first token owed (wave)
+                wave.append((j, request))
             self.stats["prefill_chunks"] += 1
             self._round_prefill_tokens += chunk
+        if wave:
+            # the finish rows' first tokens resolve at the NEXT round's
+            # sync — the extend program runs behind the decode scan
+            self._admit_waves.append((firsts, wave))
 
     @staticmethod
     def _next_pow2(n: int) -> int:
         return 1 << max(0, (n - 1).bit_length())
+
+    def _zero_caches(self, t: int | None = None) -> list:
+        """Fresh per-layer slot caches at time extent `t` (default: the
+        current serving extent) in the decoder's storage layout — plain
+        [S, H, T, D] arrays, or {"q" int8, "s" f32 [S, H, T]} dicts in
+        int8 mode."""
+        config = self.config
+        shape = (self.max_slots, config.num_kv_heads,
+                 t or self._cache_t, config.head_dim)
+        if self.kv_int8:
+            return [{"q": jnp.zeros(shape, jnp.int8),
+                     "s": jnp.zeros(shape[:3], jnp.float32)}
+                    for _ in range(config.num_layers)]
+        return [jnp.zeros(shape, config.dtype)
+                for _ in range(config.num_layers)]
+
+    def kv_cache_bytes(self) -> int:
+        """Bytes currently allocated to the slot KV caches (values +
+        scales) — the number kv_cache_dtype='int8' halves."""
+        return int(sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for cache in self._k + self._v
+            for leaf in jax.tree_util.tree_leaves(cache)))
 
     def _fit_caches(self, required_t: int) -> None:
         """Resize the cache time axis to the t_block multiple covering
         `required_t` (clamped to max_seq — plus steps_per_sync scratch
         headroom in block-KV mode, so a round-end side-buffer merge
         near the seq cap never clamps into a misaligned overwrite;
-        the headroom cells are never attended).  A grow pads with
-        zeros, a shrink slices — one whole-cache copy, amortized over
-        the many rounds run at the new size.  No-op when already
-        sized."""
-        cap = self.max_seq + (self.steps_per_sync
-                              if KV_WRITE == "block" else 0)
+        the headroom cells are never attended.  The speculative merge
+        scatters at absolute positions with out-of-bounds drop, so it
+        needs no headroom).  A grow pads with zeros, a shrink slices —
+        one whole-cache copy, amortized over the many rounds run at
+        the new size.  No-op when already sized."""
+        if self.speculate_k or KV_WRITE != "block":
+            cap = self.max_seq
+        else:
+            cap = self.max_seq + self.steps_per_sync
         new_t = min(cap, -(-required_t // self.t_block) * self.t_block)
         if new_t == self._cache_t:
             return
@@ -976,12 +1269,19 @@ class ContinuousDecoder:
             if new_t > self._cache_t:
                 pad = new_t - self._cache_t
 
-                def resize(caches, pad=pad):
-                    return [jnp.pad(c, ((0, 0), (0, 0), (0, pad),
-                                        (0, 0))) for c in caches]
+                def grow_leaf(c, pad=pad):
+                    # time axis is axis 2 for values [S,H,T,D] AND
+                    # scales [S,H,T]
+                    spec = [(0, 0)] * c.ndim
+                    spec[2] = (0, pad)
+                    return jnp.pad(c, spec)
+
+                def resize(caches):
+                    return [jax.tree.map(grow_leaf, c) for c in caches]
             else:
                 def resize(caches, t=new_t):
-                    return [c[:, :, :t] for c in caches]
+                    return [jax.tree.map(lambda c: c[:, :, :t], cache)
+                            for cache in caches]
             self._resize_fns[key] = jax.jit(resize,
                                             donate_argnums=(0,))
         self._k = self._resize_fns[key](self._k)
@@ -1058,24 +1358,28 @@ class ContinuousDecoder:
             prompts[j, :len(request.prompt)] = request.prompt
             true_lens[j] = len(request.prompt)
             valid[j] = True
-        firsts, self._k, self._v, self._tokens, self._lengths = \
-            self._admit_fn(bucket, width)(
-                self.params, self._k, self._v, self._tokens,
-                self._lengths, jnp.asarray(prompts),
-                jnp.asarray(true_lens),
-                jnp.asarray(slots + pad_slots, jnp.int32),
-                jnp.asarray(valid))
-        # NO host sync here: the dispatch is async and the first token
-        # already lives in the device tokens buffer, which the next
-        # decode round returns as `tokens_in` — fetching `firsts` now
-        # would cost a full tunnel round-trip per admit group.  The
-        # request is live (slot assigned) with its first token OWED;
-        # pump() resolves it from the round sync (generated[0]).
+        (firsts, self._k, self._v, self._tokens, self._lengths,
+         self._context) = self._admit_fn(bucket, width)(
+            self.params, self._k, self._v, self._tokens,
+            self._lengths, self._context, jnp.asarray(prompts),
+            jnp.asarray(true_lens),
+            jnp.asarray(slots + pad_slots, jnp.int32),
+            jnp.asarray(valid))
+        # NO host sync here: the dispatch is async and queued BEHIND
+        # this round's decode scan — fetching `firsts` now would stall
+        # the host on prefill.  The request is live (slot assigned)
+        # with its first token OWED; the stashed wave resolves it at
+        # the NEXT round's sync, by which point the admit program has
+        # run in the gap between scans.
+        wave = []
         for j, request in enumerate(chunk):
             request.slot = slots[j]
             request.generated = []            # first token pending
             self._slots[slots[j]] = request
             self.stats["prefills"] += 1
+            self.stats["tokens_prefill"] += len(request.prompt)
+            wave.append((j, request))
+        self._admit_waves.append((firsts, wave))
 
     def _finished(self, request: DecodeRequest, token: int) -> bool:
         return (self.eos_token is not None and token == self.eos_token) \
@@ -1103,7 +1407,7 @@ class ContinuousDecoder:
             self.logger.exception("callback failed for %s",
                                   request.request_id)
 
-    def _round_plan(self, occupied) -> tuple:
+    def _round_plan(self, occupied) -> tuple:   # graft: hot-path
         """(num_steps, required_t, budgets): how long to run before the
         next host sync, the cache time-axis extent this round needs,
         and how many tokens each slot may still emit.
@@ -1118,101 +1422,198 @@ class ContinuousDecoder:
         would instead fragment a cycle's tail into extra host syncs,
         and a sync round-trip costs ~100 ms through a tunneled
         device."""
-        budgets = np.zeros((self.max_slots,), np.int32)
+        budgets = self._budgets_np                # preallocated (hot)
+        budgets.fill(0)
         max_len = 0
+        # tokens one scan iteration can yield: 1, or the whole
+        # speculative block when every draft lands
+        per_step = 1 + self.speculate_k
         for slot in occupied:
             request = self._slots[slot]
             # a just-admitted slot still OWES its first token (resolved
-            # at the next round sync): account for it now or the device
-            # generates one extra token per request that the host
-            # discards — phantom "useful" work in the stats
+            # from its admit wave at this round's sync): account for it
+            # now or the device generates one extra token per request
+            # that the host discards — phantom "useful" work
             owed = 0 if request.generated else 1
             generated = len(request.generated) + owed
             current = len(request.prompt) + generated
             # budget 0 is legal: a deferred admit whose OWED first token
             # already satisfies the request (max_new_tokens=1, or prompt
-            # at the seq cap) only needs this round's tokens_in sync —
-            # pump() masks it out of the scan so its extra device
-            # emissions are never counted as useful work
+            # at the seq cap) needs no scan at all — pump() masks it out
+            # so its extra device emissions are never counted as useful
             budgets[slot] = max(0, min(
                 request.max_new_tokens - generated,
                 self.max_seq - 1 - current))
             max_len = max(max_len, current)
-        remaining = budgets[list(occupied)]
+        remaining = budgets[occupied]
         cap = int(remaining.min()) if self._pending \
             else int(remaining.max())
-        num_steps = min(self.steps_per_sync, self._next_pow2(max(1, cap)))
-        return num_steps, max_len + num_steps + 1, budgets
+        num_steps = min(self.steps_per_sync,
+                        self._next_pow2(max(1, -(-cap // per_step))))
+        return (num_steps, max_len + num_steps * per_step + 1, budgets)
 
-    def pump(self) -> None:
-        """One scheduling round: admit, advance prefill chunks, decode
-        K steps, retire."""
+    def pump(self) -> None:   # graft: hot-path
+        """One scheduling round, decode-first (ISSUE 7): dispatch the
+        decode scan, THEN dispatch prefill work (admits + chunk
+        extends) so it queues behind the scan on the device's in-order
+        stream and executes while the host syncs the scan and resolves
+        tokens — a decode round's sync never waits on prefill.  First
+        tokens of slots admitted in EARLIER rounds resolve from their
+        stashed admit outputs (device-complete by now), then this
+        round's scan emissions deliver, then retirements fire."""
         self._round_prefill_tokens = 0
+        # mid-prefill slots hold a slot but don't decode yet
+        active = self._active_np                  # preallocated (hot)
+        any_active = False
+        for slot in range(self.max_slots):
+            request = self._slots[slot]
+            live = request is not None and not request.prefilling
+            active[slot] = live
+            any_active = any_active or live
+        waves_due = self._admit_waves
+        self._admit_waves = []
+        scanned = False
+        if any_active:
+            occupied = [s for s in range(self.max_slots) if active[s]]
+            num_steps, required_t, budgets = self._round_plan(occupied)
+            # never shrink the cache below a mid-prefill slot's written
+            # extent — the decode slots alone may need less
+            for request in self._slots:
+                if request is not None and request.prefilling:
+                    required_t = max(required_t, request.prefill_pos)
+            self._fit_caches(required_t)
+            # a slot with budget 0 (request satisfied by its owed first
+            # token) needs no decode: masking it out of the scan keeps
+            # its discarded emissions out of useful_steps
+            scan_active = active & (budgets > 0)
+            scanned = bool(scan_active.any())
+        if scanned:
+            self.stats["rounds"] += 1
+            self.stats["occupancy_sum"] += float(active.mean())
+            decode_start = time.perf_counter()
+            eos = -1 if self.eos_token is None else int(self.eos_token)
+            if self.speculate_k:
+                (emitted, emit_mask, self._tokens, self._lengths,
+                 self._context, self._k, self._v) = self._step(
+                    self.params, self._tokens, self._lengths,
+                    jnp.array(scan_active), jnp.array(budgets),
+                    self._context, self._k, self._v,
+                    num_steps=num_steps, eos=eos)
+            else:
+                (emitted, emitted_active, self._tokens, self._lengths,
+                 self._k, self._v) = self._step(
+                    self.params, self._tokens, self._lengths,
+                    jnp.array(scan_active), jnp.array(budgets),
+                    self._k, self._v, num_steps=num_steps, eos=eos)
+            self.stats["steps"] += num_steps
+        # prefill rides BETWEEN decode scans: dispatched after the scan,
+        # it runs on device while the host below waits out the scan
+        # sync and walks the emissions — off the decode critical path,
+        # rationed by prefill_budget
         self._admit_pending()
         self._advance_prefills()
-        self.stats["round_prefill_tokens_max"] = max(
-            self.stats["round_prefill_tokens_max"],
-            self._round_prefill_tokens)
-        # mid-prefill slots hold a slot but don't decode yet
-        active = np.array([r is not None and not r.prefilling
-                           for r in self._slots])
-        if not active.any():
-            # admits can retire instantly (EOS as first token, 1-token
-            # budget, prompt at the seq cap) — the idle hook must still
-            # fire on this exit path or teardown callbacks never run
-            if self.idle and self.on_idle is not None:
-                self.on_idle()
-            return
-        occupied = [s for s in range(self.max_slots) if active[s]]
-        num_steps, required_t, budgets = self._round_plan(occupied)
-        # never shrink the cache below a mid-prefill slot's written
-        # extent — the decode slots alone may need less
-        for request in self._slots:
-            if request is not None and request.prefilling:
-                required_t = max(required_t, request.prefill_pos)
-        self._fit_caches(required_t)
-        self.stats["rounds"] += 1
-        self.stats["occupancy_sum"] += float(active.mean())
-        decode_start = time.perf_counter()
-        # a slot with budget 0 (request satisfied by its owed first
-        # token) stays in `occupied` for the tokens_in resolution below
-        # but must not decode: masking it out of the scan keeps its
-        # discarded emissions out of useful_steps
-        scan_active = active & (budgets > 0)
-        (emitted, emitted_active, tokens_in, self._tokens,
-         self._lengths, self._k, self._v) = self._step(
-            self.params, self._tokens, self._lengths,
-            jnp.asarray(scan_active), jnp.asarray(budgets),
-            self._k, self._v, num_steps=num_steps,
-            eos=-1 if self.eos_token is None else int(self.eos_token))
-        self.stats["steps"] += num_steps
-        # ONE host transfer for all three sync arrays: separate
+        if self._round_prefill_tokens > \
+                self.stats["round_prefill_tokens_max"]:
+            self.stats["round_prefill_tokens_max"] = \
+                self._round_prefill_tokens
+        # ONE host transfer for the whole round: scan sync arrays AND
+        # every due admit wave's firsts ride one device_get — separate
         # np.asarray calls pay one tunnel round trip each (~115 ms on
-        # a tunneled bench chip, 3x per round)
-        emitted, emitted_active, tokens_in = jax.device_get(
-            (emitted, emitted_active, tokens_in))
-        self.stats["decode_s"] += time.perf_counter() - decode_start
-        useful = int(emitted_active[:, occupied].sum())
-        self.stats["useful_steps"] += useful
-        self.stats["wasted_steps"] += num_steps * len(occupied) - useful
-        self.stats["bytes_moved"] += num_steps * (
-            self._param_bytes + self._kv_bytes_per_t * self._cache_t)
-        # resolve deferred admits: a freshly-admitted slot's first token
-        # (prefill argmax) arrives as this round's tokens_in — no
-        # per-admit sync was paid for it
+        # a tunneled bench chip), per wave per round
+        wave_firsts = [firsts for firsts, _ in waves_due]
+        if scanned:
+            if self.speculate_k:
+                emitted, emit_mask, wave_firsts = jax.device_get(
+                    (emitted, emit_mask, wave_firsts))
+            else:
+                emitted, emitted_active, wave_firsts = jax.device_get(
+                    (emitted, emitted_active, wave_firsts))
+            self.stats["decode_s"] += time.perf_counter() - decode_start
+            self.stats["bytes_moved"] += num_steps * (
+                self._param_bytes + self._kv_bytes_per_t * self._cache_t)
+        elif wave_firsts:
+            wave_firsts = jax.device_get(wave_firsts)
+        # resolve deferred admits from EARLIER rounds: their prefill
+        # programs ran before this round's scan on the in-order device
+        # stream, so the fetch never waits on fresh work
         now = time.monotonic()
-        for slot in occupied:
-            request = self._slots[slot]
-            if request is not None and not request.generated:
-                self._deliver(slot, int(tokens_in[slot]), now)
-        for k in range(emitted.shape[0]):
-            for slot in occupied:
-                request = self._slots[slot]
-                if request is None or not emitted_active[k, slot]:
-                    continue
-                self._deliver(slot, int(emitted[k, slot]), now)
+        for firsts, (_, wave) in zip(wave_firsts, waves_due):
+            for j, request in wave:
+                if self._slots[request.slot] is request and \
+                        not request.generated:
+                    self._deliver(request.slot, int(firsts[j]), now)
+        if scanned:
+            if self.speculate_k:
+                self._deliver_spec(emitted, emit_mask, occupied,
+                                   num_steps, now)
+            else:
+                # useful/wasted account DEVICE work (scan emissions the
+                # host meant to use); tokens_decode counts what was
+                # actually DELIVERED — they differ when a wave-resolved
+                # first token retires the slot before its scan
+                # emissions land (EOS as prefill argmax)
+                useful = int(emitted_active[:, occupied].sum())
+                self.stats["useful_steps"] += useful
+                self.stats["wasted_steps"] += \
+                    num_steps * len(occupied) - useful
+                delivered = 0
+                for k in range(emitted.shape[0]):
+                    for slot in occupied:
+                        request = self._slots[slot]
+                        if request is None or not emitted_active[k, slot]:
+                            continue
+                        self._deliver(slot, int(emitted[k, slot]), now)
+                        delivered += 1
+                self.stats["tokens_decode"] += delivered
         if self.idle and self.on_idle is not None:
             self.on_idle()
+
+    def _deliver_spec(self, emitted, emit_mask, occupied,
+                      num_steps: int, now: float) -> None:
+        """Walk a speculative round's [K, S, 1+k] emissions: per slot,
+        the masked tokens in (iteration, position) order are exactly
+        the greedy stream.  Also settles the speculation counters —
+        spec_proposed/spec_accepted feed accept_rate(), and
+        accepted_per_step is the mean tokens one verify iteration
+        yielded (1.0 = speculation never helped)."""
+        counts = emit_mask.sum(axis=2)[:, occupied]     # [K, |occ|]
+        verify_steps = int((counts > 0).sum())
+        self.stats["useful_steps"] += verify_steps
+        self.stats["wasted_steps"] += \
+            num_steps * len(occupied) - verify_steps
+        self.stats["spec_proposed"] += self.speculate_k * verify_steps
+        self.stats["spec_accepted"] += int(
+            np.maximum(counts - 1, 0).sum())
+        # tokens_decode counts DELIVERED tokens (a wave-resolved EOS
+        # first token can retire the slot before its scan emissions
+        # land — those are device work, not token flow)
+        delivered = 0
+        for slot in occupied:
+            mask_slot = emit_mask[:, slot, :]
+            if not mask_slot.any():
+                continue
+            for token in emitted[:, slot, :][mask_slot]:
+                request = self._slots[slot]
+                if request is None:
+                    break                 # retired mid-burst (EOS)
+                self._deliver(slot, int(token), now)
+                delivered += 1
+        self.stats["tokens_decode"] += delivered
+        if self.stats["useful_steps"]:
+            # mean tokens one emitting verify iteration yielded —
+            # derived straight from the two source counters so it can
+            # never drift from them
+            self.stats["accepted_per_step"] = (
+                self.stats["tokens_decode"] /
+                self.stats["useful_steps"])
+
+    def accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the verify step accepted
+        (speculation quality; 0.0 when speculation is off or no drafts
+        were scored)."""
+        proposed = self.stats["spec_proposed"]
+        return self.stats["spec_accepted"] / proposed if proposed \
+            else 0.0
 
     def _deliver(self, slot: int, token: int, now: float) -> None:
         """Append one resolved token, stamping SLO timestamps: tokens
@@ -1256,3 +1657,224 @@ class ContinuousDecoder:
     def mean_occupancy(self) -> float:
         rounds = max(self.stats["rounds"], 1)
         return self.stats["occupancy_sum"] / rounds
+
+
+@functools.lru_cache(maxsize=64)
+def _admit_fn_for(config: LlamaConfig, bucket: int, width: int,
+                  kv_int8: bool, speculative: bool):
+    """Builder behind ContinuousDecoder._admit_fn (process-wide cache:
+    decoders sharing a geometry share the jit object and its compiled
+    executables)."""
+    from .models.llama import init_llama_caches, llama_hidden
+
+    def admit(params, k_caches, v_caches, tokens, lengths, context,
+              prompts, true_lens, slots, valid):
+        # prompts: [A, bucket]; slots: [A] DISTINCT slot ids (pad
+        # rows point at other distinct slots and write back their
+        # own current content — a no-op); valid: [A] bool.
+        caches = init_llama_caches(config, width, bucket)
+        hidden, caches = llama_hidden(params, config, prompts, caches)
+        idx = jnp.maximum(true_lens - 1, 0)
+        # select each prompt's last position BEFORE the vocab
+        # projection: full prefill logits are [A, bucket, vocab] —
+        # gigabytes at serving widths
+        last_hidden = jnp.take_along_axis(
+            hidden, idx[:, None, None], axis=1)[:, 0]
+        last = L.linear_logits(params["lm_head"], last_hidden)
+        firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        mask = valid[:, None, None, None]
+        mask_s = valid[:, None, None]
+        for i, cache in enumerate(caches):
+            if kv_int8:
+                # quantize the exact prefill K/V once, scatter the
+                # int8 rows + per-(row, head, position) scales
+                kq = L.quantize_kv_cache(cache["k"])
+                vq = L.quantize_kv_cache(cache["v"])
+                k_caches[i] = {
+                    "q": k_caches[i]["q"].at[slots, :, :bucket].set(
+                        jnp.where(mask, kq["q"],
+                                  k_caches[i]["q"][slots]
+                                  [:, :, :bucket])),
+                    "s": k_caches[i]["s"].at[slots, :, :bucket].set(
+                        jnp.where(mask_s, kq["s"],
+                                  k_caches[i]["s"][slots]
+                                  [:, :, :bucket]))}
+                v_caches[i] = {
+                    "q": v_caches[i]["q"].at[slots, :, :bucket].set(
+                        jnp.where(mask, vq["q"],
+                                  v_caches[i]["q"][slots]
+                                  [:, :, :bucket])),
+                    "s": v_caches[i]["s"].at[slots, :, :bucket].set(
+                        jnp.where(mask_s, vq["s"],
+                                  v_caches[i]["s"][slots]
+                                  [:, :, :bucket]))}
+            else:
+                cur_k = k_caches[i][slots][:, :, :bucket]
+                cur_v = v_caches[i][slots][:, :, :bucket]
+                k_caches[i] = k_caches[i].at[slots, :, :bucket].set(
+                    jnp.where(mask, cache["k"], cur_k))
+                v_caches[i] = v_caches[i].at[slots, :, :bucket].set(
+                    jnp.where(mask, cache["v"], cur_v))
+        tokens = tokens.at[slots].set(
+            jnp.where(valid, firsts, tokens[slots]))
+        lengths = lengths.at[slots].set(
+            jnp.where(valid, true_lens, lengths[slots]))
+        if speculative:
+            # seed the drafter's history with the prompt itself
+            context = context.at[slots, :bucket].set(
+                jnp.where(valid[:, None], prompts,
+                          context[slots][:, :bucket]))
+        return firsts, k_caches, v_caches, tokens, lengths, context
+
+    return jax.jit(
+        admit, donate_argnames=("k_caches", "v_caches", "tokens",
+                                "lengths", "context"))
+
+
+@functools.lru_cache(maxsize=64)
+def _extend_fn_for(config: LlamaConfig, chunk_len: int, width: int,
+                   kv_int8: bool, speculative: bool):
+    """Builder behind ContinuousDecoder._extend_fn: advances up to
+    `width` mid-prefill slots by one `chunk_len`-token chunk of their
+    prompt — computes the chunk's K/V against the already-written
+    cache prefix and scatters it in at each row's own offset.  Rows
+    flagged `finish` also run the lm_head on their prompt's last
+    position and land their first token + length in the device
+    buffers, exactly like a single-shot admit — the first token then
+    resolves from the stashed wave at the next round's sync.
+
+    No reference counterpart: the reference's pipeline blocks a
+    whole stream per frame (reference pipeline.py:650-712); chunked
+    prefill is how an iteration-level scheduler keeps decode ITL
+    flat under prompt-heavy load."""
+    cos, sin = L.rope_frequencies(config.head_dim,
+                                  config.max_seq_len,
+                                  config.rope_theta)
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    group = num_heads // num_kv
+
+    def extend(params, k_caches, v_caches, tokens, lengths, context,
+               chunk_tokens, offsets, slots, valid, finish,
+               final_idx):
+        # chunk_tokens: [A, C]; offsets/slots/final_idx: [A];
+        # valid/finish: [A] bool.  Pad rows (valid=False) point at
+        # DISTINCT spare slots and write back their own content.
+        x = L.embedding(params["embed"],
+                        chunk_tokens).astype(config.dtype)
+        t_cap = _cache_time(k_caches[0])
+        # causal over prefix + chunk: query j (absolute position
+        # offsets+j) sees cache positions <= offsets+j — earlier
+        # chunks' rows are already in the cache, this chunk's are
+        # written below before attending
+        q_pos = offsets[:, None] + jnp.arange(chunk_len)[None, :]
+        mask = (jnp.arange(t_cap)[None, None, :] <=
+                q_pos[:, :, None])[:, None, None]   # [A,1,1,C,T]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(config.head_dim,
+                                           jnp.float32))
+
+        def write_rows(rows, chunk_kv, offs):
+            # per-row dynamic_update_slice (vmapped): offsets stay
+            # in-bounds by construction — the host slides a final
+            # chunk BACK (recomputing overlap, idempotent) so
+            # offset+C never exceeds the prompt length
+            return jax.vmap(
+                lambda row, kv, off: jax.lax.dynamic_update_slice(
+                    row, kv, (0, off, 0)))(rows, chunk_kv, offs)
+
+        def write_scales(rows, chunk_s, offs):
+            return jax.vmap(
+                lambda row, s, off: jax.lax.dynamic_update_slice(
+                    row, s, (0, off)))(rows, chunk_s, offs)
+
+        for i, layer in enumerate(params["layers"]):
+            normed = L.rms_norm(layer["ln_attn"], x)
+            q = L._split_heads(L.linear(layer["attn"]["q"], normed),
+                               num_heads)
+            k = L._split_heads(L.linear(layer["attn"]["k"], normed),
+                               num_kv)
+            v = L._split_heads(L.linear(layer["attn"]["v"], normed),
+                               num_kv)
+            q = L.apply_rope(q, cos, sin, offsets)
+            k = L.apply_rope(k, cos, sin, offsets)
+            if kv_int8:
+                # attend over the DEQUANTIZED prefix (exactly the
+                # int8-rounded values decode will read) + the
+                # exact current chunk; store the chunk quantized.
+                # Untouched positions keep their original q/s —
+                # re-quantizing them would double-round.
+                orig_kq = k_caches[i]["q"][slots]
+                orig_ks = k_caches[i]["s"][slots]
+                orig_vq = v_caches[i]["q"][slots]
+                orig_vs = v_caches[i]["s"][slots]
+                k_rows = write_rows(L.dequantize_kv_cache(
+                    {"q": orig_kq, "s": orig_ks}, x.dtype), k, offsets)
+                v_rows = write_rows(L.dequantize_kv_cache(
+                    {"q": orig_vq, "s": orig_vs}, x.dtype), v, offsets)
+            else:
+                orig_k = k_caches[i][slots]    # [A, kv, T, D]
+                orig_v = v_caches[i][slots]
+                k_rows = write_rows(orig_k, k, offsets)
+                v_rows = write_rows(orig_v, v, offsets)
+            q_grouped = q.reshape(q.shape[0], num_kv, group,
+                                  chunk_len, config.head_dim)
+            scores = jnp.einsum(
+                "akgcd,aktd->akgct", q_grouped, k_rows,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask, scores, -1e30)
+            weights = jax.nn.softmax(
+                scores, axis=-1).astype(v_rows.dtype)
+            out = jnp.einsum("akgct,aktd->akgcd", weights, v_rows,
+                             preferred_element_type=jnp.float32)
+            out = out.reshape(out.shape[0], num_heads, chunk_len,
+                              config.head_dim).astype(x.dtype)
+            x = x + L.linear(layer["attn"]["o"], L._merge_heads(out))
+            x = x + llama_ffn(layer, config,
+                              L.rms_norm(layer["ln_mlp"], x))
+            keep = valid[:, None, None, None]
+            if kv_int8:
+                keep_s = valid[:, None, None]
+                kq = L.quantize_kv_cache(k)
+                vq = L.quantize_kv_cache(v)
+                k_caches[i] = {
+                    "q": k_caches[i]["q"].at[slots].set(
+                        jnp.where(keep, write_rows(
+                            orig_kq, kq["q"], offsets), orig_kq)),
+                    "s": k_caches[i]["s"].at[slots].set(
+                        jnp.where(keep_s, write_scales(
+                            orig_ks, kq["s"], offsets), orig_ks))}
+                v_caches[i] = {
+                    "q": v_caches[i]["q"].at[slots].set(
+                        jnp.where(keep, write_rows(
+                            orig_vq, vq["q"], offsets), orig_vq)),
+                    "s": v_caches[i]["s"].at[slots].set(
+                        jnp.where(keep_s, write_scales(
+                            orig_vs, vq["s"], offsets), orig_vs))}
+            else:
+                k_caches[i] = k_caches[i].at[slots].set(
+                    jnp.where(keep, k_rows, orig_k))
+                v_caches[i] = v_caches[i].at[slots].set(
+                    jnp.where(keep, v_rows, orig_v))
+        x = L.rms_norm(params["ln_out"], x)
+        last_hidden = jnp.take_along_axis(
+            x, final_idx[:, None, None], axis=1)[:, 0]
+        last = L.linear_logits(params["lm_head"], last_hidden)
+        firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        apply = valid & finish
+        tokens = tokens.at[slots].set(
+            jnp.where(apply, firsts, tokens[slots]))
+        lengths = lengths.at[slots].set(
+            jnp.where(apply, offsets + final_idx + 1,
+                      lengths[slots]))
+        if speculative:
+            ctx_rows = context[slots]               # [A, ctx]
+            written = jax.vmap(
+                lambda row, blk, off: jax.lax.dynamic_update_slice(
+                    row, blk, (off,)))(ctx_rows, chunk_tokens,
+                                       offsets)
+            context = context.at[slots].set(
+                jnp.where(valid[:, None], written, ctx_rows))
+        return firsts, k_caches, v_caches, tokens, lengths, context
+
+    return jax.jit(
+        extend, donate_argnames=("k_caches", "v_caches", "tokens",
+                                 "lengths", "context"))

@@ -871,6 +871,16 @@ class WirePipelineBench(PipelineBench):
 
         self._broker = broker
         self._call_rt = call_rt
+        # retained metrics snapshots on {topic_path}/0/metrics for BOTH
+        # bench runtimes (ISSUE 7 satellite, closing the PR 5
+        # follow-up): a TPU bench run leaves the registry's last state
+        # behind on the control plane, so post-hoc analysis can read
+        # counters the JSON artifact does not carry
+        from aiko_services_tpu.observe import MetricsPublisher
+        self.metrics_publishers = [
+            MetricsPublisher(serve_rt, interval=2.0),
+            MetricsPublisher(call_rt, interval=2.0),
+        ]
         # envelope accounting now comes from the metrics registry
         # (ISSUE 5): the SAME pipeline_wire_envelopes_total /
         # pipeline_wire_frames_total / pipeline_recovery_total counters
@@ -1081,6 +1091,24 @@ LLAMA_SLOTS = int(os.environ.get("AIKO_BENCH_LLAMA_SLOTS", "256"))
 # tunnel's ~115 ms dispatch+sync cost amortizes over the whole cycle
 # (retire-aligned rounds make the tail waste <2%, measured)
 LLAMA_STEPS_PER_SYNC = int(os.environ.get("AIKO_BENCH_LLAMA_SPS", "64"))
+# int8 end-to-end KV cache (ISSUE 7): the decode step is HBM-bound and
+# the KV read is its second-largest byte, so the rung runs int8 by
+# default — set AIKO_BENCH_LLAMA_KV=native for the bf16 A/B.
+LLAMA_KV_DTYPE = os.environ.get("AIKO_BENCH_LLAMA_KV", "int8")
+# self-speculative decoding: k drafts per slot per verify step via
+# prompt lookup (serving.ContinuousDecoder speculate_k).  Off by
+# default — random-weight bench models emit near-random continuations,
+# so the drafter's accept rate measures the MACHINERY cost, not the
+# real-text win; the rung reports llama_accept_rate either way.
+LLAMA_SPEC_K = int(os.environ.get("AIKO_BENCH_LLAMA_SPEC", "0"))
+
+
+def _llama_decoder_opts() -> dict:
+    return {
+        "kv_cache_dtype": None if LLAMA_KV_DTYPE in
+        ("", "native", "bf16") else LLAMA_KV_DTYPE,
+        "speculate_k": LLAMA_SPEC_K,
+    }
 
 
 def bench_llama(window: float):
@@ -1104,14 +1132,24 @@ def bench_llama(window: float):
     decoder = ContinuousDecoder(params, config, max_slots=LLAMA_SLOTS,
                                 max_seq=1024, prefill_buckets=(128,),
                                 steps_per_sync=LLAMA_STEPS_PER_SYNC,
-                                name="bench")
+                                name="bench", **_llama_decoder_opts())
     rng = np.random.default_rng(11)
     generated = [0]
     submitted = [0]
 
     def submit_one():
-        prompt = rng.integers(
-            1, config.vocab, size=int(rng.integers(16, 120))).tolist()
+        if LLAMA_SPEC_K:
+            # n-gram structure the prompt-lookup drafter can exploit: a
+            # tiled motif — pure-random prompts would measure only the
+            # always-miss floor
+            motif = rng.integers(1, config.vocab,
+                                 size=int(rng.integers(4, 9)))
+            prompt = np.tile(motif, 16)[
+                :int(rng.integers(16, 120))].tolist()
+        else:
+            prompt = rng.integers(
+                1, config.vocab,
+                size=int(rng.integers(16, 120))).tolist()
         request_id = f"r{submitted[0]}"
         submitted[0] += 1
         decoder.submit(request_id, prompt, 64,
@@ -1122,10 +1160,14 @@ def bench_llama(window: float):
         if time.perf_counter() < deadline:
             submit_one()
 
-    # warmup: compile prefill widths + the decode step before timing
+    # warmup: compile prefill widths + the decode step before timing.
+    # TWO pumps since the decode-first rework: the first round
+    # dispatches admits only (nothing is decodable yet), the second
+    # compiles + runs the scan
     deadline = time.perf_counter() + 3600.0
     for _ in range(2 * LLAMA_SLOTS):
         submit_one()
+    decoder.pump()
     decoder.pump()
     for key in decoder.stats:
         decoder.stats[key] = 0 if isinstance(decoder.stats[key], int) \
@@ -1161,9 +1203,10 @@ def bench_llama(window: float):
         print(f"llama device-step probe failed: {exc!r}",
               file=sys.stderr)
     slo = decoder.slo_stats()
-    # admits dispatch async and resolve on the round sync (deferred
-    # admit): prefill_s is host-blocking admit time only; the prefill
-    # DEVICE time now rides inside decode_s
+    # prefill dispatches ride BETWEEN decode scans (decode-first pump):
+    # prefill_s is the host-side dispatch wall, decode_s the scan
+    # dispatch→sync wall — prefill device time only leaks into decode_s
+    # as spillover the host gap could not hide (prefill_budget bounds it)
     prefill_s = decoder.stats["prefill_s"]
     decode_s = decoder.stats["decode_s"]
     split = prefill_s / (prefill_s + decode_s) \
@@ -1191,30 +1234,45 @@ def bench_llama(window: float):
         "llama_prefill_frac": round(split, 3),
         "llama_completed": decoder.stats["completed"],
         "llama_wasted_frac": round(decoder.wasted_fraction(), 4),
-        # decode_s includes prefill device time (deferred admit), so
-        # step_ms is the honest serving cost per decode step; the
-        # roofline row is the HBM floor for the modeled bytes (weights
-        # + sized KV read) at spec bandwidth — the irreducible cost
+        # decode_s is the scan dispatch→sync wall ONLY since the
+        # decode-first rework: prefill dispatches ride between scans
+        # and execute in the host's sync gap, so the split below stops
+        # aliasing (prefill spillover a gap can't hide still lands in
+        # decode_s — prefill_budget bounds it).  The roofline row is
+        # the HBM floor for the modeled bytes (weights + sized KV
+        # read) at spec bandwidth — the irreducible cost
         "llama_decode_step_ms": round(decode_s * 1000.0 / steps, 3),
+        "llama_decode_s": round(decode_s, 3),
+        "llama_prefill_s": round(prefill_s, 3),
+        "llama_tokens_decode": decoder.stats["tokens_decode"],
+        "llama_tokens_prefill": decoder.stats["tokens_prefill"],
+        "llama_kv_cache_dtype": "int8" if decoder.kv_int8 else "bf16",
+        "llama_kv_cache_bytes": decoder.kv_cache_bytes(),
         "llama_config": f"{LLAMA_PRESET} bf16, {LLAMA_SLOTS} slots, "
                         f"{LLAMA_STEPS_PER_SYNC} steps/sync, "
-                        f"deferred admit",
-    } | ({} if device_step_ms is None else {
+                        f"off-path prefill, "
+                        f"kv={'int8' if decoder.kv_int8 else 'bf16'}"
+                        + (f", spec_k={LLAMA_SPEC_K}"
+                           if LLAMA_SPEC_K else ""),
+    } | ({} if not LLAMA_SPEC_K else {
+        "llama_spec_k": LLAMA_SPEC_K,
+        "llama_accept_rate": round(decoder.accept_rate(), 4),
+        "llama_accepted_per_step": round(
+            decoder.stats["accepted_per_step"], 3),
+    }) | ({} if device_step_ms is None else {
         # device compute per DECODE step (chained, one sync) vs the
-        # serving round above.  The difference is NOT all wire tax:
-        # deferred-admit prefills execute inside the round (at this
-        # workload every slot re-prefills each round, ~79 TFLOP of
-        # near-roofline prefill per admit wave) plus ~0.1-0.15 s of
-        # tunnel launch+sync per round — decomposition measured
-        # 2026-07-31: round ≈ admit ~0.4 s + decode 0.73 s + wire
-        # ~0.15 s at 256 slots
+        # serving round above.  Post-rework the gap is tunnel
+        # launch/sync plus whatever prefill spillover the host gap
+        # could not hide — admit compute no longer rides the round by
+        # construction (r05 measured ~9.2 ms/step of it)
         "llama_device_step_ms": round(device_step_ms, 3),
         "llama_overhead_ms_per_step": round(
             max(0.0, decode_s * 1000.0 / steps - device_step_ms), 3),
-        "llama_overhead_note": "overhead = deferred-admit prefill "
-                               "compute riding the round + tunnel "
-                               "launch/sync; see llama_prefill_frac "
-                               "for host-side admit time only",
+        "llama_overhead_note": "overhead = tunnel launch/sync + "
+                               "prefill spillover past the host gap "
+                               "(prefill dispatches between scans; "
+                               "see llama_prefill_s / "
+                               "llama_tokens_prefill)",
     }) | ({} if slo["ttft_p50_ms"] is None else {
         # measured per-request latency SLOs (serving.slo_stats):
         # TTFT submit→first burst; ITL per-request mean; stall = worst
@@ -1259,7 +1317,8 @@ def bench_llama_interactive(window: float = 12.0):
     params = llama_init(jax.random.PRNGKey(0), config)
     decoder = ContinuousDecoder(params, config, max_slots=slots,
                                 max_seq=1024, prefill_buckets=(128,),
-                                steps_per_sync=sps, name="bench_int")
+                                steps_per_sync=sps, name="bench_int",
+                                **_llama_decoder_opts())
     rng = np.random.default_rng(23)
 
     def submit_one(index):
@@ -1305,7 +1364,10 @@ def bench_llama_interactive(window: float = 12.0):
     fields = {
         "llama_int_config": f"{LLAMA_PRESET} bf16, {slots} slots, "
                             f"{sps} steps/sync, poisson "
-                            f"{rate:.0f} req/s",
+                            f"{rate:.0f} req/s, kv="
+                            f"{'int8' if decoder.kv_int8 else 'bf16'}"
+                            + (f", spec_k={LLAMA_SPEC_K}"
+                               if LLAMA_SPEC_K else ""),
         "llama_int_ttft_p50_ms": round(slo["ttft_p50_ms"], 1),
         "llama_int_ttft_p95_ms": round(slo["ttft_p95_ms"], 1),
     }

@@ -313,6 +313,43 @@ class TestLint:
                   "   # graft: disable=lint-raw-lock\n")
         assert not lint_source(source, "element.py")
 
+    def test_hot_alloc_in_marked_function(self):
+        # the pump-loop rule (ISSUE 7): array construction inside a
+        # `graft: hot-path`-marked function is a per-round allocation
+        rules = self._rules_at(
+            "import numpy as np\n"
+            "class Decoder:\n"
+            "    def pump(self):   # graft: hot-path\n"
+            "        buf = np.zeros((4,))\n"
+            "        return np.asarray(buf)\n")
+        assert ("lint-hot-alloc", 4) in rules
+        # np.asarray is a transfer of an existing buffer, not an
+        # allocation — line 5 must stay clean
+        assert not any(r == "lint-hot-alloc" and ln == 5
+                       for r, ln in rules)
+
+    def test_hot_alloc_marker_on_previous_line(self):
+        rules = self._rules_at(
+            "import jax.numpy as jnp\n"
+            "# graft: hot-path\n"
+            "def round_plan():\n"
+            "    return jnp.full((4,), 1)\n")
+        assert ("lint-hot-alloc", 4) in rules
+
+    def test_hot_alloc_unmarked_function_exempt(self):
+        rules = self._rules_at(
+            "import numpy as np\n"
+            "def setup():\n"
+            "    return np.zeros((4,))\n")
+        assert not any(r == "lint-hot-alloc" for r, _ in rules)
+
+    def test_hot_alloc_waiver(self):
+        source = ("import numpy as np\n"
+                  "def pump():   # graft: hot-path\n"
+                  "    return np.zeros(4)"
+                  "   # graft: disable=lint-hot-alloc\n")
+        assert not lint_source(source, "element.py")
+
 
 # ---------------------------------------------------------------------------
 # wire codec legality table

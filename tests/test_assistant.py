@@ -5,6 +5,7 @@
 # Coqui inline on the event loop: examples/speech/speech_elements.py).
 
 import numpy as np
+import pytest
 
 from aiko_services_tpu.compute import ComputeRuntime
 from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
@@ -18,6 +19,7 @@ def element(name, inputs=(), outputs=()):
             "output": [{"name": n} for n in outputs]}
 
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_assistant_three_model_chain(make_runtime, engine):
     runtime = make_runtime("assistant_host").initialize()
     compute = ComputeRuntime(runtime, "compute")

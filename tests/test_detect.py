@@ -133,6 +133,7 @@ def test_llama_agent_element(make_runtime, engine):
     assert swag2["response_tokens"] == swag["response_tokens"]
 
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_llama_agent_continuous_mode(make_runtime, engine):
     """Continuous batching behind the element: frames from several
     streams decode via iteration-level slots and match the sync path's

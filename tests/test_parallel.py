@@ -137,6 +137,7 @@ def test_ring_attention_jit_compiles_once():
 
 # -- sharded training step ---------------------------------------------------
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_sharded_train_step_decreases_loss():
     import optax
     from aiko_services_tpu.models import (

@@ -151,6 +151,7 @@ def _serve_all(make_runtime, engine, checkpoint, mels, kv_quant,
 
 @pytest.mark.parametrize("kv_quant,pipelined",
                          [(False, True), (True, False)])
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_full_stack_parity(make_runtime, engine, checkpoint, mels,
                            kv_quant, pipelined):
     """Every utterance served through the full stack must decode

@@ -166,6 +166,7 @@ def test_element_gate_suppresses_low_logprob(make_runtime, engine):
     assert "suppressed" not in swag
 
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_element_gate_suppresses_degenerate_text(make_runtime, engine):
     """Repetitive detokenized text trips the compression-ratio gate."""
     pipeline = _asr_pipeline(make_runtime, {"logprob_threshold": -1e9,
@@ -207,6 +208,7 @@ def test_element_timestamps_output_segments(make_runtime, engine):
     assert "segments" in swag and isinstance(swag["segments"], list)
 
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_kv_quant_tensor_parity():
     """Int8 cross-KV mode="tensor" (one scale per BATCH ELEMENT folded
     into the softmax scale, dequant is a bare convert that fuses into

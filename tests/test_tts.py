@@ -227,6 +227,7 @@ def test_neural_tts_element_speaks_the_right_tone(
             f"{word}: dominant {measured:.0f} Hz, expected {freq:.0f}"
 
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_tts_to_asr_roundtrip_text_equality(tts_params):
     """The chained golden gate: TTS speaks "charlie alpha"; the golden
     ASR transcribes the SYNTHESIZED WAVEFORM back to the same text —
@@ -255,6 +256,7 @@ def test_tts_to_asr_roundtrip_text_equality(tts_params):
 
 # -- objective quality: mel-cepstral distortion on HELD-OUT text ---------
 
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
 def test_tts_held_out_mcd():
     """Non-self-referential quality metric (VERDICT r3 item 9): train
     WITHOUT ["alpha", "charlie"], synthesize it with PREDICTED

@@ -51,7 +51,7 @@ def measure(impl: str, preset: str, slots: int, cache_t: int,
         for _ in range(rounds):
             out = step(params, tokens, lengths, active, budgets, k, v,
                        num_steps=num_steps, eos=-1)
-            _, _, _, tokens, lengths, k, v = out
+            _, _, tokens, lengths, k, v = out
         np.asarray(out[0][-1])            # one sync for the chain
     chain(1)                               # compile + warm
     best = float("inf")

@@ -68,6 +68,9 @@ def closed_loop(decoder, rng):
     for _ in range(2 * SLOTS):          # warmup: compile + fill
         submit_one()
     decoder.pump()
+    decoder.pump()        # second round compiles the decode scan
+                          # (round 1 dispatches admits only since the
+                          # decode-first rework)
     # same post-warmup reset protocol as bench.bench_llama (the
     # canonical closed-loop methodology this tool mirrors): compile
     # time must not contaminate stats or SLO percentiles
