@@ -27,6 +27,7 @@ from .process_manager import ProcessManager, RestartPolicy  # noqa: F401
 from .lifecycle import (                                    # noqa: F401
     LifeCycleClient, LifeCycleManager,
 )
+from .autoscaler import Autoscaler, ScalePolicy             # noqa: F401
 from .placement import (                                    # noqa: F401
     DevicePool, DeviceSlice, PlacementManager,
 )

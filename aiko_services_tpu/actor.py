@@ -109,6 +109,7 @@ class Actor(Service):
                     wire.decode_envelope(payload, with_trace=True)
             else:
                 command, params = parse(payload)
+                wire.pop_tenant(params)     # appended after trace
                 trace_fields = wire.pop_trace(params)
         except Exception:
             self.logger.warning("%s: unparseable payload %r",

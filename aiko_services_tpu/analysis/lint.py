@@ -41,6 +41,18 @@
 #                         levelled, routable, and exportable — stdout
 #                         is none of those (CLIs and deliberate console
 #                         tools carry per-line waivers)
+#   lint-unbounded-queue  accumulation in message/event-handler
+#                         contexts with no visible bound or shed
+#                         policy: a bare deque() (no maxlen) built in a
+#                         handler, or .append/.appendleft whose
+#                         receiver the function never pops, clears,
+#                         len()-checks, or deletes from — the unbounded
+#                         mailbox is THE classic overload failure
+#                         (SEDA): it queues until deadlines blow
+#                         instead of shedding at admission.  Sites
+#                         whose bound lives elsewhere (a drain method,
+#                         a lease) carry per-line waivers so the audit
+#                         trail stays in the diff
 #
 # Hot-path marking: a `graft: hot-path` comment on (or directly above)
 # a `def` line opts that function into the allocation rule — purely
@@ -61,7 +73,11 @@ __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
-              "lint-print")
+              "lint-print", "lint-unbounded-queue")
+
+# evidence that an accumulation target is bounded or shed within the
+# same function: any of these appearing against the SAME receiver text
+_BOUND_HINTS = (".pop", ".popleft", ".clear", ".maxlen")
 
 _HOT_MARKER = "graft: hot-path"
 # array CONSTRUCTORS (fresh allocation per call).  asarray/array are
@@ -145,10 +161,31 @@ class _ContextScanner(ast.NodeVisitor):
         self.context = context_name
         self.event = event
         self.hot = hot
+        self._source = ""           # the scanned function's own text
 
     def scan(self, node):
+        try:
+            self._source = ast.unparse(node)
+        except Exception:       # pragma: no cover — unparse is total
+            self._source = ""
         for child in ast.iter_child_nodes(node):
             self.visit(child)
+
+    def _receiver_bounded(self, receiver: str) -> bool:
+        """True when the enclosing function visibly bounds or sheds the
+        accumulation target: pops/clears it, checks len() against it,
+        deletes entries — or the target is a LOCAL the function itself
+        created (a per-call list dies with the call; the rule is about
+        state that outlives the handler).  Purely lexical, like the
+        waivers."""
+        if "." not in receiver and "[" not in receiver and (
+                f"{receiver} = " in self._source
+                or f"{receiver}: " in self._source):
+            return True
+        return any(f"{receiver}{hint}" in self._source
+                   for hint in _BOUND_HINTS) \
+            or f"len({receiver})" in self._source \
+            or f"del {receiver}" in self._source
 
     def visit_FunctionDef(self, node):      # no descent (see docstring)
         pass
@@ -177,6 +214,18 @@ class _ContextScanner(ast.NodeVisitor):
                     f"jax.jit in per-frame context {self.context!r}: "
                     f"build the jitted program once in __init__/_setup "
                     f"(per-frame jit recompiles per shape)")
+            if tail in ("append", "appendleft") and \
+                    isinstance(node.func, ast.Attribute):
+                receiver = ast.unparse(node.func.value)
+                if not self._receiver_bounded(receiver):
+                    self.lint.report(
+                        "lint-unbounded-queue", node,
+                        f"{receiver}.{tail}() accumulates in event-loop "
+                        f"context {self.context!r} with no visible "
+                        f"bound or shed policy in this function: cap "
+                        f"it (maxlen / len() check / shed-oldest) or "
+                        f"waive the audited site with `graft: "
+                        f"disable=lint-unbounded-queue`")
         if self.hot and tail in _ALLOC_TAILS and \
                 target.rpartition(".")[0] in _ALLOC_MODULES:
             self.lint.report(
@@ -186,6 +235,26 @@ class _ContextScanner(ast.NodeVisitor):
                 f"__init__/_setup and refill in place (per-round host "
                 f"allocations are the pump loop's death by a thousand "
                 f"cuts)")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # a bare deque() STORED beyond the call (attribute/subscript
+        # target) in an event context is an unbounded cross-frame
+        # queue; a per-call local deque dies with the call, mirroring
+        # _receiver_bounded's local exemption for .append
+        if self.event and isinstance(node.value, ast.Call) and \
+                _func_tail(node.value.func) == "deque" and \
+                not any(kw.arg == "maxlen"
+                        for kw in node.value.keywords) and \
+                any(not isinstance(target, ast.Name)
+                    for target in node.targets):
+            self.lint.report(
+                "lint-unbounded-queue", node,
+                f"unbounded deque() stored from event-loop context "
+                f"{self.context!r}: give it a maxlen or a shed policy "
+                f"— handler-side accumulation without a bound queues "
+                f"until deadlines blow instead of shedding at "
+                f"admission")
         self.generic_visit(node)
 
 

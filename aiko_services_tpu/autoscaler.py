@@ -1,0 +1,284 @@
+# Autoscaler: metrics-driven elastic capacity for serving runtimes
+# (ISSUE 9, ROADMAP item 2 — the third leg of the overload-control
+# plane beside deadline-aware admission and per-tenant fair queuing).
+#
+# Every process already publishes retained metrics snapshots on
+# {topic_path}/0/metrics (observe/export.py MetricsPublisher) and the
+# LifeCycleManager already supervises a fleet under a RestartPolicy
+# (ISSUE 4).  This actor closes the loop: it subscribes to the
+# namespace's metrics topics, extracts the three load signals the
+# roadmap names — event mailbox depth, remote-hop p95 latency, and
+# batch-former queue wait — and scales the fleet through
+# LifeCycleManager.scale_to with hysteresis, so a threshold-straddling
+# load step cannot flap capacity up and down every evaluation:
+#
+#   * scale UP when ANY signal has breached its up-threshold for
+#     `hysteresis` consecutive evaluations (overload is urgent; one
+#     healthy signal must not veto);
+#   * scale DOWN when EVERY signal has been below its down-threshold
+#     for `hysteresis` consecutive evaluations (shrinking is cheap to
+#     delay, expensive to regret);
+#   * hold the floor immediately: a fleet below min_clients (a crash
+#     the restart policy has not yet replaced, a crash-looping
+#     manager) respawns on the next evaluation without waiting out the
+#     streak — capacity loss is the one signal that needs no
+#     confirmation;
+#   * a cooldown after every action lets the new capacity's metrics
+#     arrive before the next verdict.
+#
+# Scale decisions are themselves observable: counted into
+# autoscaler_decisions_total{action, reason}, mirrored into gauges, and
+# recorded as tracer spans when tracing is enabled.
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+
+from .actor import Actor
+from .observe import tracing
+from .observe.export import METRICS_TOPIC_SUFFIX, series_quantile
+from .observe.metrics import default_registry
+from .service import ServiceProtocol
+from .utils import get_logger
+
+__all__ = ["Autoscaler", "ScalePolicy", "PROTOCOL_AUTOSCALER"]
+
+PROTOCOL_AUTOSCALER = ServiceProtocol("autoscaler")
+
+# a snapshot older than this many seconds is a corpse (its process died
+# or its publisher stopped) and must not keep voting on load
+_SNAPSHOT_HORIZON = 30.0
+
+
+@dataclass(frozen=True)
+class ScalePolicy:
+    """Thresholds and pacing for the scale loop.  Up-thresholds trip on
+    ANY signal; down-thresholds require ALL signals quiet."""
+    min_clients: int = 1
+    max_clients: int = 4
+    mailbox_depth_up: float = 64.0      # queued events, worst process
+    hop_p95_up: float = 1.0             # seconds, pipeline_hop_seconds
+    batch_wait_up: float = 100.0        # ms, batch_mean_wait_ms
+    mailbox_depth_down: float = 4.0
+    hop_p95_down: float = 0.25
+    batch_wait_down: float = 20.0
+    hysteresis: int = 3                 # consecutive breaching evals
+    cooldown: float = 10.0              # seconds between scale actions
+    step: int = 1                       # clients added/removed per action
+
+
+class Autoscaler(Actor):
+    """Watches retained {topic}/0/metrics snapshots and drives a
+    LifeCycleManager's fleet size.
+
+    `manager` is the LifeCycleManager whose spawner builds one serving
+    runtime per client (under its RestartPolicy — the autoscaler and
+    the crash supervisor share one actuator, so they cannot fight over
+    the same fleet).  `topic_filter` defaults to every process in the
+    runtime's namespace; narrow it when several fleets share a
+    namespace."""
+
+    def __init__(self, runtime, name: str = "autoscaler", manager=None,
+                 policy: ScalePolicy | None = None,
+                 interval: float = 2.0, topic_filter: str | None = None):
+        super().__init__(runtime, name, PROTOCOL_AUTOSCALER)
+        self.logger = get_logger(f"autoscaler.{name}")
+        self.manager = manager
+        self.policy = policy or ScalePolicy()
+        self.interval = float(interval)
+        # topic_path is {namespace}/{host}/{pid}; metrics snapshots ride
+        # {topic_path}/0/metrics
+        self._filter = topic_filter or \
+            f"{runtime.namespace}/+/+/{METRICS_TOPIC_SUFFIX}"
+        self._snapshots: dict[str, dict] = {}    # topic_path -> document
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_at: float | None = None
+        registry = default_registry()
+        labels = {"autoscaler": name}
+        self._decision_counters: dict = {}
+        self._registry = registry
+        self._labels = labels
+        self._clients_gauge = registry.gauge(
+            "autoscaler_clients", "fleet size the autoscaler manages",
+            labels)
+        self._signal_gauges = {
+            "mailbox_depth": registry.gauge(
+                "autoscaler_signal_mailbox_depth",
+                "worst observed event mailbox depth", labels),
+            "hop_p95": registry.gauge(
+                "autoscaler_signal_hop_p95_s",
+                "worst observed remote-hop p95 seconds", labels),
+            "batch_wait": registry.gauge(
+                "autoscaler_signal_batch_wait_ms",
+                "worst observed batch-former mean wait ms", labels),
+        }
+        runtime.add_message_handler(self._metrics_handler, self._filter)
+        self._timer = runtime.event.add_timer_handler(self.evaluate,
+                                                      self.interval)
+
+    # -- snapshot intake ----------------------------------------------------
+    def _metrics_handler(self, topic: str, payload) -> None:
+        try:
+            if isinstance(payload, (bytes, bytearray)):
+                payload = payload.decode("utf-8")
+            document = json.loads(payload)
+        except Exception:
+            self.logger.debug("autoscaler %s: unparseable snapshot on "
+                              "%s", self.name, topic)
+            return
+        if not isinstance(document, dict) or "snapshot" not in document:
+            return
+        document["_received"] = self.runtime.event.clock.now()
+        self._snapshots[str(document.get("topic_path", topic))] = document
+
+    # -- signal extraction --------------------------------------------------
+    def signals(self) -> dict:
+        """Worst-case load signals across every live snapshot:
+        {"mailbox_depth", "hop_p95", "batch_wait"} (0.0 when a family
+        has no series yet)."""
+        now = self.runtime.event.clock.now()
+        mailbox = hop_p95 = batch_wait = 0.0
+        # prune corpses outright: under restart churn every dead
+        # process left its last full snapshot behind under a unique
+        # pid topic_path — skipping them is not enough, the dict (and
+        # the per-tick iteration) must not grow without bound
+        stale = [key for key, document in self._snapshots.items()
+                 if now - document.get("_received", now)
+                 > _SNAPSHOT_HORIZON]
+        for key in stale:
+            del self._snapshots[key]
+        for document in self._snapshots.values():
+            snapshot = document.get("snapshot", {})
+            for series in snapshot.get("event_mailbox_depth",
+                                       {}).get("series", []):
+                mailbox = max(mailbox, float(series.get("value", 0)))
+            for series in snapshot.get("pipeline_hop_seconds",
+                                       {}).get("series", []):
+                hop_p95 = max(hop_p95, series_quantile(series, 0.95))
+            for series in snapshot.get("batch_mean_wait_ms",
+                                       {}).get("series", []):
+                batch_wait = max(batch_wait,
+                                 float(series.get("value", 0)))
+        return {"mailbox_depth": mailbox, "hop_p95": hop_p95,
+                "batch_wait": batch_wait}
+
+    # -- the scale loop -----------------------------------------------------
+    def _count_decision(self, action: str, reason: str) -> None:
+        key = (action, reason)
+        counter = self._decision_counters.get(key)
+        if counter is None:
+            counter = self._registry.counter(
+                "autoscaler_decisions_total",
+                "scale loop verdicts by action and reason",
+                labels={**self._labels, "action": action,
+                        "reason": reason})
+            self._decision_counters[key] = counter
+        counter.inc()
+
+    def _in_cooldown(self, now: float) -> bool:
+        return self._last_action_at is not None and \
+            now - self._last_action_at < self.policy.cooldown
+
+    def _act(self, delta: int, reason: str, now: float,
+             signals: dict) -> None:
+        action = "up" if delta > 0 else "down"
+        target = len(self.manager.clients) + delta
+        target = min(max(target, 0), self.policy.max_clients)
+        if delta < 0:
+            # a step larger than the headroom must not shrink below
+            # the floor — it would trigger a below-floor respawn next
+            # tick and flap forever
+            target = max(target, self.policy.min_clients)
+        started = time.perf_counter()
+        applied = self.manager.scale_to(target)
+        if applied == 0:
+            return
+        self._last_action_at = now
+        self._up_streak = 0
+        self._down_streak = 0
+        self._count_decision(action, reason)
+        self.logger.warning(
+            "autoscaler %s: scale %s (%+d -> %d clients, reason=%s, "
+            "signals=%s)", self.name, action, applied,
+            len(self.manager.clients), reason,
+            {k: round(v, 3) for k, v in signals.items()})
+        trc = tracing.tracer
+        if trc.enabled:
+            trc.record(f"autoscale:{action}", started,
+                       time.perf_counter() - started,
+                       context=tracing.new_trace(), cat="autoscale",
+                       proc=self.name,
+                       args={"reason": reason, "delta": applied,
+                             **{k: round(v, 4)
+                                for k, v in signals.items()}})
+
+    def evaluate(self) -> None:
+        """One scale-loop tick (engine timer, so virtual-clock tests
+        drive it deterministically)."""
+        if self.manager is None:
+            return
+        policy = self.policy
+        now = self.runtime.event.clock.now()
+        signals = self.signals()
+        self._signal_gauges["mailbox_depth"].set(
+            signals["mailbox_depth"])
+        self._signal_gauges["hop_p95"].set(signals["hop_p95"])
+        self._signal_gauges["batch_wait"].set(signals["batch_wait"])
+        total = len(self.manager.clients)
+        self._clients_gauge.set(total)
+
+        # floor restoration needs no hysteresis: lost capacity (a crash
+        # the restart supervisor gave up on, a slow respawn) is not a
+        # noisy signal — but it still honours the cooldown, or a
+        # handshaking replacement would be double-spawned every tick
+        if total < policy.min_clients:
+            if not self._in_cooldown(now):
+                self._act(policy.min_clients - total, "below-floor",
+                          now, signals)
+            return
+        overload = (
+            signals["mailbox_depth"] >= policy.mailbox_depth_up
+            or signals["hop_p95"] >= policy.hop_p95_up
+            or signals["batch_wait"] >= policy.batch_wait_up)
+        underload = (
+            signals["mailbox_depth"] <= policy.mailbox_depth_down
+            and signals["hop_p95"] <= policy.hop_p95_down
+            and signals["batch_wait"] <= policy.batch_wait_down)
+        if overload:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif underload:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            # the dead band between the thresholds: this is what
+            # absorbs a threshold-straddling load step — neither streak
+            # may keep growing on ambiguous evidence
+            self._up_streak = 0
+            self._down_streak = 0
+            self._count_decision("hold", "dead-band")
+            return
+        if self._in_cooldown(now):
+            self._count_decision("hold", "cooldown")
+            return
+        if overload and self._up_streak >= policy.hysteresis:
+            if total < policy.max_clients:
+                self._act(policy.step, "overload", now, signals)
+            else:
+                self._count_decision("hold", "at-max")
+        elif underload and self._down_streak >= policy.hysteresis:
+            if total > policy.min_clients:
+                self._act(-policy.step, "underload", now, signals)
+            else:
+                self._count_decision("hold", "at-min")
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self.runtime.event.remove_timer_handler(self._timer)
+            self._timer = None
+        self.runtime.remove_message_handler(self._metrics_handler,
+                                            self._filter)
+        super().stop()

@@ -266,6 +266,7 @@ class ComputeRuntime(Actor):
         elif program.scheduler is not None:
             program.scheduler.observe_service_time(bucket, elapsed)
             if program.recent_service is not None:
+                # audited: deque(maxlen=512)  # graft: disable=lint-unbounded-queue
                 program.recent_service.append((bucket, elapsed))
         if program.scheduler is not None:
             self._publish_stats(program.name, program.scheduler)

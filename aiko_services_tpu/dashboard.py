@@ -118,6 +118,7 @@ class DashboardState:
         self.page = "log"
 
     def _on_log(self, _topic, payload) -> None:
+        # audited: deque(maxlen=_LOG_LIMIT)  # graft: disable=lint-unbounded-queue
         self.log_lines.append(str(payload))
 
     def close_log(self) -> None:
@@ -217,6 +218,8 @@ class DashboardState:
             self._history_expected = parse_int(params[0], 0)
         elif command == "history" and params:
             try:
+                # audited: reset per history request, bounded by the
+                # registrar's requested count  # graft: disable=lint-unbounded-queue
                 self.history_rows.append(ServiceFields.from_record(
                     params[0]))
             except Exception:

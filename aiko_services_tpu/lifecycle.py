@@ -251,6 +251,32 @@ class LifeCycleManager(Actor):
     def ready_count(self) -> int:
         return sum(1 for r in self.clients.values() if r.state == "ready")
 
+    def ready_ids(self) -> list[str]:
+        """Ready client ids in creation order (ids are monotonic)."""
+        return sorted((cid for cid, record in self.clients.items()
+                       if record.state == "ready"), key=int)
+
+    # -- elastic capacity (ISSUE 9: the autoscaler's actuator) --------------
+    def scale_to(self, count: int) -> int:
+        """Grow or shrink the fleet to `count` clients.  Growth spawns
+        through the normal create path (handshake-leased, supervised
+        under the restart policy); shrink retires the NEWEST ready
+        clients first — the oldest capacity is the warmest (compiled
+        programs, filled caches), so it is the last to go.  Returns the
+        signed delta actually applied."""
+        count = max(0, int(count))
+        current = len(self.clients)
+        if count > current:
+            self.create_clients(count - current)
+            return count - current
+        removed = 0
+        for client_id in reversed(self.ready_ids()):
+            if current - removed <= count:
+                break
+            self.delete_client(client_id)
+            removed += 1
+        return -removed
+
     def _publish_count(self) -> None:
         self.ec_producer.update("client_count", len(self.clients))
 

@@ -203,6 +203,17 @@ class MetricsRegistry:
         return metric.count if isinstance(metric, Histogram) \
             else metric.value
 
+    def series(self, name: str) -> list:
+        """Every live series of one metric family, whatever its labels:
+        [(labels_dict, metric), ...].  Readers that aggregate across a
+        family without knowing the label sets in advance (the admission
+        gate's batch_mean_wait_ms fallback, the autoscaler's signal
+        extraction) use this instead of reconstructing keys."""
+        with self._lock:
+            return [(dict(metric.labels), metric)
+                    for (metric_name, _), metric in self._metrics.items()
+                    if metric_name == name]
+
     def snapshot(self) -> dict:
         """Plain-data view of every series, JSON-able:
         {name: {"type", "help", "series": [{"labels", ...values}]}}."""

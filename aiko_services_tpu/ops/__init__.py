@@ -2,8 +2,12 @@
 # inference data plane (SURVEY.md §7).  jax imports stay inside modules so
 # the control plane never pays for them.
 
+from .admission import (                                    # noqa: F401
+    AdmissionGate, TenantFairQueue, TenantPolicy,
+)
 from .batching import (                                     # noqa: F401
     BatchItem, BatchingScheduler, ShapeBuckets,
 )
 
-__all__ = ["BatchItem", "BatchingScheduler", "ShapeBuckets"]
+__all__ = ["AdmissionGate", "BatchItem", "BatchingScheduler",
+           "ShapeBuckets", "TenantFairQueue", "TenantPolicy"]

@@ -125,6 +125,59 @@ class BatchingScheduler:
         with self._lock:
             return self._service_ewma.get(bucket)
 
+    def estimated_wait(self, bucket_key: int | None = None,
+                       extra: int = 1) -> float | None:
+        """Expected queue wait for the NEXT `extra` item(s) submitted to
+        `bucket_key` (None = worst case over every non-empty bucket):
+        batch-forming delay plus the service time of every batch ahead
+        of — and including — the one the item would join.
+
+            wait ≈ forming_delay + ceil((occupancy + extra) / max_batch)
+                   × service_ewma
+
+        forming_delay is the head item's remaining max_wait share (the
+        joining batch won't dispatch before it fills or the head ages
+        out); it collapses to 0 once the joining batch would be full.
+        With no service EWMA yet (cold scheduler) the observed mean
+        queue wait substitutes — the same level mirrored into the
+        registry's batch_mean_wait_ms gauge — and a scheduler that has
+        never dispatched returns None: the admission gate must not shed
+        on a number it doesn't have."""
+        now = self.clock()
+        with self._lock:
+            if bucket_key is None:
+                keys = [k for k, b in self._queues.items() if b.items]
+                if not keys:
+                    keys = list(self._service_ewma)
+                if not keys:
+                    return self.mean_wait() if self.stats["items"] \
+                        else None
+                return max(
+                    (w for w in (self._estimate_locked(k, extra, now)
+                                 for k in keys) if w is not None),
+                    default=None)
+            return self._estimate_locked(bucket_key, extra, now)
+
+    def _estimate_locked(self, bucket_key: int, extra: int,
+                         now: float) -> float | None:
+        bucket = self._queues.get(bucket_key)
+        occupancy = len(bucket.items) if bucket is not None else 0
+        estimate = self._service_ewma.get(bucket_key)
+        if estimate is None:
+            # cold bucket: the scheduler-wide mean wait is the only
+            # signal there is (it feeds batch_mean_wait_ms)
+            return self.mean_wait() if self.stats["items"] else None
+        joining = occupancy + max(1, extra)
+        if joining >= self.max_batch:
+            forming = 0.0
+        elif bucket is not None and bucket.items:
+            head_age = now - bucket.items[0].enqueue_time
+            forming = max(0.0, self.max_wait - head_age)
+        else:
+            forming = self.max_wait
+        batches_ahead = -(-joining // self.max_batch)   # ceil division
+        return forming + batches_ahead * estimate
+
     def _deadline_at_risk(self, bucket_key: int, bucket: _Bucket,
                           now: float) -> bool:
         """True when waiting any longer would likely miss the earliest

@@ -581,7 +581,8 @@ class Pipeline(PipelineElement):
                  retry_jitter: float = 0.25,
                  retry_seed: int | None = None,
                  stream_failure_budget: int = 1,
-                 frame_deadline: float = 0.0):
+                 frame_deadline: float = 0.0,
+                 admission=None):
         self._element_classes = element_classes or {}
         self.graph = PipelineGraph.from_definition(definition)
         self.graph.validate(definition)
@@ -645,7 +646,8 @@ class Pipeline(PipelineElement):
             "dup_requests": 0, "replayed_replies": 0,
             "frames_failed": 0, "streams_stopped": 0,
             "one_way_shed": 0, "deadline_exceeded": 0,
-            "deadline_rejected": 0,
+            "deadline_rejected": 0, "shed_early": 0,
+            "admission_shed": 0,
         }, metric="pipeline_recovery_total",
             help="pipeline recovery machinery events by kind",
             labels={"pipeline": self.name})
@@ -681,6 +683,24 @@ class Pipeline(PipelineElement):
         self._remote_wire_codecs = dict(remote_wire_codecs or {})
         self._reply_buffer: dict[str, list] = {}
         self._reply_flush_scheduled = False
+        # -- overload control (ISSUE 9) ----------------------------------
+        # admission is an ops/admission.py AdmissionGate: remote
+        # requests whose deadline budget cannot survive the estimated
+        # queue wait are answered shed-early BEFORE any work, and
+        # admitted requests pass a per-tenant weighted fair queue whose
+        # inflight window is credited back as replies go out.  None
+        # keeps the legacy walk-immediately semantics.
+        self.admission = admission
+        self._admitted_keys: set = set()
+        self._admission_timer = None
+        if admission is not None:
+            # drain BACKSTOP only: the hot-path trigger is a reply
+            # releasing an inflight credit (zero-delay oneshot in
+            # _send_remote_reply); this timer exists so a queued frame
+            # cannot strand when the pipeline goes idle, so it ticks
+            # slowly and exits immediately on an empty queue
+            self._admission_timer = runtime.event.add_timer_handler(
+                self._drain_admission, 0.05)
         self._create_elements()
         self._precompute_schedule()
         self.ec_producer.update("element_count", len(self.graph))
@@ -1197,12 +1217,21 @@ class Pipeline(PipelineElement):
     def _hop_entry(self, pending: _PendingHop, hop_id: str) -> list:
         """The wire entry for one request hop.  The trace context is
         re-serialized per send, so a retry carries the SHRUNK remaining
-        budget, not the original one."""
+        budget, not the original one.  The stream's tenant/tier
+        parameters ride as a trailing self-tagged field list (ISSUE 9)
+        — the serving admission gate charges the hop to the right
+        per-tenant budget; both fields are markers, so a tenant tag
+        without a trace is unambiguous at the receiver."""
         entry = [pending.frame.stream_id, pending.inputs, self.topic_in,
                  hop_id]
         if pending.trace is not None:
             entry.append(pending.trace.to_fields(
                 self.runtime.event.clock.now()))
+        parameters = pending.frame.stream.parameters
+        tenant = parameters.get("tenant")
+        if tenant:
+            entry.append(wire.tenant_fields(tenant,
+                                            parameters.get("tier", 1)))
         return entry
 
     def _arm_hop_lease(self, pending: _PendingHop, hop_id: str) -> None:
@@ -1338,7 +1367,14 @@ class Pipeline(PipelineElement):
             placeholder.outstanding += len(request)
             self._wire_counters["request_envelopes"].inc()
             self._wire_counters["request_frames"].inc(len(request))
-            if len(request) == 1:
+            # a tenant-tagged solo entry must ship in the COALESCED
+            # form: as the last positional of a bare RPC its tag is
+            # indistinguishable from a header-level tenant marker and
+            # the receiving actor's pop_tenant would strip it (a trace
+            # in that slot survives — the actor re-injects it as the
+            # ambient context, but there is no ambient tenant)
+            if len(request) == 1 and \
+                    not wire.is_tenant_fields(request[0][-1]):
                 placeholder.proxy.process_frame_remote(*request[0])
             else:
                 placeholder.proxy.process_frames_remote(request)
@@ -1563,7 +1599,7 @@ class Pipeline(PipelineElement):
                 self.resume_remote_frame(*entry[:4])
 
     def process_frame_remote(self, stream_id, inputs, reply_topic, hop_id,
-                             trace=None):
+                             trace=None, tenant=None):
         """Serving entry: walk a frame for a remote caller and reply with
         the final swag when it completes (including through DEFERRED
         elements).
@@ -1578,7 +1614,20 @@ class Pipeline(PipelineElement):
         trace context: the walk runs under it — its spans share the
         caller's trace id — and a request arriving with its deadline
         budget already spent is rejected fast instead of walked (the
-        caller has, by definition, stopped waiting)."""
+        caller has, by definition, stopped waiting).
+
+        `tenant` (optional trailing entry field, wire.tenant_fields) is
+        the caller stream's tenant/tier tag.  With an admission gate
+        configured (ISSUE 9) the request passes two further verdicts
+        before any work: shed-early when the estimated queue wait
+        cannot meet the remaining deadline budget (one cheap failure
+        reply, and the caller fails over), then the per-tenant weighted
+        fair queue.  Both markers are self-tagged, so a tenant tag
+        arriving without a trace lands in the `trace` slot and is
+        re-sorted here."""
+        if tenant is None and wire.is_tenant_fields(trace):
+            trace, tenant = None, trace
+        tenant_name, tier = wire.parse_tenant(tenant)
         key = (str(reply_topic), str(hop_id))
         if key in self._served_hops:
             self.recovery_stats["dup_requests"] += 1
@@ -1603,22 +1652,84 @@ class Pipeline(PipelineElement):
             # the failure reply is cached in the dedup ring, so a
             # duplicate of this dead request replays the verdict
             self.recovery_stats["deadline_rejected"] += 1
+            if self.admission is not None:
+                self.admission.count_rejected(tenant_name, tier,
+                                              "expired")
             self._shim_failure_reply(
                 key, stream_id,
                 f"deadline exceeded before processing (hop {hop_id})")
             return
-        inputs = dict(inputs or {})
+        if self.admission is not None:
+            remaining = context.remaining(now) \
+                if context is not None else None
+            shed, wait = self.admission.shed_early(remaining)
+            if shed:
+                # reject at the cheapest point: the dedup-cached reply
+                # costs one control message, and the caller's retry
+                # machinery rotates to another candidate instead of
+                # queueing doomed work here (charged to the caller's
+                # stream failure budget like deadline_rejected)
+                self.recovery_stats["shed_early"] += 1
+                self.admission.count_rejected(tenant_name, tier,
+                                              "shed-early")
+                self._shim_failure_reply(
+                    key, stream_id,
+                    f"shed-early: estimated queue wait {wait:.3f}s "
+                    f"cannot meet remaining budget {remaining:.3f}s "
+                    f"(hop {hop_id})")
+                return
+            item = (key, str(stream_id), dict(inputs or {}), context,
+                    tenant_name, tier)
+            self._admitted_keys.add(key)
+            self.admission.offer(tenant_name, item,
+                                 shed=self._shed_admitted, tier=tier,
+                                 dispatch=self._run_admitted)
+            return
+        self._serve_walk(key, str(stream_id), dict(inputs or {}),
+                         context, tenant_name, tier)
+
+    def _serve_walk(self, key, stream_id, inputs, context, tenant,
+                    tier) -> None:
+        """Run one admitted remote request's walk.  The tenant tag is
+        stamped into the stream's parameters at creation, so elements
+        and nested pipelines see it through get_parameter and further
+        hops re-ship it (ISSUE 9)."""
+        if tenant and self.auto_create_streams and \
+                stream_id not in self.streams:
+            self.create_stream(stream_id,
+                               parameters={"tenant": tenant,
+                                           "tier": tier})
         try:
             with tracing.activate(context):
                 result = self.process_frame(stream_id, inputs,
-                                            _reply_to=(str(reply_topic),
-                                                       str(hop_id)),
+                                            _reply_to=key,
                                             _reply_skip=inputs)
         except Exception as exc:
             self._shim_failure_reply(key, stream_id, repr(exc))
             raise
         if not result.ok:
             self._shim_failure_reply(key, stream_id, result.diagnostic)
+
+    # -- admission gate plumbing (ISSUE 9) ----------------------------------
+    def _run_admitted(self, item) -> None:
+        key, stream_id, inputs, context, tenant, tier = item
+        self._serve_walk(key, stream_id, inputs, context, tenant, tier)
+
+    def _shed_admitted(self, item) -> None:
+        """Fair-queue shed: the frame never ran — answer its caller so
+        the dedup ring doesn't strand retries, and give back nothing
+        (it never held an inflight credit)."""
+        key, stream_id, _inputs, _context, tenant, _tier = item
+        self._admitted_keys.discard(key)
+        self.recovery_stats["admission_shed"] += 1
+        self._shim_failure_reply(
+            key, stream_id,
+            f"shed: tenant {tenant or 'default'!r} over admission "
+            f"budget")
+
+    def _drain_admission(self) -> None:
+        if self.admission is not None and self.admission.queue.depth():
+            self.admission.drain(self._run_admitted)
 
     def _shim_failure_reply(self, key, stream_id, diagnostic) -> None:
         """Answer a remote request whose walk died before any frame
@@ -1681,12 +1792,13 @@ class Pipeline(PipelineElement):
 
     def process_frames_remote(self, entries):
         """Coalesced request/response entry: one envelope, many
-        (stream_id, inputs, reply_topic, hop_id[, trace]) frames —
-        each frame's OWN trace context rides its entry, so coalescing
-        never mixes trace ids or deadlines."""
+        (stream_id, inputs, reply_topic, hop_id[, trace][, tenant])
+        frames — each frame's OWN trace context and tenant tag ride its
+        entry, so coalescing never mixes trace ids, deadlines, or
+        per-tenant budgets."""
         for entry in entries or []:
             if isinstance(entry, (list, tuple)) and len(entry) >= 4:
-                self.process_frame_remote(*entry[:5])
+                self.process_frame_remote(*entry[:6])
 
     def _fail_frame(self, frame, node_name, diagnostic) -> None:
         self.logger.error("pipeline %s stream %s frame %s: element %s "
@@ -1746,6 +1858,14 @@ class Pipeline(PipelineElement):
             outputs = {k: v for k, v in outputs.items()
                        if k not in elided}
         key = (topic, str(hop_id))
+        if self.admission is not None and key in self._admitted_keys:
+            # the admitted frame's reply is going out: return its
+            # inflight credit and release the next queued frame on a
+            # fresh engine turn (never recurse inside a drain)
+            self._admitted_keys.discard(key)
+            self.admission.release()
+            self.runtime.event.add_oneshot_handler(
+                self._drain_admission, 0.0)
         if wire.supports_binary(self.runtime.message):
             # binary envelope reply: tensors cross back out-of-band
             # (zero text round-trip); replies to one caller coalesce
@@ -1794,6 +1914,13 @@ class Pipeline(PipelineElement):
             self.runtime.publish(topic, payload)
 
     def stop(self) -> None:
+        if self._admission_timer is not None:
+            self.runtime.event.remove_timer_handler(self._admission_timer)
+            self._admission_timer = None
+        if self.admission is not None:
+            # queued-but-never-run frames still owe their callers a
+            # reply — shed them through the normal failure path first
+            self.admission.queue.shed_all(reason="shutdown")
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
         # any hop that survived stream teardown (e.g. nested frames on

@@ -5,12 +5,20 @@
 # log topic filter ({namespace}/+/+/+/log), keeps an LRU of per-topic ring
 # buffers, and republishes counts into its EC share so dashboards can
 # discover which services are logging and fetch their tails.
+#
+# Metrics page (ISSUE 9, the PR 5 follow-up): the same discipline for the
+# retained {topic_path}/0/metrics snapshots every MetricsPublisher emits —
+# the dashboard's 'm' pane renders the LOCAL registry; the Recorder is
+# what captures REMOTE processes' snapshots, browsable live
+# (metrics_tail) and persistable to Storage beside the log rings.
 
 from __future__ import annotations
 
+import json
 from collections import deque
 
 from .actor import Actor
+from .observe.export import METRICS_TOPIC_SUFFIX
 from .service import ServiceProtocol
 from .utils import LRUCache, get_logger
 
@@ -19,20 +27,34 @@ __all__ = ["Recorder", "PROTOCOL_RECORDER"]
 PROTOCOL_RECORDER = ServiceProtocol("recorder")
 _TOPIC_LIMIT = 64           # LRU of log topics
 _RING_LIMIT = 128           # records per topic
+_METRICS_RING_LIMIT = 8     # snapshots kept per metrics topic (each is
+                            # a full registry dump — deep history is the
+                            # scraper's job, the tail is the Recorder's)
 
 
 class Recorder(Actor):
     def __init__(self, runtime, name: str = "recorder",
                  topic_limit: int = _TOPIC_LIMIT,
-                 ring_limit: int = _RING_LIMIT):
+                 ring_limit: int = _RING_LIMIT,
+                 metrics_ring_limit: int = _METRICS_RING_LIMIT):
         super().__init__(runtime, name, PROTOCOL_RECORDER)
         self.logger = get_logger("recorder")
         self.ring_limit = ring_limit
+        self.metrics_ring_limit = metrics_ring_limit
         self.buffers: LRUCache = LRUCache(topic_limit)
+        self.metrics_buffers: LRUCache = LRUCache(topic_limit)
         self._log_filter = f"{runtime.namespace}/+/+/+/log"
         runtime.add_message_handler(self._log_handler, self._log_filter)
+        # topic_path is {namespace}/{host}/{pid}; snapshots ride
+        # {topic_path}/0/metrics (observe/export.py MetricsPublisher) —
+        # retained, so a late-started Recorder still catches the latest
+        self._metrics_filter = \
+            f"{runtime.namespace}/+/+/{METRICS_TOPIC_SUFFIX}"
+        runtime.add_message_handler(self._metrics_handler,
+                                    self._metrics_filter)
         self.ec_producer.update("topic_count", 0)
         self.ec_producer.update("record_count", 0)
+        self.ec_producer.update("metrics_topic_count", 0)
 
     def _log_handler(self, topic: str, payload) -> None:
         ring = self.buffers.get(topic)
@@ -44,6 +66,23 @@ class Recorder(Actor):
         total = sum(len(self.buffers.get(t)) for t in self.buffers.keys())
         self.ec_producer.update("record_count", total)
 
+    def _metrics_handler(self, topic: str, payload) -> None:
+        try:
+            if isinstance(payload, (bytes, bytearray)):
+                payload = payload.decode("utf-8")
+            document = json.loads(payload)
+        except Exception:
+            self.logger.debug("recorder: unparseable metrics snapshot "
+                              "on %s", topic)
+            return
+        ring = self.metrics_buffers.get(topic)
+        if ring is None:
+            ring = deque(maxlen=self.metrics_ring_limit)
+            self.metrics_buffers.put(topic, ring)
+            self.ec_producer.update("metrics_topic_count",
+                                    len(self.metrics_buffers))
+        ring.append(document)
+
     def tail(self, topic: str, count: int = 16) -> list:
         ring = self.buffers.get(topic)
         return list(ring)[-count:] if ring else []
@@ -51,13 +90,23 @@ class Recorder(Actor):
     def topics(self) -> list[str]:
         return list(self.buffers.keys())
 
+    def metrics_tail(self, topic: str, count: int = 1) -> list:
+        """The last `count` captured snapshot documents of one metrics
+        topic (parsed: {"process", "topic_path", "time", "snapshot"})."""
+        ring = self.metrics_buffers.get(topic)
+        return list(ring)[-count:] if ring else []
+
+    def metrics_topics(self) -> list[str]:
+        return list(self.metrics_buffers.keys())
+
     def persist(self, storage_topic_in: str) -> None:
         """Write every ring durably to a Storage service (sqlite) as
-        `log/<topic>` → record list, over the standard `(put ...)` RPC —
-        the persistence the reference recorder aspired to but never
-        built (reference recorder.py ring buffers are memory-only).
-        Callable remotely: publish `(persist <storage_topic_in>)` to
-        this recorder's in topic.
+        `log/<topic>` → record list and `metrics/<topic>` → snapshot
+        list, over the standard `(put ...)` RPC — the persistence the
+        reference recorder aspired to but never built (reference
+        recorder.py ring buffers are memory-only).  Callable remotely:
+        publish `(persist <storage_topic_in>)` to this recorder's in
+        topic.
 
         Binary records (bytes from binary log topics) are persisted as
         latin-1 text — lossless byte mapping, not a Python repr."""
@@ -71,9 +120,16 @@ class Recorder(Actor):
                        if isinstance(record, bytes) else str(record)
                        for record in self.buffers.get(topic)]
             storage.put(f"log/{topic}", records)
+        for topic in self.metrics_buffers.keys():
+            storage.put(f"metrics/{topic}",
+                        list(self.metrics_buffers.get(topic)))
         self.ec_producer.update("persisted_topics", len(self.buffers))
+        self.ec_producer.update("persisted_metrics_topics",
+                                len(self.metrics_buffers))
 
     def stop(self) -> None:
         self.runtime.remove_message_handler(self._log_handler,
                                             self._log_filter)
+        self.runtime.remove_message_handler(self._metrics_handler,
+                                            self._metrics_filter)
         super().stop()

@@ -1036,7 +1036,8 @@ class ContinuousDecoder:
              "spec_proposed": 0, "spec_accepted": 0,
              "accepted_per_step": 0.0,
              "bytes_moved": 0, "prefill_chunks": 0,
-             "chunk_admits": 0, "round_prefill_tokens_max": 0},
+             "chunk_admits": 0, "round_prefill_tokens_max": 0,
+             "admission_shed": 0},
             metric="serving_decoder_total",
             help="continuous-decoder events by kind",
             # levels and time-sums stay dict-only: a high-water mark or
@@ -1051,10 +1052,44 @@ class ContinuousDecoder:
         self.itl_samples: deque = deque(maxlen=8192)
         self.gap_samples: deque = deque(maxlen=8192)
         self._round_prefill_tokens = 0
+        # EWMA of recent working-round wall time (alpha 0.3), fed by
+        # pump(): the deadline-aware admission estimate's time base
+        self._round_ewma: float | None = None
 
     # -- public API --------------------------------------------------------
+    def estimated_admit_wait(self) -> float | None:
+        """Coarse time-to-first-token wait estimate for the NEXT
+        submitted request: at least one working round when a slot is
+        free, scaled by the backlog's share of the slot pool when all
+        slots are taken.  Deliberately a cheap lower-bound heuristic —
+        it exists to shed requests that are grossly doomed under
+        overload (the deadline-aware admission gate, ISSUE 9), not to
+        predict TTFT; None until a round has been measured, because
+        admission must not drop work on a number it doesn't have."""
+        if self._round_ewma is None:
+            return None
+        free = sum(1 for request in self._slots if request is None)
+        waiting = len(self._pending)
+        if waiting < free:
+            return self._round_ewma
+        return self._round_ewma * \
+            (1.0 + (waiting - free + 1) / max(1, self.max_slots))
+
     def submit(self, request_id: str, prompt, max_new_tokens: int,
-               callback) -> None:
+               callback, deadline: float | None = None) -> bool:
+        """Enqueue one request; returns False when deadline-aware
+        admission rejected it instead (the callback is NOT invoked —
+        the caller owns the refusal).  `deadline` (absolute,
+        time.monotonic seconds) is the request's first-token target: a
+        request whose deadline cannot survive the estimated admit wait
+        is refused NOW, so the caller fails over or degrades instead of
+        queueing doomed work (ISSUE 9)."""
+        if deadline is not None:
+            wait = self.estimated_admit_wait()
+            if wait is not None and \
+                    time.monotonic() + wait >= float(deadline):
+                self.stats["admission_shed"] += 1
+                return False
         # keep the TAIL on overflow (recent context matters most).
         # Without chunked prefill the largest bucket is a hard cap (an
         # oversized prompt would blow up _admit's scatter); with it,
@@ -1069,6 +1104,7 @@ class ContinuousDecoder:
         self._pending.append(DecodeRequest(
             request_id, prompt, int(max_new_tokens), callback,
             submit_time=time.monotonic()))
+        return True
 
     def attach(self, engine, period: float = 0.002) -> int:
         # idempotent: re-attaching while already pumping (e.g. a stream
@@ -1462,6 +1498,7 @@ class ContinuousDecoder:
         stashed admit outputs (device-complete by now), then this
         round's scan emissions deliver, then retirements fire."""
         self._round_prefill_tokens = 0
+        round_start = time.perf_counter()
         # mid-prefill slots hold a slot but don't decode yet
         active = self._active_np                  # preallocated (hot)
         any_active = False
@@ -1565,6 +1602,12 @@ class ContinuousDecoder:
                         self._deliver(slot, int(emitted[k, slot]), now)
                         delivered += 1
                 self.stats["tokens_decode"] += delivered
+        if scanned or wave_firsts or self._round_prefill_tokens:
+            # working rounds only: idle pump ticks would drag the EWMA
+            # toward the timer period and break the admission estimate
+            elapsed = time.perf_counter() - round_start
+            self._round_ewma = elapsed if self._round_ewma is None \
+                else 0.7 * self._round_ewma + 0.3 * elapsed
         if self.idle and self.on_idle is not None:
             self.on_idle()
 

@@ -322,6 +322,15 @@ class ChaosMessage(Message):
     def connect(self) -> None:
         self.inner.connect()
 
+    def crash(self) -> None:
+        """Abrupt-death passthrough (the soak kills a runtime through
+        its transport, chaos wrapper or not)."""
+        crash = getattr(self.inner, "crash", None)
+        if crash is not None:
+            crash()
+        else:
+            self.inner.disconnect()
+
     def disconnect(self, *args, **kwargs) -> None:
         self.inner.disconnect(*args, **kwargs)
 

@@ -247,6 +247,25 @@ class MQTTMessage(Message):
         self._client.disconnect()
         self._connected_event.clear()
 
+    def crash(self) -> None:
+        """Simulate abrupt process death (tests / chaos soaks): stop
+        the reconnect machinery, then sever the link UNGRACEFULLY so
+        the broker fires this client's LWT.  Loopback clients
+        (transport/paho_loopback.py) expose drop() for the ungraceful
+        cut; against a real paho client the socket is simply abandoned
+        — the broker's keepalive generates the LWT."""
+        with self._lock:
+            self._closing = True
+            if self._reconnect_timer is not None:
+                self._reconnect_timer.cancel()
+                self._reconnect_timer = None
+        drop = getattr(self._client, "drop", None)
+        if drop is not None:
+            drop()
+        else:                               # pragma: no cover — real paho
+            self._client.loop_stop()
+        self._connected_event.clear()
+
     def connected(self) -> bool:
         return self._connected_event.is_set()
 

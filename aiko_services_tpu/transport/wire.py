@@ -52,7 +52,8 @@ __all__ = [
     "MAGIC", "WIRE_VERSION", "WireError", "is_envelope", "contains_binary",
     "encode_envelope", "decode_envelope", "encode_rpc", "supports_binary",
     "WIRE_CODECS", "WIRE_CODEC_DTYPES", "WIRE_CODEC_RANK", "codec_legal",
-    "pop_trace",
+    "pop_trace", "TENANT_MARKER", "tenant_fields", "is_tenant_fields",
+    "parse_tenant", "pop_tenant",
 ]
 
 MAGIC = b"AIKW"
@@ -65,6 +66,13 @@ _MARKER = "__aikb__"
 # it.  The canonical constant lives in observe/tracing.py (which has no
 # transport dependency, so the import cannot cycle).
 _TRACE = TRACE_MARKER
+# Tenant header marker (ISSUE 9): a trailing parameter
+# ["__aikn__", tenant, tier] rides AFTER the trace marker in the
+# envelope header (or appended to sexpr params on text transports) and
+# is stripped back out on decode — the serving-side admission gate
+# (ops/admission.py) charges the frame to the right per-tenant budget,
+# existing RPC consumers never see it.
+TENANT_MARKER = "__aikn__"
 _HEAD = struct.Struct("<BI")            # version, header_len
 _COUNT = struct.Struct("<I")
 _BUFLEN = struct.Struct("<Q")
@@ -271,19 +279,60 @@ def pop_trace(parameters):
     return None
 
 
+def tenant_fields(tenant, tier=1) -> list:
+    """The wire form of a tenant tag: a self-tagged field list, so it
+    can ride as a trailing header parameter OR as a positional hop-entry
+    field without ambiguity against trace fields."""
+    return [TENANT_MARKER, str(tenant), str(int(tier))]
+
+
+def is_tenant_fields(value) -> bool:
+    return isinstance(value, (list, tuple)) and bool(value) and \
+        isinstance(value[0], str) and value[0] == TENANT_MARKER
+
+
+def parse_tenant(fields, default_tier: int = 1):
+    """(tenant, tier) from a tenant field list; ("", default_tier) when
+    absent/malformed — the admission gate folds "" into its default
+    tenant bucket."""
+    if not is_tenant_fields(fields) or len(fields) < 2:
+        return "", int(default_tier)
+    tenant = str(fields[1])
+    try:
+        tier = int(fields[2]) if len(fields) > 2 else int(default_tier)
+    except (TypeError, ValueError):
+        tier = int(default_tier)
+    return tenant, tier
+
+
+def pop_tenant(parameters):
+    """Strip a trailing tenant marker from a decoded parameter list;
+    returns the field list or None.  Must run BEFORE pop_trace: the
+    tenant marker is appended after the trace marker on encode, so it
+    is the last parameter when both are present."""
+    if isinstance(parameters, list) and parameters:
+        if is_tenant_fields(parameters[-1]):
+            return list(parameters.pop())
+    return None
+
+
 def encode_envelope(command: str, parameters=(), codec_hints=None,
-                    trace=None) -> bytes:
+                    trace=None, tenant=None) -> bytes:
     """RPC (command, params) -> one binary envelope payload.
 
     codec_hints: {dict_key: codec_name} — arrays stored under a hinted
     dict key ship through that codec (lossy, opt-in).
     trace: an optional trace-context field list (observe/tracing.py
-    TraceContext.to_fields) carried in the envelope header."""
+    TraceContext.to_fields) carried in the envelope header.
+    tenant: an optional tenant field list (tenant_fields) carried after
+    the trace — the serving admission gate's per-tenant charge tag."""
     buffers: list[memoryview] = []
     extracted = [_extract(p, buffers, codec_hints=codec_hints)
                  for p in parameters]
     if trace:
         extracted.append([str(f) for f in trace])
+    if tenant:
+        extracted.append([str(f) for f in tenant])
     header = generate(command, extracted).encode("utf-8")
     parts = [MAGIC, _HEAD.pack(WIRE_VERSION, len(header)), header,
              _COUNT.pack(len(buffers))]
@@ -340,13 +389,16 @@ def _restore(obj, buffers, payload_nbytes=0):
     return obj
 
 
-def decode_envelope(payload, with_trace: bool = False):
+def decode_envelope(payload, with_trace: bool = False,
+                    with_tenant: bool = False):
     """One binary envelope payload -> (command, params), or
-    (command, params, trace_fields|None) when with_trace=True.
+    (command, params, trace_fields|None) when with_trace=True, or
+    (command, params, trace, tenant_fields|None) when with_tenant=True.
 
     ndarrays come back as read-only views over `payload` (zero-copy);
-    everything else keeps S-expression semantics (strings).  A trace
-    header (see encode_envelope) is always stripped from the params."""
+    everything else keeps S-expression semantics (strings).  Trace and
+    tenant headers (see encode_envelope) are always stripped from the
+    params, whether or not the caller asks for them back."""
     view = memoryview(payload).cast("B")
     if view.nbytes < 4 + _HEAD.size or bytes(view[:4]) != MAGIC:
         raise WireError("not a binary envelope (bad magic / truncated)")
@@ -378,32 +430,40 @@ def decode_envelope(payload, with_trace: bool = False):
     except Exception as exc:
         raise WireError(f"envelope header parse failed: {exc}") from exc
     if isinstance(expr, str):
+        if with_tenant:
+            return expr, [], None, None
         return (expr, [], None) if with_trace else (expr, [])
     if not isinstance(expr, list) or not expr or \
             not isinstance(expr[0], str):
         raise WireError(f"envelope header is not an RPC: {header!r}")
     params = [_restore(p, buffers, view.nbytes) for p in expr[1:]]
+    tenant = pop_tenant(params)         # appended last; strip first
     trace = pop_trace(params)
+    if with_tenant:
+        return expr[0], params, trace, tenant
     if with_trace:
         return expr[0], params, trace
     return expr[0], params
 
 
 def encode_rpc(command: str, parameters=(), transport=None,
-               codec_hints=None, trace=None):
+               codec_hints=None, trace=None, tenant=None):
     """Pick the wire representation for an outbound RPC: the binary
     envelope when the transport can carry bytes AND the params hold
     binary values; S-expression text otherwise (control-plane messages
-    stay human-readable, non-binary transports keep working).  A trace
-    field list rides the envelope header on the binary path and as a
-    trailing marker parameter on the text path — decoders strip it
-    either way (pop_trace)."""
+    stay human-readable, non-binary transports keep working).  Trace
+    and tenant field lists ride the envelope header on the binary path
+    and as trailing marker parameters on the text path — decoders strip
+    them either way (pop_trace / pop_tenant)."""
     if supports_binary(transport) and contains_binary(parameters):
         return encode_envelope(command, parameters,
-                               codec_hints=codec_hints, trace=trace)
+                               codec_hints=codec_hints, trace=trace,
+                               tenant=tenant)
     text_params = [
         p if not _is_arraylike(p) or isinstance(p, (str, int, float, bool))
         else generate_sexpr(np.asarray(p).tolist()) for p in parameters]
     if trace:
         text_params.append([str(f) for f in trace])
+    if tenant:
+        text_params.append([str(f) for f in tenant])
     return generate(command, text_params)

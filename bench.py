@@ -598,7 +598,11 @@ class PipelineBench:
         # the void — the constant-192-lost-frames ladder collapse
         for i in range(n):
             if f"s{i}" not in self.pipeline.streams:
-                self.pipeline.create_stream(f"s{i}", lease_time=0)
+                # tenant tag rides the wire on remote hops (ISSUE 9):
+                # the serving gate's admission counters label by it
+                self.pipeline.create_stream(
+                    f"s{i}", lease_time=0,
+                    parameters={"tenant": "bench", "tier": 1})
 
     def _post(self, stream_id: str) -> None:
         self._post_times[stream_id].append(time.perf_counter())
@@ -841,9 +845,31 @@ class WirePipelineBench(PipelineBench):
                  "output": [{"name": "tokens"}]},
             ],
         })
+        # overload-control plane (ISSUE 9): the serving pipeline runs
+        # behind a LIVE AdmissionGate — its wait estimator reads the
+        # batch scheduler's EWMA+occupancy estimate (estimated_wait),
+        # every frame passes the per-tenant DRR queue (caller streams
+        # are tagged tenant="bench"), and admission_* counters ride the
+        # rung fields.  Shed-early only bites when frames carry an
+        # end-to-end deadline: AIKO_BENCH_WIRE_DEADLINE_S > 0 opts the
+        # caller in (default off, keeping rung comparability with r05).
+        from aiko_services_tpu.ops.admission import AdmissionGate
+
+        def _scheduler_wait():
+            waits = [program.scheduler.estimated_wait()
+                     for program in self.compute.programs.values()
+                     if program.scheduler is not None]
+            waits = [w for w in waits if w is not None]
+            return max(waits) if waits else None
+
+        self.admission = AdmissionGate(
+            inflight_limit=max(4 * batch, 64),
+            metrics_labels={"pipeline": "p_bench_serve"})
+        self.admission.add_wait_estimator(_scheduler_wait)
         self.serving = Pipeline(serve_rt, serving_def,
                                 stream_lease_time=0,
-                                auto_create_streams=True)
+                                auto_create_streams=True,
+                                admission=self.admission)
 
         call_rt = make_rt("bench_call")
         if peer:
@@ -860,13 +886,16 @@ class WirePipelineBench(PipelineBench):
                                        {"name": "p_bench_serve"}}}},
             ],
         })
+        wire_deadline = float(os.environ.get(
+            "AIKO_BENCH_WIRE_DEADLINE_S", "0"))
         self.pipeline = Pipeline(
             call_rt, caller_def, stream_lease_time=0,
             element_classes={
                 "PE_BenchWireSource": make_wire_source(chunk_seconds)},
             services_cache=ServicesCache(call_rt),
             # hops must survive the first-batch device compile
-            remote_timeout=900.0, coalesce_frames=coalesce_frames)
+            remote_timeout=900.0, coalesce_frames=coalesce_frames,
+            frame_deadline=wire_deadline)
         self.pipeline.add_frame_handler(self._on_frame)
 
         self._broker = broker
@@ -916,6 +945,21 @@ class WirePipelineBench(PipelineBench):
             "peer_sent": registry.value("peer_events_total",
                                         {"kind": "sent"}),
             "broker_routed": self._broker.stats["routed"],
+            # overload-control verdicts (ISSUE 9): per-tenant counters
+            # summed across the serving gate's series — shed/rejected
+            # stay 0 unless AIKO_BENCH_WIRE_DEADLINE_S arms shed-early
+            "admitted": sum(
+                m.value for labels, m in registry.series(
+                    "admission_admitted_total")
+                if labels.get("pipeline") == "p_bench_serve"),
+            "shed": sum(
+                m.value for labels, m in registry.series(
+                    "admission_shed_total")
+                if labels.get("pipeline") == "p_bench_serve"),
+            "rejected": sum(
+                m.value for labels, m in registry.series(
+                    "admission_rejected_total")
+                if labels.get("pipeline") == "p_bench_serve"),
         }
 
     def peer_pinned(self) -> bool:
@@ -1741,6 +1785,14 @@ def bench_latency():
             "lat_wire_broker_routed":
                 wire_after["broker_routed"] - wire_before["broker_routed"],
             "lat_wire_peer_pinned": bench.peer_pinned(),
+            # overload-control verdicts this rung (ISSUE 9): the gate
+            # is live on the serving pipeline; shed/rejected are 0
+            # unless AIKO_BENCH_WIRE_DEADLINE_S arms shed-early
+            "lat_wire_admitted":
+                wire_after["admitted"] - wire_before["admitted"],
+            "lat_wire_shed": wire_after["shed"] - wire_before["shed"],
+            "lat_wire_rejected":
+                wire_after["rejected"] - wire_before["rejected"],
             "lat_wire_budget_met": bool(
                 ok and p50 <= LATENCY_BUDGET and n >= 200),
         }

@@ -64,7 +64,9 @@ _TELEMETRY_FAMILIES = (
     "chaos_faults_total", "pipeline_recovery_total",
     "broker_messages_total", "transport_client_messages_total",
     "pipeline_wire_envelopes_total", "pipeline_wire_frames_total",
-    "peer_events_total",
+    "peer_events_total", "autoscaler_decisions_total",
+    "admission_admitted_total", "admission_shed_total",
+    "admission_rejected_total",
 )
 
 
@@ -115,7 +117,8 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
              remote_timeout: float = 1.5, retries: int = 6,
              failure_budget: int = 4, horizon: float = 60.0,
              wav_path: str | None = None, peer: bool = False,
-             peer_kill_at: float | None = None) -> dict:
+             peer_kill_at: float | None = None, mqtt: bool = False,
+             autoscale: bool = False) -> dict:
     """Run the scenario; returns the JSON-able report.
 
     peer=True runs the data plane over registrar-negotiated direct
@@ -124,7 +127,24 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     caller ships mel as i8mel codes, and at `peer_kill_at` (default:
     1.5 s before kill_at) every open peer channel is killed mid-stream
     — traffic must degrade to the broker without losing a frame, then
-    re-negotiate back onto direct channels."""
+    re-negotiate back onto direct channels.
+
+    mqtt=True runs every runtime over MQTTMessage against the loopback
+    paho broker (the test_mqtt envelope-soak plumbing, now
+    transport/paho_loopback.py — the PR 4 follow-up): the full MQTT
+    client code path carries the binary envelopes, the kill fires the
+    victim's LWT through the broker, and chaos applies at the publish
+    edge (ChaosMessage).  Client-edge chaos cannot see recipients, so
+    the partition window is emulated with symmetric sender-scoped drop
+    rules on the data topics.
+
+    autoscale=True brings the serving fleet up through a
+    LifeCycleManager (under a RestartPolicy whose backoff is
+    deliberately LONGER than the soak) and an Autoscaler holding a
+    min_clients=2 floor (ISSUE 9): the mid-run kill drops the fleet
+    below the floor and the AUTOSCALER — not the restart backoff — is
+    what respawns capacity, provably (autoscaler_decisions_total
+    {action=up, reason=below-floor} in the telemetry block)."""
     import numpy as np
 
     from aiko_services_tpu.compute import ComputeRuntime
@@ -136,7 +156,8 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     from aiko_services_tpu.process import ProcessRuntime
     from aiko_services_tpu.registrar import Registrar
     from aiko_services_tpu.share import ServicesCache
-    from aiko_services_tpu.transport.chaos import ChaosBroker, FaultPlan
+    from aiko_services_tpu.transport.chaos import (
+        ChaosBroker, ChaosMessage, FaultPlan)
     from aiko_services_tpu.transport.memory import MemoryMessage
 
     from aiko_services_tpu.observe import default_registry, tracing
@@ -154,16 +175,34 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
                                      _TELEMETRY_FAMILIES)
     engine = EventEngine(VirtualClock())
     plan = FaultPlan(seed)
-    broker = ChaosBroker(plan, engine)
 
-    def make_runtime(name):
-        def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
-            return MemoryMessage(
-                on_message=on_message, broker=broker, lwt_topic=lwt_topic,
-                lwt_payload=lwt_payload, lwt_retain=lwt_retain,
-                client_id=name)
-        return ProcessRuntime(name=name, engine=engine,
-                              transport_factory=factory).initialize()
+    if mqtt:
+        from aiko_services_tpu.transport.mqtt import MQTTMessage
+        from aiko_services_tpu.transport.paho_loopback import (
+            LoopbackBroker, LoopbackPaho)
+        loop_broker = LoopbackBroker()
+
+        def make_runtime(name):
+            def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+                inner = MQTTMessage(
+                    on_message=on_message, lwt_topic=lwt_topic,
+                    lwt_payload=lwt_payload, lwt_retain=lwt_retain,
+                    client_factory=lambda: LoopbackPaho(loop_broker),
+                    backoff_min=0.02, backoff_max=0.1)
+                return ChaosMessage(inner, plan, engine, client_id=name)
+            return ProcessRuntime(name=name, engine=engine,
+                                  transport_factory=factory).initialize()
+    else:
+        broker = ChaosBroker(plan, engine)
+
+        def make_runtime(name):
+            def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+                return MemoryMessage(
+                    on_message=on_message, broker=broker,
+                    lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                    lwt_retain=lwt_retain, client_id=name)
+            return ProcessRuntime(name=name, engine=engine,
+                                  transport_factory=factory).initialize()
 
     own_tmpdir = None
     if wav_path is None:
@@ -179,7 +218,11 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     _settle(engine, 3.0)
 
     servings = []
-    for index in (1, 2):
+    serving_counter = [0]
+
+    def build_serving():
+        serving_counter[0] += 1
+        index = serving_counter[0]
         serve_rt = make_runtime(f"serving{index}")
         if peer:
             serve_rt.enable_peer(fault_plan=plan, jitter_seed=seed)
@@ -189,7 +232,56 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
             parse_pipeline_definition(_serving_definition(
                 f"compute{index}")),
             auto_create_streams=True, stream_lease_time=30.0)
+        if autoscale:
+            # retained snapshots are what the autoscaler watches
+            from aiko_services_tpu.observe.export import MetricsPublisher
+            MetricsPublisher(serve_rt, interval=1.0)
         servings.append((serve_rt, pipeline))
+        return serve_rt
+
+    manager = None
+    autoscaler = None
+    manager_rt = None
+    if autoscale:
+        from aiko_services_tpu.autoscaler import Autoscaler, ScalePolicy
+        from aiko_services_tpu.lifecycle import (
+            LifeCycleClient, LifeCycleManager)
+        from aiko_services_tpu.process_manager import RestartPolicy
+        manager_rt = make_runtime("lcm")
+
+        def spawner(client_id, manager_topic):
+            serve_rt = build_serving()
+            LifeCycleClient(serve_rt, f"serve_client_{client_id}",
+                            manager_topic, client_id)
+            return serve_rt
+
+        manager = LifeCycleManager(
+            manager_rt, "serve_fleet", spawner,
+            # the policy is the crash-loop supervisor of record, but
+            # its backoff is parked beyond the soak horizon: the
+            # AUTOSCALER's below-floor verdict must be what restores
+            # capacity, or the scenario proves nothing about it
+            restart_policy=RestartPolicy(max_restarts=8, window=1e6,
+                                         backoff=10 * horizon,
+                                         jitter=0.0))
+        autoscaler = Autoscaler(
+            manager_rt, manager=manager,
+            # load-driven thresholds parked out of reach: the chaos
+            # window itself inflates hop p95 (a partition IS overload),
+            # and this scenario must isolate the below-floor
+            # restoration path so the report's scale-up provably came
+            # from the kill (the hysteresis/no-flap behaviour has its
+            # own virtual-clock test)
+            policy=ScalePolicy(min_clients=2, max_clients=3,
+                               mailbox_depth_up=1e9, hop_p95_up=1e9,
+                               batch_wait_up=1e9,
+                               hysteresis=3, cooldown=2.0),
+            interval=0.5)
+        manager.create_clients(2)
+        _settle(engine, 3.0)
+    else:
+        for _ in (1, 2):
+            build_serving()
     call_rt = make_runtime("caller")
     if peer:
         call_rt.enable_peer(fault_plan=plan, jitter_seed=seed)
@@ -214,8 +306,21 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
         plan.drop(topic=topic, probability=drop)
         plan.duplicate(topic=topic, probability=1.0, count=duplicates)
         plan.delay(topic=topic, probability=0.2, delay=0.1)
-    plan.partition([["caller"], ["serving*"]],
-                   start=base + partition[0], stop=base + partition[1])
+    if mqtt:
+        # publish-edge chaos never sees recipients, so a group
+        # partition cannot apply: emulate the same window with
+        # symmetric sender-scoped total drops on the data topics
+        for topic in data_topics:
+            plan.drop(topic=topic, sender="caller", probability=1.0,
+                      start=base + partition[0],
+                      stop=base + partition[1])
+            plan.drop(topic=topic, sender="serving*", probability=1.0,
+                      start=base + partition[0],
+                      stop=base + partition[1])
+    else:
+        plan.partition([["caller"], ["serving*"]],
+                       start=base + partition[0],
+                       stop=base + partition[1])
     kill_time = base + kill_at
     # peer scenario: sever every open channel mid-stream — after the
     # partition heals, before the serving-process kill — so the run
@@ -261,8 +366,15 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
         completed = {frame.stream_id for frame in done}
         lost = [sid for sid in posted
                 if sid not in caller.streams and sid not in completed]
-        if next_frame >= frames and \
-                len(completed) + len(lost) >= frames:
+        frames_settled = next_frame >= frames and \
+            len(completed) + len(lost) >= frames
+        # the autoscale scenario must run THROUGH the kill and the
+        # autoscaler's floor restoration, even when every frame settled
+        # early — the respawn is the acceptance, not a side effect
+        capacity_recovered = manager is None or (
+            killed and serving_counter[0] >= 3
+            and manager.ready_count() >= 2)
+        if frames_settled and capacity_recovered:
             break
         engine.clock.advance(0.05)
     _settle(engine, 1.0)
@@ -312,6 +424,15 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
             "serving": {f"serving{i + 1}": rt.peer.info()["stats"]
                         for i, (rt, _) in enumerate(servings)},
         }
+    report["transport"] = "mqtt" if mqtt else "memory"
+    if autoscale:
+        report["autoscaler"] = {
+            "deaths": manager.restart_stats["deaths"],
+            "policy_respawns": manager.restart_stats["respawns"],
+            "clients": len(manager.clients),
+            "ready": manager.ready_count(),
+            "servings_built": serving_counter[0],
+        }
 
     # -- telemetry snapshot (ISSUE 5) ------------------------------------
     metrics_after = _counter_series(default_registry().snapshot(),
@@ -333,15 +454,213 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     # -- teardown (serving1 already crashed; leave its corpse be) --------
     caller.stop()
     call_rt.terminate()
-    servings[1][1].stop()
-    servings[1][0].terminate()
+    if autoscaler is not None:
+        autoscaler.stop()
+    if manager is not None:
+        manager.stop()
+    for index, (serve_rt, pipeline) in enumerate(servings):
+        if index == 0:
+            continue                    # the crashed corpse
+        pipeline.stop()
+        serve_rt.terminate()
     if peer and servings[0][0].peer is not None:
         # the corpse's peer host: channels are dead, but unregister its
         # endpoint so repeated in-process runs don't accumulate entries
         servings[0][0].peer.close()
+    if manager_rt is not None:
+        manager_rt.terminate()
     registrar_rt.terminate()
     if own_tmpdir is not None:
         shutil.rmtree(own_tmpdir, ignore_errors=True)
+    return report
+
+
+def _tenant_counter(registry, family: str, tenant: str) -> int:
+    """Sum one admission counter family across all series of a tenant."""
+    return sum(metric.value
+               for labels, metric in registry.series(family)
+               if labels.get("tenant") == tenant)
+
+
+def run_tenant_soak(seed: int = 11, polite_frames: int = 6,
+                    flood_frames: int = 24,
+                    polite_interval: float = 0.5,
+                    flood_interval: float = 0.02,
+                    service_time: float = 0.15,
+                    inflight_limit: int = 2,
+                    flood_budget: int = 4,
+                    frame_deadline: float = 5.0,
+                    horizon: float = 30.0) -> dict:
+    """Per-tenant fair-queuing acceptance (ISSUE 9): a flooding tenant
+    slams a slow serving pipeline while a polite tenant keeps its
+    steady cadence.  The admission gate's weighted DRR queue must shed
+    ONLY the flooder's overflow (newest-first, within its own budget)
+    while the polite tenant — higher priority tier — keeps a
+    deadline-met fraction of 1.0.  The per-tenant admission_* counters
+    in the report are the proof; deterministic on a VirtualClock."""
+    from aiko_services_tpu.event import EventEngine, VirtualClock
+    from aiko_services_tpu.observe import default_registry
+    from aiko_services_tpu.ops.admission import (
+        AdmissionGate, TenantFairQueue, TenantPolicy)
+    from aiko_services_tpu.pipeline import (
+        DEFERRED, Frame, FrameOutput, Pipeline, PipelineElement,
+        parse_pipeline_definition)
+    from aiko_services_tpu.process import ProcessRuntime
+    from aiko_services_tpu.registrar import Registrar
+    from aiko_services_tpu.share import ServicesCache
+
+    wall_start = time.monotonic()
+    registry = default_registry()
+    before = {
+        (family, tenant): _tenant_counter(registry, family, tenant)
+        for family in ("admission_admitted_total", "admission_shed_total",
+                       "admission_rejected_total")
+        for tenant in ("polite", "flood")}
+    engine = EventEngine(VirtualClock())
+
+    def make_runtime(name):
+        return ProcessRuntime(name=name, engine=engine).initialize()
+
+    class PE_SlowSink(PipelineElement):
+        """Defers every frame for `service_time` virtual seconds — the
+        stand-in for a batched device program, so admitted frames HOLD
+        their inflight credit and the fair queue actually backs up."""
+
+        def process_frame(self, frame: Frame, value=None, **_):
+            pipeline = self.pipeline
+            name = self.definition.name
+            self.runtime.event.add_oneshot_handler(
+                lambda: pipeline.post("resume_frame", frame, name,
+                                      {"echo": value}),
+                service_time)
+            return FrameOutput(True, DEFERRED)
+
+    registrar_rt = make_runtime("registrar")
+    Registrar(registrar_rt)
+    _settle(engine, 3.0)
+
+    serve_rt = make_runtime("tenant_serving")
+    gate = AdmissionGate(
+        queue=TenantFairQueue(
+            policies={
+                "polite": TenantPolicy(weight=1.0, tier=0,
+                                       queue_budget=polite_frames + 2),
+                "flood": TenantPolicy(weight=1.0, tier=1,
+                                      queue_budget=flood_budget),
+            },
+            metrics_labels={"pipeline": "tenant_serve"}),
+        inflight_limit=inflight_limit,
+        metrics_labels={"pipeline": "tenant_serve"})
+    serving = Pipeline(
+        serve_rt, parse_pipeline_definition({
+            "version": 0, "name": "tenant_serve", "runtime": "python",
+            "graph": ["(PE_SlowSink)"],
+            "elements": [
+                {"name": "PE_SlowSink", "input": [{"name": "value"}],
+                 "output": [{"name": "echo"}]},
+            ],
+        }),
+        element_classes={"PE_SlowSink": PE_SlowSink},
+        auto_create_streams=True, stream_lease_time=30.0,
+        admission=gate)
+
+    call_rt = make_runtime("tenant_caller")
+    caller = Pipeline(
+        call_rt, parse_pipeline_definition({
+            "version": 0, "name": "tenant_call", "runtime": "python",
+            "graph": ["(remote_sink)"],
+            "elements": [
+                {"name": "remote_sink", "input": [{"name": "value"}],
+                 "output": [{"name": "echo"}],
+                 "deploy": {"remote": {"service_filter":
+                                       {"name": "tenant_serve"}}}},
+            ],
+        }),
+        services_cache=ServicesCache(call_rt), stream_lease_time=0,
+        frame_deadline=frame_deadline)
+    _settle(engine, 2.0)
+    if not caller.remote_elements_ready():
+        raise RuntimeError("tenant soak: discovery failed")
+
+    base = engine.clock.now()
+    posted: dict[str, float] = {}        # stream_id -> post time
+    completed: dict[str, float] = {}     # stream_id -> completion time
+    caller.add_frame_handler(
+        lambda frame: completed.setdefault(frame.stream_id,
+                                           engine.clock.now()))
+
+    def post(tenant, index, value):
+        stream_id = f"{tenant}-{index}"
+        caller.create_stream(stream_id, lease_time=0,
+                             parameters={"tenant": tenant,
+                                         "tier": 0 if tenant == "polite"
+                                         else 1})
+        caller.post("process_frame", stream_id, {"value": value})
+        posted[stream_id] = engine.clock.now()
+
+    next_polite = next_flood = 0
+    deadline = base + horizon
+    while engine.clock.now() < deadline:
+        now = engine.clock.now() - base
+        while next_flood < flood_frames and \
+                now >= next_flood * flood_interval:
+            post("flood", next_flood, float(next_flood))
+            next_flood += 1
+        while next_polite < polite_frames and \
+                now >= next_polite * polite_interval:
+            post("polite", next_polite, float(next_polite))
+            next_polite += 1
+        while engine.step():
+            pass
+        pending = [sid for sid in posted
+                   if sid not in completed and sid in caller.streams]
+        if next_polite >= polite_frames and \
+                next_flood >= flood_frames and not pending:
+            break
+        engine.clock.advance(0.05)
+    _settle(engine, 1.0)
+
+    def tenant_block(tenant):
+        ids = [sid for sid in posted if sid.startswith(tenant)]
+        met = sum(1 for sid in ids
+                  if sid in completed
+                  and completed[sid] - posted[sid] <= frame_deadline)
+        deltas = {
+            family.split("_")[1]: _tenant_counter(registry, family,
+                                                  tenant)
+            - before[(family, tenant)]
+            for family in ("admission_admitted_total",
+                           "admission_shed_total",
+                           "admission_rejected_total")}
+        return {
+            "posted": len(ids),
+            "completed": sum(1 for sid in ids if sid in completed),
+            "deadline_met_fraction":
+                round(met / len(ids), 4) if ids else 1.0,
+            "admitted": deltas["admitted"],
+            "shed": deltas["shed"],
+            "rejected": deltas["rejected"],
+        }
+
+    report = {
+        "seed": seed,
+        "polite": tenant_block("polite"),
+        "flood": tenant_block("flood"),
+        "serving_recovery": {
+            key: serving.recovery_stats[key]
+            for key in ("admission_shed", "shed_early",
+                        "deadline_rejected")},
+        "queue_depth_final": gate.queue.depth(),
+        "inflight_final": gate.inflight,
+        "virtual_seconds": round(engine.clock.now() - base, 2),
+        "wall_seconds": round(time.monotonic() - wall_start, 2),
+    }
+
+    caller.stop()
+    call_rt.terminate()
+    serving.stop()
+    serve_rt.terminate()
+    registrar_rt.terminate()
     return report
 
 
@@ -363,10 +682,30 @@ def main(argv=None) -> int:
                         help="run the data plane over negotiated peer "
                              "channels (chaos-wrapped), including a "
                              "mid-stream channel kill")
+    parser.add_argument("--mqtt", action="store_true",
+                        help="run every runtime over MQTTMessage "
+                             "against the loopback paho broker (the "
+                             "PR 4 follow-up)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="bring the serving fleet up through "
+                             "LifeCycleManager + Autoscaler: the "
+                             "mid-run kill is repaired by the "
+                             "autoscaler's below-floor verdict")
+    parser.add_argument("--tenants", action="store_true",
+                        help="run the flooding-tenant admission "
+                             "scenario instead of the chaos soak")
     args = parser.parse_args(argv)
+    if args.tenants:
+        report = run_tenant_soak(seed=args.seed)
+        print(json.dumps(report, indent=2))
+        ok = report["polite"]["shed"] == 0 and \
+            report["flood"]["shed"] > 0 and \
+            report["polite"]["deadline_met_fraction"] >= 0.99
+        return 0 if ok else 1
     report = run_soak(seed=args.seed, frames=args.frames, drop=args.drop,
                       retries=args.retries, horizon=args.horizon,
-                      peer=args.peer)
+                      peer=args.peer, mqtt=args.mqtt,
+                      autoscale=args.autoscale)
     print(json.dumps(report, indent=2))
     return 0 if report["frames_lost"] <= args.max_lost else 1
 

@@ -350,6 +350,90 @@ class TestLint:
                   "   # graft: disable=lint-hot-alloc\n")
         assert not lint_source(source, "element.py")
 
+    def test_unbounded_append_in_handler_flagged(self):
+        # the overload rule (ISSUE 9): cross-frame accumulation in an
+        # event context with no visible bound or shed policy
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        self.buffer.append(x)\n")
+        assert ("lint-unbounded-queue", 3) in rules
+
+    def test_bounded_append_exempt(self):
+        # a pop/len/del against the SAME receiver is the shed policy
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        self.buffer.append(x)\n"
+            "        if len(self.buffer) > 64:\n"
+            "            self.buffer.popleft()\n")
+        assert not any(r == "lint-unbounded-queue" for r, _ in rules)
+
+    def test_local_list_append_exempt(self):
+        # a per-call local dies with the call — not a queue
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        chunks = []\n"
+            "        for part in x:\n"
+            "            chunks.append(part)\n"
+            "        return chunks\n")
+        assert not any(r == "lint-unbounded-queue" for r, _ in rules)
+
+    def test_bare_deque_in_handler_flagged(self):
+        rules = self._rules_at(
+            "from collections import deque\n"
+            "class A:\n"
+            "    def _on_msg(self, topic, payload):\n"
+            "        self.ring = deque()\n"
+            "        self.ring.append(payload)\n"
+            "    def setup(self, rt):\n"
+            "        rt.add_message_handler(self._on_msg, 't')\n")
+        assert ("lint-unbounded-queue", 4) in rules
+
+    def test_local_deque_in_handler_exempt(self):
+        # a per-call work-list deque dies with the call — same local
+        # exemption as .append
+        rules = self._rules_at(
+            "from collections import deque\n"
+            "class A:\n"
+            "    def _on_msg(self, topic, payload):\n"
+            "        frontier = deque(payload)\n"
+            "        while frontier:\n"
+            "            frontier.popleft()\n"
+            "    def setup(self, rt):\n"
+            "        rt.add_message_handler(self._on_msg, 't')\n")
+        assert not any(r == "lint-unbounded-queue" for r, _ in rules)
+
+    def test_maxlen_deque_in_handler_exempt(self):
+        rules = self._rules_at(
+            "from collections import deque\n"
+            "class A:\n"
+            "    def _on_msg(self, topic, payload):\n"
+            "        self.ring = deque(maxlen=8)\n"
+            "    def setup(self, rt):\n"
+            "        rt.add_message_handler(self._on_msg, 't')\n")
+        assert not any(r == "lint-unbounded-queue" for r, _ in rules)
+
+    def test_unbounded_queue_outside_event_context_exempt(self):
+        # construction-time accumulators are __init__'s business, not
+        # this rule's: only handler contexts are scanned
+        rules = self._rules_at(
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.items = []\n"
+            "    def helper(self, x):\n"
+            "        self.items.append(x)\n")
+        assert not any(r == "lint-unbounded-queue" for r, _ in rules)
+
+    def test_unbounded_queue_waiver(self):
+        source = ("class PE_X:\n"
+                  "    def process_frame(self, frame, x=None):\n"
+                  "        # audited: drained by _flush"
+                  "  # graft: disable=lint-unbounded-queue\n"
+                  "        self.buffer.append(x)\n")
+        assert not lint_source(source, "element.py")
+
 
 # ---------------------------------------------------------------------------
 # wire codec legality table

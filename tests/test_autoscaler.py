@@ -1,0 +1,239 @@
+# Autoscaler tests (ISSUE 9): signal extraction from retained metrics
+# snapshots, hysteresis (a threshold-straddling load step must NOT flap
+# capacity), cooldown pacing, and floor restoration through a real
+# LifeCycleManager after a mid-run crash — all virtual-clock.
+
+import json
+
+import pytest
+
+from aiko_services_tpu import (
+    Autoscaler, EventEngine, LifeCycleClient, LifeCycleManager,
+    ProcessRuntime, ScalePolicy, VirtualClock)
+from aiko_services_tpu.event import settle_virtual
+from aiko_services_tpu.observe.metrics import default_registry
+
+
+@pytest.fixture()
+def engine():
+    return EventEngine(VirtualClock())
+
+
+def make_runtime(engine, name):
+    return ProcessRuntime(name=name, engine=engine).initialize()
+
+
+class StubManager:
+    """A LifeCycleManager stand-in that just tracks the fleet size."""
+
+    def __init__(self, count=1):
+        self.clients = {str(i): object() for i in range(count)}
+        self._next = count
+        self.actions = []
+
+    def scale_to(self, count):
+        delta = count - len(self.clients)
+        self.actions.append(delta)
+        while len(self.clients) < count:
+            self.clients[str(self._next)] = object()
+            self._next += 1
+        while len(self.clients) > count:
+            self.clients.popitem()
+        return delta
+
+    def ready_count(self):
+        return len(self.clients)
+
+
+def snapshot_payload(topic_path, mailbox=0.0, batch_wait=0.0,
+                     hop_counts=None):
+    snapshot = {}
+    if mailbox:
+        snapshot["event_mailbox_depth"] = {
+            "type": "gauge",
+            "series": [{"labels": {}, "value": mailbox}]}
+    if batch_wait:
+        snapshot["batch_mean_wait_ms"] = {
+            "type": "gauge",
+            "series": [{"labels": {}, "value": batch_wait}]}
+    if hop_counts:
+        bounds = [0.1, 0.5, 2.0]
+        snapshot["pipeline_hop_seconds"] = {
+            "type": "histogram",
+            "series": [{"labels": {}, "bounds": bounds,
+                        "counts": hop_counts,
+                        "sum": 1.0, "count": sum(hop_counts)}]}
+    return json.dumps({"topic_path": topic_path, "snapshot": snapshot})
+
+
+def publish_snapshot(rt, process, **kwargs):
+    topic_path = f"{rt.namespace}/host/{process}"
+    rt.publish(f"{topic_path}/0/metrics",
+               snapshot_payload(topic_path, **kwargs))
+
+
+class TestSignals:
+    def test_worst_case_across_processes_and_families(self, engine):
+        rt = make_runtime(engine, "sig_rt")
+        autoscaler = Autoscaler(rt, name="sig", manager=StubManager(),
+                                interval=1000.0)   # timer parked
+        publish_snapshot(rt, "p1", mailbox=10, batch_wait=5)
+        publish_snapshot(rt, "p2", mailbox=3, batch_wait=40,
+                         hop_counts=[0, 1, 0, 0])
+        settle_virtual(engine, 0.2)
+        signals = autoscaler.signals()
+        assert signals["mailbox_depth"] == 10
+        assert signals["batch_wait"] == 40
+        # p95 of one observation in the (0.1, 0.5] bucket
+        assert signals["hop_p95"] == pytest.approx(0.5)
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_stale_snapshots_stop_voting(self, engine):
+        rt = make_runtime(engine, "stale_rt")
+        autoscaler = Autoscaler(rt, name="stale",
+                                manager=StubManager(), interval=1000.0)
+        publish_snapshot(rt, "p1", mailbox=500)
+        settle_virtual(engine, 0.2)
+        assert autoscaler.signals()["mailbox_depth"] == 500
+        engine.clock.advance(60.0)      # past _SNAPSHOT_HORIZON
+        assert autoscaler.signals()["mailbox_depth"] == 0
+        autoscaler.stop()
+        rt.terminate()
+
+
+class TestHysteresis:
+    def policy(self, **kwargs):
+        defaults = dict(min_clients=1, max_clients=4,
+                        mailbox_depth_up=64.0, mailbox_depth_down=4.0,
+                        hop_p95_up=1e9, batch_wait_up=1e9,
+                        hysteresis=3, cooldown=5.0)
+        defaults.update(kwargs)
+        return ScalePolicy(**defaults)
+
+    def test_sustained_overload_scales_up_once(self, engine):
+        rt = make_runtime(engine, "hys_rt")
+        manager = StubManager(1)
+        autoscaler = Autoscaler(rt, name="hys_up", manager=manager,
+                                policy=self.policy(), interval=1.0)
+        publish_snapshot(rt, "p1", mailbox=200)
+        settle_virtual(engine, 10.0)
+        # hysteresis crossed once; cooldown holds the second step back
+        # until its window passes, then the still-overloaded signal
+        # adds capacity again — no thrash, one step per window
+        assert manager.actions.count(1) >= 1
+        assert all(a >= 0 for a in manager.actions)
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_threshold_straddling_step_does_not_flap(self, engine):
+        """The ISSUE 9 hysteresis acceptance: a load step that lands
+        BETWEEN the up and down thresholds (the dead band) must produce
+        no scale action at all, however long it persists."""
+        rt = make_runtime(engine, "flap_rt")
+        manager = StubManager(2)
+        autoscaler = Autoscaler(rt, name="flap", manager=manager,
+                                policy=self.policy(min_clients=1),
+                                interval=1.0)
+        # mailbox 30: above down (4), below up (64) — the dead band
+        for _ in range(12):
+            publish_snapshot(rt, "p1", mailbox=30)
+            settle_virtual(engine, 1.0)
+        assert manager.actions == []
+        assert len(manager.clients) == 2
+        # and ALTERNATING straddles (one tick hot, one tick ambiguous)
+        # never accumulate a streak either
+        for i in range(12):
+            publish_snapshot(rt, "p1", mailbox=200 if i % 2 else 30)
+            settle_virtual(engine, 1.0)
+        assert manager.actions == []
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_sustained_quiet_scales_down_to_floor(self, engine):
+        rt = make_runtime(engine, "down_rt")
+        manager = StubManager(3)
+        autoscaler = Autoscaler(rt, name="down", manager=manager,
+                                policy=self.policy(cooldown=1.5),
+                                interval=1.0)
+        publish_snapshot(rt, "p1", mailbox=1)      # below every down
+        settle_virtual(engine, 20.0)
+        assert len(manager.clients) == 1           # at min_clients
+        # every action was a single downward step
+        assert all(a == -1 for a in manager.actions)
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_down_step_never_undershoots_the_floor(self, engine):
+        """A step larger than the headroom above min_clients must clamp
+        to the floor — undershooting would trip the below-floor respawn
+        next tick and flap forever."""
+        rt = make_runtime(engine, "step_rt")
+        manager = StubManager(3)
+        autoscaler = Autoscaler(
+            rt, name="step", manager=manager,
+            policy=self.policy(min_clients=2, cooldown=1.5, step=2),
+            interval=1.0)
+        publish_snapshot(rt, "p1", mailbox=1)      # quiet
+        settle_virtual(engine, 20.0)
+        assert len(manager.clients) == 2           # clamped at the floor
+        assert manager.actions == [-1]             # one partial step
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_decisions_are_counted(self, engine):
+        registry = default_registry()
+
+        def up_count():
+            return sum(m.value for labels, m in registry.series(
+                "autoscaler_decisions_total")
+                if labels.get("autoscaler") == "cnt"
+                and labels.get("action") == "up")
+
+        rt = make_runtime(engine, "cnt_rt")
+        manager = StubManager(1)
+        before = up_count()
+        autoscaler = Autoscaler(rt, name="cnt", manager=manager,
+                                policy=self.policy(), interval=1.0)
+        publish_snapshot(rt, "p1", mailbox=200)
+        settle_virtual(engine, 4.0)
+        assert up_count() - before >= 1
+        autoscaler.stop()
+        rt.terminate()
+
+
+class TestFloorRestoration:
+    def test_crash_respawns_through_lifecycle_manager(self, engine):
+        """A serving client crashes (LWT); the autoscaler's below-floor
+        verdict — not a restart backoff — restores the fleet through
+        LifeCycleManager.scale_to."""
+        manager_rt = make_runtime(engine, "floor_mgr")
+        spawned = {}
+
+        def spawner(client_id, manager_topic):
+            rt = make_runtime(engine, f"floor_w{client_id}")
+            LifeCycleClient(rt, f"floor_client_{client_id}",
+                            manager_topic, client_id)
+            spawned[client_id] = rt
+            return rt
+
+        manager = LifeCycleManager(manager_rt, "floor_lcm", spawner)
+        autoscaler = Autoscaler(
+            manager_rt, name="floor", manager=manager,
+            policy=ScalePolicy(min_clients=2, max_clients=3,
+                               mailbox_depth_up=1e9, hop_p95_up=1e9,
+                               batch_wait_up=1e9, cooldown=1.0),
+            interval=0.5)
+        manager.create_clients(2)
+        settle_virtual(engine, 3.0)
+        assert manager.ready_count() == 2
+
+        first = sorted(spawned)[0]
+        spawned[first].message.crash()         # LWT fires
+        settle_virtual(engine, 4.0)
+        # the dead client was purged AND replaced via scale_to
+        assert manager.ready_count() == 2
+        assert len(spawned) == 3
+        autoscaler.stop()
+        manager.stop()
+        manager_rt.terminate()

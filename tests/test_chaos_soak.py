@@ -77,3 +77,47 @@ def test_chaos_soak_peer_data_plane():
     # leak checks hold on the peer path too
     assert report["pending_hops"] == 0
     assert report["leaked_hop_leases"] == 0
+
+
+def test_chaos_soak_mqtt_autoscale():
+    # ISSUE 9 capstone (and the PR 4 --mqtt follow-up): the same
+    # scenario over MQTTMessage/LoopbackPaho with the serving fleet
+    # behind LifeCycleManager + Autoscaler.  The mid-run kill fires the
+    # victim's LWT through the broker; the restart policy's backoff is
+    # parked beyond the horizon, so the AUTOSCALER's below-floor
+    # verdict is what respawns capacity — and zero admitted frames are
+    # lost across the repair.
+    report = run_soak(seed=11, frames=6, horizon=40.0, mqtt=True,
+                      autoscale=True)
+
+    assert report["transport"] == "mqtt"
+    assert report["frames_sent"] == 6
+    assert report["frames_lost"] == 0, report
+    assert report["frames_recovered"] == 6
+    assert report["texts_returned"] == 6
+
+    # the kill registered as a fleet death and a THIRD serving runtime
+    # was built to restore the floor — by the autoscaler, not the
+    # (deliberately parked) restart policy
+    scaler = report["autoscaler"]
+    assert scaler["deaths"] == 1
+    assert scaler["policy_respawns"] == 0
+    assert scaler["servings_built"] == 3
+    assert scaler["ready"] == 2
+
+    # the scale decision is itself observable: exactly the below-floor
+    # verdict fired in this run's telemetry delta
+    ups = {key: value
+           for key, value in report["telemetry"]["metrics"].items()
+           if key.startswith("autoscaler_decisions_total")
+           and "action=up" in key}
+    assert sum(ups.values()) >= 1
+    assert any("reason=below-floor" in key for key in ups)
+
+    # chaos really applied over the MQTT path, and recovery absorbed it
+    assert sum(report["faults_injected"].values()) > 0
+    assert report["caller_recovery"]["retries"] > 0
+
+    # leak checks hold over MQTT too
+    assert report["pending_hops"] == 0
+    assert report["leaked_hop_leases"] == 0

@@ -301,6 +301,40 @@ def test_recorder_ring_limit(make_runtime, engine):
     assert recorder.tail(topic, 99) == ["6", "7", "8", "9"]
 
 
+def test_recorder_captures_remote_metrics_snapshots(make_runtime,
+                                                    engine):
+    """The PR 5 follow-up (ISSUE 9 satellite): the Recorder tails the
+    retained {topic_path}/0/metrics snapshots MetricsPublisher emits —
+    remote processes' registries become browsable pages, ring-bounded
+    per topic."""
+    import json
+
+    rt = make_runtime("recm_host").initialize()
+    recorder = Recorder(rt, metrics_ring_limit=2)
+    settle(engine, 2)
+
+    topic_path = f"{rt.namespace}/host/77-0"
+    metrics_topic = f"{topic_path}/0/metrics"
+    for tick in range(3):
+        rt.publish(metrics_topic, json.dumps({
+            "process": "p77", "topic_path": topic_path, "time": tick,
+            "snapshot": {"event_mailbox_depth": {
+                "type": "gauge",
+                "series": [{"labels": {}, "value": tick}]}}}))
+    rt.publish(metrics_topic, "not json")      # must not wedge the ring
+    settle(engine, 6)
+
+    assert recorder.metrics_topics() == [metrics_topic]
+    assert recorder.ec_producer.get("metrics_topic_count") == 1
+    page = recorder.metrics_tail(metrics_topic)
+    assert len(page) == 1
+    assert page[0]["process"] == "p77"
+    assert page[0]["time"] == 2                # the latest snapshot
+    # ring bound honoured: only the last 2 of 3 survive
+    assert [doc["time"]
+            for doc in recorder.metrics_tail(metrics_topic, 99)] == [1, 2]
+
+
 # -- storage -----------------------------------------------------------------
 
 def test_storage_put_get_roundtrip(make_runtime, engine):

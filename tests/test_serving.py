@@ -902,3 +902,39 @@ def test_fused_projections_match_oracle(params):
     assert len(done) == len(prompts)
     for rid, prompt in prompts.items():
         assert done[rid] == oracle(params, prompt, 10), rid
+
+
+def test_deadline_admission_sheds_doomed_request(params):
+    """Deadline-aware admission (ISSUE 9): a request whose first-token
+    deadline cannot survive the estimated admit wait is refused at
+    submit — no callback, counted — while an open-deadline request and
+    a comfortable one are admitted."""
+    import time as _time
+
+    decoder = ContinuousDecoder(params, CONFIG, max_slots=2,
+                                prefill_buckets=(16,), steps_per_sync=4)
+    called = []
+    # cold decoder: no round EWMA yet, so admission must NOT shed even
+    # against an absurd deadline (no number to shed on)
+    assert decoder.estimated_admit_wait() is None
+    assert decoder.submit("r0", [3, 5], 4, called.append,
+                          deadline=_time.monotonic() - 1.0)
+    # simulate a measured round and a backlog: the estimate scales with
+    # the pending queue's share of the slot pool
+    decoder._round_ewma = 0.5
+    for i in range(4):
+        decoder.submit(f"fill{i}", [7], 4, called.append)
+    wait = decoder.estimated_admit_wait()
+    assert wait is not None and wait > 0.5
+    # doomed: deadline inside the estimated wait -> refused, counted
+    shed_before = decoder.stats["admission_shed"]
+    assert decoder.submit("doomed", [9], 4, called.append,
+                          deadline=_time.monotonic() + 0.01) is False
+    assert decoder.stats["admission_shed"] == shed_before + 1
+    assert len(decoder._pending) == 5          # the refusal never queued
+    # comfortable deadline and no deadline both admit
+    assert decoder.submit("fine", [9], 4, called.append,
+                          deadline=_time.monotonic() + 60.0)
+    assert decoder.submit("open", [9], 4, called.append)
+    assert len(decoder._pending) == 7
+    assert called == []                        # refusals never call back
