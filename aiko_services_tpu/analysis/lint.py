@@ -41,6 +41,17 @@
 #                         levelled, routable, and exportable — stdout
 #                         is none of those (CLIs and deliberate console
 #                         tools carry per-line waivers)
+#   lint-linear-timer     remove_timer_handler called with a HANDLER
+#                         FUNCTION instead of a handle: removal by
+#                         identity is a linear scan over every
+#                         outstanding timer — O(n) per cancel at
+#                         session cardinality, exactly the pattern the
+#                         timer wheel (state/wheel.py) exists to kill.
+#                         Keep the handle add_*_handler returned and
+#                         cancel by it (O(1) on the wheel).  The
+#                         sparse periodic-handler heap keeps the
+#                         identity path for reference parity; its one
+#                         internal scan carries a waiver
 #   lint-unbounded-queue  accumulation in message/event-handler
 #                         contexts with no visible bound or shed
 #                         policy: a bare deque() (no maxlen) built in a
@@ -73,7 +84,7 @@ __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
-              "lint-print", "lint-unbounded-queue")
+              "lint-print", "lint-unbounded-queue", "lint-linear-timer")
 
 # evidence that an accumulation target is bounded or shed within the
 # same function: any of these appearing against the SAME receiver text
@@ -303,6 +314,18 @@ class _Linter(ast.NodeVisitor):
                 "raw threading.Lock: use aiko_services_tpu.utils.Lock "
                 "(named holder, misuse errors, AIKO_LOCK_CHECK "
                 "lock-order cycle detection)")
+        if _func_tail(node.func) == "remove_timer_handler" and node.args:
+            arg_tail = _func_tail(node.args[0])
+            if arg_tail and arg_tail in self.handler_names:
+                self.report(
+                    "lint-linear-timer", node,
+                    f"remove_timer_handler({arg_tail}) cancels by "
+                    f"HANDLER IDENTITY — a linear scan over every "
+                    f"outstanding timer (O(n) at session cardinality): "
+                    f"keep the handle add_*_handler returned and cancel "
+                    f"by it (O(1) on the timer wheel); the sparse "
+                    f"periodic heap's internal scan is the one waived "
+                    f"exception")
         if self.lock_depth > 0 and \
                 _func_tail(node.func) in ("publish", "route"):
             self.report(

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from .observe.metrics import default_registry
+from .state.wheel import TimerWheel
 
 __all__ = [
     "Clock", "RealClock", "VirtualClock", "EventEngine", "default_engine",
@@ -134,7 +135,17 @@ class EventEngine:
         self.clock = clock or RealClock()
         self._lock = threading.RLock()
         self._seq = itertools.count()
-        self._timers: list[_Timer] = []          # heap
+        # ONESHOT timers (leases, hop/handshake timeouts — the
+        # session-cardinality population) ride the hashed timer wheel:
+        # O(1) schedule/cancel/advance (ISSUE 10).  The heap remains
+        # only for the sparse PERIODIC handlers (metrics publishers,
+        # admission drains, snapshot ticks — tens per process).
+        self._wheel = TimerWheel(self.clock.now(), tick=_TICK)
+        # handles cancelled while their expiry batch is in flight this
+        # step (the wheel has already surrendered them); cleared per
+        # step, so the set stays bounded by one batch
+        self._step_cancelled: set[int] = set()
+        self._timers: list[_Timer] = []          # heap: periodic only
         self._timer_handles: dict[int, _Timer] = {}
         self._mailboxes: dict[str, _Mailbox] = {}
         self._queues: dict[str, _Mailbox] = {}
@@ -144,9 +155,22 @@ class EventEngine:
         self._wake = threading.Event()
 
     # -- handler bookkeeping ----------------------------------------------
+    def live_timer_handlers(self) -> list:
+        """Callables of every LIVE timer — periodic (heap) and oneshot
+        (wheel).  The leak-audit surface: a cancelled timer never
+        appears here, so 'no Lease-owned handler left' is exactly 'no
+        lease can ever fire again' (the chaos soak and the lease
+        lifecycle tests assert over this instead of poking the stores)."""
+        with self._lock:
+            handlers = [t.handler for t in self._timer_handles.values()
+                        if not t.cancelled]
+            handlers.extend(e.payload for e in self._wheel.entries())
+            return handlers
+
     def _handler_count(self) -> int:
         with self._lock:
-            return (len(self._timer_handles) + len(self._mailboxes)
+            return (len(self._timer_handles) + len(self._wheel)
+                    + len(self._mailboxes)
                     + len(self._queues) + len(self._flatout))
 
     # -- timers -----------------------------------------------------------
@@ -163,11 +187,13 @@ class EventEngine:
             return seq
 
     def add_oneshot_handler(self, handler, delay: float) -> int:
+        """Schedule handler() once after `delay` seconds.  Oneshots are
+        wheel-backed: schedule and cancel are O(1) however many are
+        outstanding — Lease and every hop timeout ride this."""
         with self._lock:
             seq = next(self._seq)
-            timer = _Timer(self.clock.now() + delay, seq, handler, 0.0)
-            heapq.heappush(self._timers, timer)
-            self._timer_handles[seq] = timer
+            self._wheel.schedule(self.clock.now() + delay, handler,
+                                 handle=seq)
             self._wake.set()
             return seq
 
@@ -177,12 +203,24 @@ class EventEngine:
                 timer = self._timer_handles.pop(handle_or_handler, None)
                 if timer:
                     timer.cancelled = True
+                elif not self._wheel.cancel(handle_or_handler):
+                    # maybe in the currently-firing batch: suppress it
+                    # there (heap parity: cancel before fire always
+                    # sticks, even from a handler in the same step)
+                    self._step_cancelled.add(handle_or_handler)
                 return
-            # compatibility: remove all timers with this handler function
+            # compatibility: remove all timers with this handler
+            # function — a LINEAR scan over both stores, kept only for
+            # parity with the reference API.  Per-frame/per-session
+            # code must cancel by handle (lint-linear-timer polices
+            # this).  graft: disable=lint-linear-timer
             for seq, timer in list(self._timer_handles.items()):
                 if timer.handler == handle_or_handler:
                     timer.cancelled = True
                     del self._timer_handles[seq]
+            for entry in self._wheel.entries():
+                if entry.payload == handle_or_handler:
+                    self._wheel.cancel(entry.handle)
 
     def reset_timer(self, handle: int) -> None:
         """Restart a periodic timer's countdown from now."""
@@ -276,7 +314,23 @@ class EventEngine:
         worked = False
         now = self.clock.now()
 
-        # due timers (all that are due, in order)
+        # due ONESHOTS off the wheel first (tick order; batch collected
+        # under the lock, delivered outside it).  A handler in the
+        # batch may cancel a LATER entry of the same batch — the wheel
+        # has already surrendered those, so the cancel lands in
+        # _step_cancelled and is honoured here (heap parity: a timer
+        # never fires after its cancel).
+        with self._lock:
+            due_oneshots = self._wheel.advance(now)
+            self._step_cancelled.clear()
+        for entry in due_oneshots:
+            with self._lock:
+                if entry.handle in self._step_cancelled:
+                    continue
+            self._guard(entry.payload)
+            worked = True
+
+        # due PERIODIC timers (all that are due, in order)
         while True:
             with self._lock:
                 if not self._timers or self._timers[0].due > now:
@@ -338,7 +392,13 @@ class EventEngine:
         with self._lock:
             while self._timers and self._timers[0].cancelled:
                 heapq.heappop(self._timers)
-            return self._timers[0].due if self._timers else None
+            heap_due = self._timers[0].due if self._timers else None
+            wheel_due = self._wheel.next_due()
+        if heap_due is None:
+            return wheel_due
+        if wheel_due is None:
+            return heap_due
+        return min(heap_due, wheel_due)
 
     def loop(self, loop_when_no_handlers: bool = False) -> None:
         self._running = True

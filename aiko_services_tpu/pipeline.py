@@ -534,6 +534,13 @@ _RETIRED_HOP_CAP = 2048     # recently settled hop ids (reply dedup)
 _SERVED_HOP_CAP = 1024      # serving-side request dedup + reply replay
 _SERVED_REPLY_CACHE_BYTES = 1 << 18   # replies above this aren't cached
 _SERVED_REPLY_BUDGET_BYTES = 8 << 20  # aggregate pin across ALL entries
+# per-tenant sub-budget (ISSUE 10): one flooding tenant's replies must
+# not evict every other tenant's replay capacity — a TAGGED tenant over
+# this pin demotes ITS OWN oldest replies to dedup-only first, before
+# the aggregate budget touches anyone else's.  Untagged traffic ("")
+# is exempt: it has no neighbours to be fair to, and capping it would
+# silently shrink the PR 4 aggregate semantics for untenanted serving.
+_SERVED_REPLY_TENANT_BUDGET_BYTES = 2 << 20
 
 
 def _payload_nbytes(value) -> int:
@@ -676,6 +683,7 @@ class Pipeline(PipelineElement):
         self._retired_hops: dict[str, bool] = {}    # reply dedup ring
         self._served_hops: dict = {}    # (reply_topic, hop_id) -> reply
         self._served_reply_bytes = 0    # aggregate pinned reply payload
+        self._served_reply_tenant_bytes: dict[str, int] = {}
         # remote-hop wire tuning: coalesce_frames bounds how many frames
         # one envelope may carry (1 disables); codec hints opt named
         # swag keys into lossy wire codecs (transport/wire.py)
@@ -1030,6 +1038,11 @@ class Pipeline(PipelineElement):
         frame.deferred_at = None
         frame.metrics[f"time_{node.name}"] = \
             time.perf_counter() - frame.deferred_since
+        # the deferred element's span covers park → resume (the wait IS
+        # where the frame's budget went: batch formation + device time)
+        self._record_call_span(node_name, frame, frame.deferred_since,
+                               frame.metrics[f"time_{node.name}"],
+                               deferred=True)
         if isinstance(outputs, Exception):
             self._fail_frame(frame, node.name, repr(outputs))
             return FrameOutput(False,
@@ -1039,6 +1052,23 @@ class Pipeline(PipelineElement):
                                 outputs, frame.swag)
         with tracing.activate(frame.trace):
             return self._walk(frame, index + 1)
+
+    def _record_call_span(self, node_name: str, frame: Frame,
+                          started: float, duration: float,
+                          deferred: bool = False) -> None:
+        """Per-element span under the frame's trace (ISSUE 10 satellite
+        closing the PR 5 follow-up): Perfetto dumps show where a
+        frame's budget went element by element, not just per hop."""
+        trc = tracing.tracer
+        if not trc.enabled or frame.trace is None:
+            return
+        args = {"stream": frame.stream.stream_id,
+                "frame": frame.frame_id}
+        if deferred:
+            args["deferred"] = True
+        trc.record(f"call:{node_name}", started, duration,
+                   context=frame.trace, cat="element", proc=self.name,
+                   span_id=tracing.new_span_id(), args=args)
 
     def _walk(self, frame: Frame, start_index: int) -> FrameOutput:
         swag = frame.swag
@@ -1084,6 +1114,8 @@ class Pipeline(PipelineElement):
                 return FrameOutput(True, DEFERRED)
             frame.metrics[f"time_{node.name}"] = \
                 time.perf_counter() - element_start
+            self._record_call_span(node.name, frame, element_start,
+                                   frame.metrics[f"time_{node.name}"])
             if not ok:
                 diagnostic = diagnostic or "element reported not-ok"
                 self._fail_frame(frame, node.name, diagnostic)
@@ -1647,7 +1679,9 @@ class Pipeline(PipelineElement):
                           if v is not None), None)
             if stale is None:
                 break
-            self._served_reply_bytes -= self._served_hops.pop(stale)[3]
+            evicted = self._served_hops.pop(stale)
+            self._served_reply_bytes -= evicted[3]
+            self._credit_tenant_reply_bytes(evicted[4], evicted[3])
         if context is not None and context.expired(now):
             # the failure reply is cached in the dedup ring, so a
             # duplicate of this dead request replays the verdict
@@ -1744,29 +1778,61 @@ class Pipeline(PipelineElement):
                      frame_id=-1, reply_to=key)
         self._send_remote_reply(shim, False, {"diagnostic": diagnostic})
 
-    def _cache_served_reply(self, key, kind, topic, data) -> None:
-        """Pin a completed reply for duplicate replay, under an
-        AGGREGATE byte budget as well as the per-entry size cap: when
-        the total pinned payload would exceed
-        _SERVED_REPLY_BUDGET_BYTES, the oldest cached replies are
-        demoted to 'uncached' (still dedup-recognized as completed,
-        just no longer replayable) — 1024 entries of just-under-cap
-        image replies must not pin a quarter gigabyte."""
+    def _cache_served_reply(self, key, kind, topic, data,
+                            tenant: str = "") -> None:
+        """Pin a completed reply for duplicate replay, under THREE
+        bounds: the per-entry size cap, the caller tenant's sub-budget
+        (_SERVED_REPLY_TENANT_BUDGET_BYTES — a tagged tenant over it
+        demotes its OWN oldest replies first, so a flooder cannot evict
+        the polite tenants' replay capacity; ISSUE 10), and the
+        aggregate _SERVED_REPLY_BUDGET_BYTES pin.  Demotion is always
+        to 'uncached' — still dedup-recognized as completed, just no
+        longer replayable — 1024 entries of just-under-cap image
+        replies must not pin a quarter gigabyte."""
         nbytes = _payload_nbytes(data)
-        self._served_hops[key] = (kind, topic, data, nbytes)
+        self._served_hops[key] = (kind, topic, data, nbytes, tenant)
         self._served_reply_bytes += nbytes
+        if nbytes and tenant:
+            self._served_reply_tenant_bytes[tenant] = \
+                self._served_reply_tenant_bytes.get(tenant, 0) + nbytes
+            while self._served_reply_tenant_bytes.get(tenant, 0) > \
+                    _SERVED_REPLY_TENANT_BUDGET_BYTES:
+                if not self._demote_oldest_reply(key, tenant=tenant):
+                    break
         while self._served_reply_bytes > _SERVED_REPLY_BUDGET_BYTES:
-            stale = next((k for k, v in self._served_hops.items()
-                          if v is not None and v[3] and k != key), None)
-            if stale is None:
+            if not self._demote_oldest_reply(key):
                 break
-            _, stale_topic, _, stale_nbytes = self._served_hops[stale]
-            self._served_hops[stale] = ("uncached", stale_topic, None, 0)
-            self._served_reply_bytes -= stale_nbytes
+
+    def _demote_oldest_reply(self, keep_key, tenant: str | None = None) \
+            -> bool:
+        """Demote the oldest pinned reply (of `tenant`, or of anyone)
+        to dedup-only; returns False when nothing is left to demote."""
+        stale = next(
+            (k for k, v in self._served_hops.items()
+             if v is not None and v[3] and k != keep_key
+             and (tenant is None or v[4] == tenant)), None)
+        if stale is None:
+            return False
+        _, stale_topic, _, stale_nbytes, stale_tenant = \
+            self._served_hops[stale]
+        self._served_hops[stale] = \
+            ("uncached", stale_topic, None, 0, stale_tenant)
+        self._served_reply_bytes -= stale_nbytes
+        self._credit_tenant_reply_bytes(stale_tenant, stale_nbytes)
+        return True
+
+    def _credit_tenant_reply_bytes(self, tenant: str, nbytes: int) -> None:
+        if not tenant or not nbytes:
+            return
+        remaining = self._served_reply_tenant_bytes.get(tenant, 0) - nbytes
+        if remaining > 0:
+            self._served_reply_tenant_bytes[tenant] = remaining
+        else:
+            self._served_reply_tenant_bytes.pop(tenant, None)
 
     def _replay_reply(self, cached) -> None:
         """Re-send a cached reply for a duplicate of a completed hop."""
-        kind, topic, data, _ = cached
+        kind, topic, data = cached[0], cached[1], cached[2]
         if kind == "uncached":
             self.logger.warning(
                 "pipeline %s: duplicate of a completed hop whose reply "
@@ -1832,6 +1898,10 @@ class Pipeline(PipelineElement):
     def _send_remote_reply(self, frame, ok: bool, outputs: dict) -> None:
         import numpy as _np
         topic, hop_id = frame.reply_to
+        # the caller stream's tenant tag (stamped into auto-created
+        # stream parameters by _serve_walk) keys the reply replay
+        # cache's per-tenant sub-budget
+        tenant = str(frame.stream.parameters.get("tenant", "") or "")
         trc = tracing.tracer
         if trc.enabled and frame.trace is not None:
             # the serving-side "process" span: walk start → reply out
@@ -1877,12 +1947,14 @@ class Pipeline(PipelineElement):
             entry = [hop_id, bool(ok), payload, elided]
             if key in self._served_hops:
                 if _payload_nbytes(payload) <= _SERVED_REPLY_CACHE_BYTES:
-                    self._cache_served_reply(key, "bin", topic, entry)
+                    self._cache_served_reply(key, "bin", topic, entry,
+                                             tenant=tenant)
                 else:
                     # completed, but too heavy to pin for replay: a
                     # duplicate request is still recognized (never
                     # re-walked), it just can't be answered again
-                    self._served_hops[key] = ("uncached", topic, None, 0)
+                    self._served_hops[key] = \
+                        ("uncached", topic, None, 0, tenant)
             self._reply_buffer.setdefault(topic, []).append(entry)
             if not self._reply_flush_scheduled:
                 self._reply_flush_scheduled = True
@@ -1896,7 +1968,8 @@ class Pipeline(PipelineElement):
                 if isinstance(v, (str, int, float, bool))}
         text = generate("resume_remote_frame", [hop_id, ok, safe, elided])
         if key in self._served_hops:
-            self._cache_served_reply(key, "text", topic, text)
+            self._cache_served_reply(key, "text", topic, text,
+                                     tenant=tenant)
         self.runtime.publish(topic, text)
 
     def _flush_replies(self) -> None:

@@ -80,6 +80,19 @@ class ECProducer:
         self.service = service
         self.runtime = service.runtime
         self.share = share if share is not None else {}
+        # Maintained flattened view (ISSUE 10 satellite): the producer
+        # used to call _flatten(self.share) — a full dict rebuild — on
+        # EVERY get/update existence check and again per consumer sync,
+        # an O(n)-per-operation pattern that collapses at session
+        # cardinality (1e5 keys × a sync storm = 1e10 key visits).
+        # The view is updated incrementally on update/remove (O(1) per
+        # leaf; O(branch) only when a whole top-level branch is
+        # replaced or removed), so a sync is O(items shipped) and a
+        # get/update is O(1).  Invariant: all mutations go through
+        # update()/remove() (the remote command path already does) —
+        # writing producer.share[...] directly was never part of the
+        # API and now additionally bypasses delta publication.
+        self._flat = _flatten(self.share)
         self._handlers = []       # handler(command, name, value)
         # response_topic → {"lease": Lease, "filter": ...}
         self._consumers: dict[str, dict] = {}
@@ -88,23 +101,42 @@ class ECProducer:
 
     # -- local API ---------------------------------------------------------
     def get(self, name: str, default=None):
-        flat = _flatten(self.share)
-        if name in flat:
-            return flat[name]
+        if name in self._flat:
+            return self._flat[name]
         return self.share.get(name, default)
 
     def update(self, name: str, value) -> None:
-        exists = name in _flatten(self.share) or name in self.share
+        exists = name in self._flat or name in self.share
+        self._flat_forget(name)
         _set_path(self.share, name, value)
+        if "." not in name and isinstance(value, dict):
+            for sub, leaf in value.items():
+                self._flat[f"{name}.{sub}"] = leaf
+        else:
+            self._flat[name] = value
         command = "update" if exists else "add"
         self._notify(command, name, value)
 
     def remove(self, name: str) -> None:
+        self._flat_forget(name)
         _del_path(self.share, name)
         self._notify("remove", name, None)
 
+    def _flat_forget(self, name: str) -> None:
+        """Drop `name`'s current leaves from the flat view, BEFORE the
+        backing dict changes (a replaced top-level branch enumerates
+        its old keys from the share, not by scanning the view)."""
+        if "." in name:
+            self._flat.pop(name, None)
+            return
+        old = self.share.get(name)
+        if isinstance(old, dict):
+            for sub in old:
+                self._flat.pop(f"{name}.{sub}", None)
+        self._flat.pop(name, None)
+
     def keys(self):
-        return list(_flatten(self.share).keys())
+        return list(self._flat.keys())
 
     def add_handler(self, handler) -> None:
         self._handlers.append(handler)
@@ -157,7 +189,7 @@ class ECProducer:
         self._consumers.pop(response_topic, None)
 
     def _synchronize(self, response_topic, item_filter) -> None:
-        items = [(k, v) for k, v in _flatten(self.share).items()
+        items = [(k, v) for k, v in self._flat.items()
                  if filter_matches_item(item_filter, k)]
         publish = self.runtime.publish
         publish(response_topic, generate("item_count", [str(len(items))]))
@@ -230,6 +262,18 @@ class ECConsumer:
         self._handlers = []       # handler(command, item_name, value)
         self._expected = None
         self._lease = None
+        # share-request dedup (ISSUE 10 satellite): a reconnect flap
+        # storm — N connection transitions inside one lease window —
+        # must hold ONE outstanding share request, not N.  Each request
+        # makes the producer replay the full filtered snapshot; N
+        # requests at session cardinality is an N×n item storm.  The
+        # outstanding flag clears on the sync marker (the snapshot
+        # completed) or on a timeout (the producer died mid-snapshot;
+        # the next lease extension re-requests).
+        self.stats = {"share_requests": 0, "share_requests_deduped": 0}
+        self._request_outstanding = False
+        self._request_timer = None
+        self._was_connected = False
         self.response_topic = (f"{runtime.topic_path}/0/ec/"
                                f"{next(_consumer_counter)}")
         runtime.add_message_handler(self._consumer_handler,
@@ -237,14 +281,31 @@ class ECConsumer:
         runtime.connection.add_handler(self._connection_handler)
 
     def _connection_handler(self, _connection, state) -> None:
-        if state >= ConnectionState.TRANSPORT and self._lease is None:
+        if state < ConnectionState.TRANSPORT:
+            # transport lost: the NEXT recovery resynchronizes (once)
+            self._was_connected = False
+            return
+        if self._lease is None:
             self._lease = Lease(
                 self.runtime.event, self.lease_time, self.response_topic,
                 lease_extend_handler=lambda *_: self._share_request(),
                 automatic_extend=True)
             self._share_request()
+        elif not self._was_connected:
+            # reconnect: the producer may have expired our lease while
+            # we were gone — resync, deduped across flap storms
+            self._share_request()
+        self._was_connected = True
 
     def _share_request(self) -> None:
+        if self._request_outstanding:
+            self.stats["share_requests_deduped"] += 1
+            return
+        self._request_outstanding = True
+        timeout = max(1.0, min(self.lease_time * 0.4, 30.0))
+        self._request_timer = self.runtime.event.add_oneshot_handler(
+            self._request_expired, timeout)
+        self.stats["share_requests"] += 1
         item_filter = self.item_filter
         params = [self.response_topic, str(int(self.lease_time))]
         if isinstance(item_filter, (list, tuple)):
@@ -253,6 +314,18 @@ class ECConsumer:
             params.append(item_filter)
         self.runtime.publish(self.producer_topic_control,
                              generate("share", params))
+
+    def _request_expired(self) -> None:
+        # no sync marker arrived inside the window: stop holding the
+        # dedup gate shut so the next extend/reconnect can re-request
+        self._request_timer = None
+        self._request_outstanding = False
+
+    def _request_settled(self) -> None:
+        self._request_outstanding = False
+        if self._request_timer is not None:
+            self.runtime.event.remove_timer_handler(self._request_timer)
+            self._request_timer = None
 
     def _consumer_handler(self, _topic, payload) -> None:
         try:
@@ -272,6 +345,7 @@ class ECConsumer:
             # by per-publisher FIFO, immune to interleaved live deltas
             # (counting adds is not — they decrement the count early)
             self._expected = None
+            self._request_settled()
             if not self.synchronized:
                 self.synchronized = True
                 self._fire("sync", None, None)
@@ -286,6 +360,8 @@ class ECConsumer:
     def terminate(self) -> None:
         if self._lease:
             self._lease.terminate()
+        self._request_settled()
+        self.runtime.connection.remove_handler(self._connection_handler)
         self.runtime.remove_message_handler(self._consumer_handler,
                                             self.response_topic)
 

@@ -1,0 +1,44 @@
+# Session-cardinality state plane (ISSUE 10).
+#
+# The reference framework's eventual-consistency state and lease/timer
+# machinery are sized for tens of services; the ROADMAP north star is
+# millions of user sessions.  This package holds the pieces that make
+# state O(1)-per-operation at 1e5-1e6 cardinality:
+#
+#   fsm.py      — the declarative StateMachine (moved from the old
+#                 top-level state.py; re-exported here so
+#                 `from .state import StateMachine` keeps working)
+#   wheel.py    — hierarchical hashed timer wheel (Varghese & Lauck,
+#                 SOSP '87): O(1) schedule/cancel/advance.  event.py
+#                 backs every oneshot/lease timer with one; the heap
+#                 remains only for sparse periodic handlers.
+#   sessions.py — SessionTable: (tenant, session_id)-keyed sessions,
+#                 hash-sharded across per-shard ECProducer topics,
+#                 wheel-backed lease expiry with batch callbacks, and
+#                 per-tenant byte budgets with demote-to-dedup-only
+#                 shedding.
+#   loadgen.py  — the open-loop session load generator (seeded Poisson
+#                 arrivals, tenant mix, create/touch/expire lifecycle)
+#                 that proves the table flat across 1k → 100k rungs.
+
+from .fsm import StateMachine, StateMachineError            # noqa: F401
+from .wheel import TimerWheel                               # noqa: F401
+
+__all__ = [
+    "StateMachine", "StateMachineError", "TimerWheel",
+    "SessionTable", "SessionView", "TenantBudget", "session_shard",
+]
+
+_SESSION_NAMES = ("SessionTable", "SessionView", "TenantBudget",
+                  "session_shard")
+
+
+def __getattr__(name):
+    # sessions.py pulls in the share layer; event.py imports THIS
+    # package for the wheel — loading sessions lazily keeps that import
+    # edge acyclic (event → state.wheel only, never state → share →
+    # ... → event at import time)
+    if name in _SESSION_NAMES:
+        from . import sessions
+        return getattr(sessions, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

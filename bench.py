@@ -1860,6 +1860,42 @@ def _hbm_in_use() -> str:
         return "n/a"
 
 
+def bench_state():
+    """Session state plane rung (ISSUE 10): the open-loop session load
+    generator across cardinality rungs — pure control plane (no
+    device), so it runs identically on the TPU host and the CPU smoke.
+    lat_state_p95_flat is the headline verdict: handler p95 must not
+    grow a knee as live-session cardinality steps 1k → 100k."""
+    from aiko_services_tpu.state.loadgen import (LoadConfig,
+                                                 run_session_load)
+    rungs = tuple(int(r) for r in os.environ.get(
+        "AIKO_BENCH_STATE_RUNGS", "1000,10000,100000").split(",") if r)
+    report = run_session_load(LoadConfig(rungs=rungs))
+    first, last = report["rungs"][0], report["rungs"][-1]
+    return {
+        "lat_state_rungs": list(rungs),
+        "lat_state_sustained_sessions": report["sustained_sessions"],
+        "lat_state_peak_sessions": last["peak_sessions"],
+        "lat_state_sessions_per_s": last["sessions_per_wall_s"],
+        "lat_state_ops_per_s": last["ops_per_wall_s"],
+        "lat_state_handler_p95_ms": last["handler_p95_ms"],
+        "lat_state_handler_p95_ms_first": first["handler_p95_ms"],
+        "lat_state_handler_mean_us": last["handler_mean_us"],
+        "lat_state_handler_mean_us_first": first["handler_mean_us"],
+        "lat_state_p95_ratio": report["flat"]["p95_ratio"],
+        "lat_state_p95_flat": report["flat"]["ok"],
+        "lat_state_lease_churn_per_s":
+            last["lease_churn_per_virtual_s"],
+        "lat_state_delta_bytes": last["delta_bytes"],
+        "lat_state_max_expiry_batch": last["max_expiry_batch"],
+        "lat_state_budgets_enforced": report["budgets"]["ok"],
+        "lat_state_shed": report["budgets"]["flood_shed"],
+        "lat_state_demoted": report["budgets"]["flood_demoted"],
+        "lat_state_leaked_timers": report["drain"]["leaked_timers"],
+        "lat_state_ok": report["ok"],
+    }
+
+
 def main() -> None:
     debug = "--debug" in sys.argv
     if debug:
@@ -1975,6 +2011,15 @@ def main() -> None:
         latency = {}
         print(f"latency bench failed: {exc!r}", file=sys.stderr)
 
+    # session state plane: control-plane only (no device buffers to
+    # collide with the sections around it)
+    try:
+        state_fields = bench_state()
+        print(f"state plane: {state_fields}", file=sys.stderr)
+    except Exception as exc:
+        state_fields = {}
+        print(f"state bench failed: {exc!r}", file=sys.stderr)
+
     # independent sections run after the headline: a stalled section
     # must not discard the already-measured ASR numbers — report
     # without its fields instead
@@ -2062,8 +2107,8 @@ def main() -> None:
             1),
     }) | ({} if detect_mfu is None else {
         "detect_mfu": round(detect_mfu, 4),
-    }) | {k: v for k, v in latency.items()
-          if k != "latency_budget_met"} | llama))
+    }) | state_fields | {k: v for k, v in latency.items()
+                         if k != "latency_budget_met"} | llama))
 
 
 if __name__ == "__main__":

@@ -384,9 +384,9 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     lost = [sid for sid in posted
             if sid not in caller.streams and sid not in completed]
     leaked_hop_leases = 0
-    for timer in list(engine._timer_handles.values()):
-        owner = getattr(timer.handler, "__self__", None)
-        if isinstance(owner, Lease) and not timer.cancelled and \
+    for handler in engine.live_timer_handlers():
+        owner = getattr(handler, "__self__", None)
+        if isinstance(owner, Lease) and \
                 str(owner.lease_id).startswith("chaos_call."):
             leaked_hop_leases += 1
     serving_stats = {

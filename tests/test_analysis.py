@@ -434,6 +434,38 @@ class TestLint:
                   "        self.buffer.append(x)\n")
         assert not lint_source(source, "element.py")
 
+    # -- lint-linear-timer (ISSUE 10) -------------------------------------
+    def test_remove_by_handler_identity_flagged(self):
+        # cancelling by the FUNCTION is a linear scan over every
+        # outstanding timer — keep the handle
+        rules = self._rules_at(
+            "class A:\n"
+            "    def setup(self, rt):\n"
+            "        rt.event.add_timer_handler(self._tick, 1.0)\n"
+            "    def stop(self, rt):\n"
+            "        rt.event.remove_timer_handler(self._tick)\n")
+        assert ("lint-linear-timer", 5) in rules
+
+    def test_remove_by_handle_exempt(self):
+        rules = self._rules_at(
+            "class A:\n"
+            "    def setup(self, rt):\n"
+            "        self._timer = rt.event.add_timer_handler(\n"
+            "            self._tick, 1.0)\n"
+            "    def stop(self, rt):\n"
+            "        rt.event.remove_timer_handler(self._timer)\n")
+        assert not any(r == "lint-linear-timer" for r, _ in rules)
+
+    def test_linear_timer_waiver(self):
+        source = ("class A:\n"
+                  "    def setup(self, rt):\n"
+                  "        rt.event.add_oneshot_handler(self._fire, 1.0)\n"
+                  "    def stop(self, rt):\n"
+                  "        # graft: disable=lint-linear-timer\n"
+                  "        rt.event.remove_timer_handler(self._fire)\n")
+        assert not any(f.rule == "lint-linear-timer"
+                       for f in lint_source(source, "element.py"))
+
 
 # ---------------------------------------------------------------------------
 # wire codec legality table

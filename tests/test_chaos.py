@@ -445,9 +445,9 @@ class TestRemoteRecovery:
         settle(engine, 8.0)
         assert not caller._pending_remote
         assert "s1" not in caller.streams
-        for timer in engine._timer_handles.values():
-            owner = getattr(timer.handler, "__self__", None)
-            assert not (isinstance(owner, Lease) and not timer.cancelled
+        for handler in engine.live_timer_handlers():
+            owner = getattr(handler, "__self__", None)
+            assert not (isinstance(owner, Lease)
                         and str(owner.lease_id).startswith("call_pipe.")), \
                 f"leaked hop lease {owner.lease_id}"
 
@@ -466,9 +466,9 @@ class TestRemoteRecovery:
         assert caller._pending_remote
         caller.destroy_stream("s1")
         assert not caller._pending_remote
-        for timer in engine._timer_handles.values():
-            owner = getattr(timer.handler, "__self__", None)
-            assert not (isinstance(owner, Lease) and not timer.cancelled
+        for handler in engine.live_timer_handlers():
+            owner = getattr(handler, "__self__", None)
+            assert not (isinstance(owner, Lease)
                         and str(owner.lease_id).startswith("call_pipe."))
         settle(engine, 6.0)                      # nothing blows up later
         assert caller.recovery_stats["retries"] == 0

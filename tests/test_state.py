@@ -1,0 +1,528 @@
+# Session state plane (ISSUE 10): the hashed timer wheel at
+# cardinality, the wheel-backed event-engine oneshots, the sharded
+# SessionTable with per-tenant budgets, the consumer-side view over
+# real EC wire traffic, the share-layer flat-cache + request-dedup
+# satellites, the per-tenant reply replay budgets, and the per-element
+# walk spans.
+
+import json
+import random
+
+import pytest
+
+from aiko_services_tpu.connection import ConnectionState
+from aiko_services_tpu.event import (EventEngine, VirtualClock,
+                                     settle_virtual)
+from aiko_services_tpu.lease import Lease
+from aiko_services_tpu.service import Service
+from aiko_services_tpu.share import ECConsumer, ECProducer
+from aiko_services_tpu.state import SessionTable, SessionView, \
+    TenantBudget, TimerWheel, session_shard
+from aiko_services_tpu.state.sessions import DEMOTED
+from aiko_services_tpu.utils import generate
+
+
+def make_engine():
+    return EventEngine(VirtualClock())
+
+
+# ---------------------------------------------------------------------------
+# TimerWheel
+# ---------------------------------------------------------------------------
+
+class TestTimerWheel:
+    def test_50k_leases_expire_in_order_within_tick(self):
+        """Property at cardinality: 50k wheel-scheduled dues over a
+        minute of virtual time expire in due order within one tick of
+        tolerance, none early, none lost."""
+        wheel = TimerWheel(0.0, tick=0.01)
+        rng = random.Random(17)
+        dues = {}
+        for i in range(50_000):
+            due = rng.uniform(0.0, 60.0)
+            handle = wheel.schedule(due, i)
+            dues[handle] = due
+        assert len(wheel) == 50_000
+        fired = []
+        now = 0.0
+        while now <= 61.0:
+            for entry in wheel.advance(now):
+                assert entry.due <= now          # never early
+                fired.append(entry.handle)
+            now += 0.05
+        assert len(fired) == 50_000
+        assert len(wheel) == 0
+        previous = -1.0
+        for handle in fired:
+            assert dues[handle] >= previous - 0.05, \
+                f"out of order beyond tick tolerance at {handle}"
+            previous = max(previous, dues[handle])
+
+    def test_cancel_is_o1_no_scan(self):
+        """cancel() is a dict pop — the slot is untouched (lazy
+        deletion), and the cancelled entry never fires."""
+        wheel = TimerWheel(0.0, tick=0.01)
+        handles = [wheel.schedule(5.0 + (i % 100) * 0.01, i)
+                   for i in range(20_000)]
+        # the slot buckets keep their (now dead) references after
+        # cancel: the entry map alone defines liveness
+        bucket_sizes = [sum(len(b) for b in level)
+                        for level in wheel._slots]
+        for handle in handles[::2]:
+            assert wheel.cancel(handle)
+        assert len(wheel) == 10_000
+        assert [sum(len(b) for b in level)
+                for level in wheel._slots] == bucket_sizes
+        assert not wheel.cancel(handles[0])      # already cancelled
+        fired = [e.payload for e in wheel.advance(10.0)]
+        assert len(fired) == 10_000
+        assert all(i % 2 == 1 for i in fired)
+
+    def test_cascade_across_levels(self):
+        """Dues beyond level 0's span (2.56 s at 10 ms ticks) cascade
+        down and fire on time; a due beyond level 1 (~11 min) too."""
+        wheel = TimerWheel(0.0, tick=0.01)
+        fired = []
+        wheel.schedule(1.0, "near")
+        wheel.schedule(30.0, "mid")             # level 1
+        wheel.schedule(1000.0, "far")           # level 2
+        for t in (0.5, 1.0, 15.0, 30.0, 500.0, 1000.0):
+            fired.extend((t, e.payload) for e in wheel.advance(t))
+        assert fired == [(1.0, "near"), (30.0, "mid"), (1000.0, "far")]
+        assert len(wheel) == 0
+
+    def test_past_due_fires_next_advance_without_clock_movement(self):
+        wheel = TimerWheel(0.0, tick=0.01)
+        wheel.advance(10.0)
+        wheel.schedule(3.0, "overdue")          # already in the past
+        assert [e.payload for e in wheel.advance(10.0)] == ["overdue"]
+
+
+class TestEngineOneshotOnWheel:
+    def test_oneshots_bypass_the_heap(self):
+        """The heap holds ONLY periodic handlers now: scheduling 1000
+        oneshots leaves it empty, and handle cancel goes through the
+        wheel's O(1) path."""
+        engine = make_engine()
+        handles = [engine.add_oneshot_handler(lambda: None, 1.0)
+                   for _ in range(1000)]
+        assert engine._timers == []
+        assert len(engine._wheel) == 1000
+        for handle in handles:
+            engine.remove_timer_handler(handle)
+        assert len(engine._wheel) == 0
+        engine.add_timer_handler(lambda: None, 1.0)     # periodic: heap
+        assert len(engine._timers) == 1
+
+    def test_settle_virtual_drives_wheel_deterministically(self):
+        """Two identical engines replay an identical fire sequence
+        through settle_virtual — the wheel adds no hidden state."""
+        sequences = []
+        for _ in range(2):
+            engine = make_engine()
+            fired = []
+            rng = random.Random(23)
+            for i in range(500):
+                delay = rng.uniform(0.0, 3.0)
+                engine.add_oneshot_handler(
+                    (lambda i=i: fired.append(
+                        (i, round(engine.clock.now(), 4)))), delay)
+            settle_virtual(engine, 3.5)
+            sequences.append(fired)
+        assert sequences[0] == sequences[1]
+        assert len(sequences[0]) == 500
+
+    def test_cancel_during_expiry_batch_suppresses(self):
+        """Heap parity: a handler cancelling a later timer of the SAME
+        expiry batch prevents it from firing."""
+        engine = make_engine()
+        fired = []
+        h2 = []
+        engine.add_oneshot_handler(
+            lambda: (fired.append("a"),
+                     engine.remove_timer_handler(h2[0])), 0.1)
+        h2.append(engine.add_oneshot_handler(lambda: fired.append("b"),
+                                             0.2))
+        engine.clock.advance(1.0)
+        engine.step()
+        assert fired == ["a"]
+
+    def test_lease_rides_the_wheel(self):
+        engine = make_engine()
+        expired = []
+        lease = Lease(engine, 1.0, "x",
+                      lease_expired_handler=expired.append)
+        assert len(engine._wheel) == 1 and engine._timers == []
+        lease.extend()
+        settle_virtual(engine, 0.9)
+        assert not expired
+        settle_virtual(engine, 1.5)
+        assert expired == ["x"]
+        assert len(engine._wheel) == 0
+        lease2 = Lease(engine, 1.0, "y",
+                       lease_expired_handler=expired.append)
+        lease2.cancel()
+        settle_virtual(engine, 2.0)
+        assert expired == ["x"]
+        assert len(engine._wheel) == 0
+
+
+# ---------------------------------------------------------------------------
+# share-layer satellites: flat cache + share-request dedup
+# ---------------------------------------------------------------------------
+
+class TestProducerFlatCache:
+    def test_flat_view_tracks_mutations(self, make_runtime, engine):
+        runtime = make_runtime("flat_host").initialize()
+        service = Service(runtime, "flat_svc")
+        producer = ECProducer(service, {"a": 1, "b": {"c": 2, "d": 3}})
+        assert producer.get("b.c") == 2
+        assert sorted(producer.keys()) == ["a", "b.c", "b.d"]
+        producer.update("b.e", 4)
+        assert producer.get("b.e") == 4
+        producer.update("a", {"x": 9})          # scalar → branch
+        assert producer.get("a.x") == 9
+        assert "a" not in producer._flat
+        producer.update("a", 7)                 # branch → scalar
+        assert producer.get("a") == 7
+        assert "a.x" not in producer._flat
+        producer.remove("b")                    # whole-branch removal
+        assert sorted(producer.keys()) == ["a"]
+        from aiko_services_tpu.share import _flatten
+        assert producer._flat == _flatten(producer.share)
+
+    def test_snapshot_served_from_cache(self, make_runtime, engine):
+        """_synchronize ships the maintained view — the consumer sees
+        exactly the flat items, no re-flatten drift."""
+        runtime = make_runtime("sync_host").initialize()
+        service = Service(runtime, "sync_svc")
+        producer = ECProducer(service, {"t1": {"s1": "a", "s2": "b"},
+                                        "t2": {"s9": "c"}})
+        cache = {}
+        ECConsumer(runtime, cache, service.topic_control,
+                   item_filter="t1")
+        settle_virtual(engine, 0.5)
+        assert cache == {"t1.s1": "a", "t1.s2": "b"}
+
+
+class TestConsumerRequestDedup:
+    def test_flap_storm_holds_one_outstanding_request(
+            self, make_runtime, engine):
+        runtime = make_runtime("flap_host").initialize()
+        service = Service(runtime, "flap_svc")
+        ECProducer(service, {"k": 1})
+        requests = []
+        runtime.add_message_handler(
+            lambda _t, payload: requests.append(payload),
+            service.topic_control)
+        consumer = ECConsumer(runtime, {}, service.topic_control,
+                              lease_time=10.0)
+        settle_virtual(engine, 0.5)             # join + snapshot + sync
+        assert len(requests) == 1
+        assert consumer.synchronized
+        # N reconnect flaps inside one lease window: ONE request until
+        # its sync lands
+        for _ in range(5):
+            runtime.connection.update(ConnectionState.NONE)
+            runtime.connection.update(ConnectionState.TRANSPORT)
+        assert consumer.stats["share_requests"] == 2
+        assert consumer.stats["share_requests_deduped"] == 4
+        settle_virtual(engine, 0.5)             # sync settles the gate
+        assert len(requests) == 2
+        runtime.connection.update(ConnectionState.NONE)
+        runtime.connection.update(ConnectionState.TRANSPORT)
+        assert consumer.stats["share_requests"] == 3
+        settle_virtual(engine, 0.5)
+        assert len(requests) == 3               # next reconnect: one more
+
+    def test_lost_sync_unwedges_after_timeout(self, make_runtime,
+                                              engine):
+        runtime = make_runtime("wedge_host").initialize()
+        consumer = ECConsumer(runtime, {}, "aiko/nowhere/1/control",
+                              lease_time=10.0)
+        settle_virtual(engine, 0.5)
+        assert consumer._request_outstanding    # no producer, no sync
+        settle_virtual(engine, 5.0)             # > 0.4 * lease
+        assert not consumer._request_outstanding
+
+
+# ---------------------------------------------------------------------------
+# SessionTable + SessionView
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def table_system(make_runtime, engine):
+    runtime = make_runtime("state_host").initialize()
+    view_runtime = make_runtime("state_viewer").initialize()
+    service = Service(runtime, "session_table")
+    return runtime, view_runtime, service, engine
+
+
+class TestSessionTable:
+    def test_lifecycle_and_expiry_batches(self, table_system):
+        runtime, _, service, engine = table_system
+        batches = []
+        table = SessionTable(service, num_shards=4, lease_time=2.0,
+                             on_expired=batches.append)
+        for i in range(40):
+            assert table.create("t", f"s{i}", {"n": i})
+        assert len(table) == 40
+        assert table.get("t", "s3") == {"n": 3}
+        settle_virtual(engine, 1.0)
+        table.touch("t", "s0")                  # extends past the rest
+        settle_virtual(engine, 1.5)             # 39 lapse, s0 survives
+        assert len(table) == 1
+        assert table.get("t", "s0") is not None
+        assert sum(len(b) for b in batches) == 39
+        settle_virtual(engine, 2.5)
+        assert len(table) == 0
+        assert table.stats["expired"] == 40
+        assert table.outstanding_timers() == 0
+        table.stop()
+
+    def test_sharding_is_stable_and_spread(self):
+        shards = [session_shard("tenant", f"s{i}", 8)
+                  for i in range(1000)]
+        assert session_shard("tenant", "s1", 8) == shards[1]
+        assert len(set(shards)) == 8            # all shards hit
+
+    def test_view_follows_table_through_real_wire(self, table_system):
+        runtime, view_runtime, service, engine = table_system
+        table = SessionTable(service, num_shards=4, lease_time=3.0)
+        table.create("polite", "s1", "hello")
+        table.create("noisy", "n1", "spam")
+        view = SessionView(view_runtime, service.topic_path, 4,
+                           tenants="polite")
+        settle_virtual(engine, 0.5)
+        assert view.synchronized
+        assert view.get("polite", "s1") == "hello"
+        assert view.get("noisy", "n1") is None  # filtered out
+        table.create("polite", "s2", "world")   # live delta
+        settle_virtual(engine, 0.2)
+        assert view.get("polite", "s2") == "world"
+        table.remove("polite", "s1")
+        settle_virtual(engine, 0.2)
+        assert view.get("polite", "s1") is None
+        view.terminate()
+        table.stop()
+
+    def test_tenant_budgets_shed_and_demote(self, table_system):
+        runtime, _, service, engine = table_system
+        table = SessionTable(
+            service, num_shards=2, lease_time=5.0,
+            budgets={"flood": TenantBudget(max_sessions=10,
+                                           max_bytes=200)})
+        payload = "x" * 50
+        for i in range(30):
+            table.create("flood", f"f{i}", payload)
+            table.create("polite", f"p{i}", payload)
+        # count budget: only 10 flood sessions admitted, polite intact
+        assert table.tenant_sessions("flood") == 10
+        assert table.tenant_sessions("polite") == 30
+        assert table.stats["shed"] == 20
+        # byte budget: oldest flood sessions demoted to dedup-only
+        assert table.stats["demoted"] >= 6
+        assert table.tenant_bytes("flood") <= 200
+        assert table.get("flood", "f0") is None         # payload gone
+        assert table.tenant_sessions("flood") == 10     # key retained
+        # demoted sessions revive on update — once there's headroom
+        # (reviving while still at the cap would just re-demote the
+        # oldest non-demoted session, which IS f0)
+        table.remove("flood", "f9")
+        assert table.update("flood", "f0", "y")
+        assert table.get("flood", "f0") == "y"
+        assert table.tenant_bytes("polite") == 30 * 50  # untouched
+        table.stop()
+
+    def test_demotion_visible_to_consumers(self, table_system):
+        runtime, view_runtime, service, engine = table_system
+        table = SessionTable(
+            service, num_shards=2, lease_time=5.0,
+            budgets={"f": TenantBudget(max_bytes=120)})
+        view = SessionView(view_runtime, service.topic_path, 2,
+                           tenants="f")
+        table.create("f", "s1", "a" * 100)
+        table.create("f", "s2", "b" * 100)      # pushes s1 over
+        settle_virtual(engine, 0.3)
+        assert view.get("f", "s1") == DEMOTED
+        assert view.get("f", "s2") == "b" * 100
+        view.terminate()
+        table.stop()
+
+    def test_compacted_snapshot_heals_consumer(self, table_system):
+        runtime, view_runtime, service, engine = table_system
+        table = SessionTable(service, num_shards=1, lease_time=30.0,
+                             snapshot_interval=2.0)
+        view = SessionView(view_runtime, service.topic_path, 1,
+                           tenants="*")
+        table.create("t", "s1", "v1")
+        settle_virtual(engine, 0.3)
+        assert view.get("t", "s1") == "v1"
+        del view.cache["t.s1"]                  # simulate a lost delta
+        table.create("t", "s2", "v2")           # dirties the shard
+        settle_virtual(engine, 2.5)             # snapshot interval
+        assert view.get("t", "s1") == "v1"      # healed by compaction
+        view.terminate()
+        table.stop()
+
+    def test_drain_leaves_no_timers_anywhere(self, table_system):
+        runtime, view_runtime, service, engine = table_system
+        table = SessionTable(service, num_shards=4, lease_time=1.0)
+        view = SessionView(view_runtime, service.topic_path, 4)
+        for i in range(50):
+            table.create("t", f"s{i}", "p")
+            table.touch("t", f"s{i}")
+        settle_virtual(engine, 3.0)
+        assert len(table) == 0
+        assert table.outstanding_timers() == 0
+        view.terminate()
+        table.stop()
+        settle_virtual(engine, 0.2)
+        assert len(engine._wheel) == 0
+        assert not engine._timer_handles
+
+    def test_bad_keys_rejected(self, table_system):
+        runtime, _, service, engine = table_system
+        table = SessionTable(service, num_shards=1)
+        with pytest.raises(ValueError):
+            table.create("a.b", "s1")
+        with pytest.raises(ValueError):
+            table.create("t", "s/1")
+        table.stop()
+
+
+# ---------------------------------------------------------------------------
+# load generator (small rungs — the full 1k→100k smoke is
+# scripts/session_load.py; this guards the harness itself)
+# ---------------------------------------------------------------------------
+
+def test_session_load_small_rungs():
+    from aiko_services_tpu.state.loadgen import (LoadConfig,
+                                                 run_session_load)
+    report = run_session_load(LoadConfig(
+        rungs=(200, 1500), lease_time=8.0, seed=5))
+    assert report["ok"], report
+    assert report["sustained_sessions"] >= 1500
+    assert report["drain"] == {"leaked_sessions": 0,
+                               "leaked_timers": 0, "ok": True}
+    assert report["budgets"]["flood_shed"] > 0
+    assert report["budgets"]["flood_demoted"] > 0
+    assert report["budgets"]["polite_shed"] == 0
+    last = report["rungs"][-1]
+    assert last["view_deltas"] > 0
+    assert last["delta_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# per-tenant reply replay budget (pipeline satellite)
+# ---------------------------------------------------------------------------
+
+class TestTenantReplayBudget:
+    def test_flooding_tenant_demotes_its_own_replies_only(
+            self, make_runtime, monkeypatch):
+        import numpy as np
+        from aiko_services_tpu import pipeline as pipeline_module
+        from aiko_services_tpu.pipeline import (Pipeline,
+                                                parse_pipeline_definition)
+        monkeypatch.setattr(pipeline_module,
+                            "_SERVED_REPLY_TENANT_BUDGET_BYTES", 1024)
+        runtime = make_runtime("replay_host").initialize()
+        definition = parse_pipeline_definition({
+            "version": 0, "name": "p_replay", "runtime": "python",
+            "graph": ["(PE_1)"],
+            "elements": [{"name": "PE_1",
+                          "input": [{"name": "number", "type": "int"}],
+                          "output": [{"name": "a", "type": "int"}]}],
+        })
+        serving = Pipeline(runtime, definition, stream_lease_time=0)
+        payload = np.zeros(100, dtype=np.float32)       # 400 B pinned
+        for n in range(4):
+            key = ("aiko/t", f"f{n}")
+            serving._served_hops[key] = None
+            serving._cache_served_reply(
+                key, "bin", "aiko/t", [f"f{n}", True, {"x": payload}, []],
+                tenant="flood")
+        polite_key = ("aiko/t", "p0")
+        serving._served_hops[polite_key] = None
+        serving._cache_served_reply(
+            polite_key, "bin", "aiko/t", ["p0", True, {"x": payload}, []],
+            tenant="polite")
+        kinds = [serving._served_hops[("aiko/t", f"f{n}")][0]
+                 for n in range(4)]
+        # flood demoted ITS OWN oldest replies; polite is untouched
+        assert kinds == ["uncached", "uncached", "bin", "bin"]
+        assert serving._served_hops[polite_key][0] == "bin"
+        assert serving._served_reply_tenant_bytes["flood"] <= 1024
+        assert serving._served_reply_tenant_bytes["polite"] == 400
+
+    def test_untagged_traffic_keeps_aggregate_semantics(
+            self, make_runtime, monkeypatch):
+        """Tenantless replies are exempt from the sub-budget — the PR 4
+        aggregate pin is their only bound."""
+        import numpy as np
+        from aiko_services_tpu import pipeline as pipeline_module
+        from aiko_services_tpu.pipeline import (Pipeline,
+                                                parse_pipeline_definition)
+        monkeypatch.setattr(pipeline_module,
+                            "_SERVED_REPLY_TENANT_BUDGET_BYTES", 256)
+        runtime = make_runtime("replay_host2").initialize()
+        definition = parse_pipeline_definition({
+            "version": 0, "name": "p_replay2", "runtime": "python",
+            "graph": ["(PE_1)"],
+            "elements": [{"name": "PE_1",
+                          "input": [{"name": "number", "type": "int"}],
+                          "output": [{"name": "a", "type": "int"}]}],
+        })
+        serving = Pipeline(runtime, definition, stream_lease_time=0)
+        payload = np.zeros(100, dtype=np.float32)
+        for n in range(3):
+            key = ("aiko/t", f"u{n}")
+            serving._served_hops[key] = None
+            serving._cache_served_reply(
+                key, "bin", "aiko/t", [f"u{n}", True, {"x": payload}, []])
+        kinds = [serving._served_hops[("aiko/t", f"u{n}")][0]
+                 for n in range(3)]
+        assert kinds == ["bin", "bin", "bin"]
+
+
+# ---------------------------------------------------------------------------
+# per-element walk spans (PR 5 follow-up satellite)
+# ---------------------------------------------------------------------------
+
+def test_walk_records_per_element_spans(make_runtime):
+    from aiko_services_tpu.observe import tracing
+    from aiko_services_tpu.pipeline import (Pipeline,
+                                            parse_pipeline_definition)
+    tracer = tracing.tracer
+    was_enabled = tracer.enabled
+    tracer.enable()
+    tracer.clear()
+    try:
+        runtime = make_runtime("span_host").initialize()
+        definition = parse_pipeline_definition(json.loads(json.dumps({
+            "version": 0, "name": "p_spans", "runtime": "python",
+            "graph": ["(PE_1 PE_2)"],
+            "parameters": {},
+            "elements": [
+                {"name": "PE_1",
+                 "input": [{"name": "number", "type": "int"}],
+                 "output": [{"name": "a", "type": "int"}]},
+                {"name": "PE_2",
+                 "input": [{"name": "a", "type": "int"}],
+                 "output": [{"name": "b", "type": "int"}]},
+            ]})))
+        pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+        pipeline.create_stream("s1", lease_time=0)
+        result = pipeline.process_frame("s1", {"number": 1})
+        assert result.ok
+        spans = [s for s in tracer.spans if s.name.startswith("call:")]
+        assert {s.name for s in spans} == {"call:PE_1", "call:PE_2"}
+        trace_ids = {s.trace_id for s in spans}
+        assert len(trace_ids) == 1 and "" not in trace_ids
+        assert all(s.cat == "element" and s.proc == "p_spans"
+                   for s in spans)
+        assert all(s.args["stream"] == "s1" for s in spans)
+    finally:
+        tracer.clear()
+        if not was_enabled:
+            tracer.disable()
