@@ -52,6 +52,19 @@
 #                         sparse periodic-handler heap keeps the
 #                         identity path for reference parity; its one
 #                         internal scan carries a waiver
+#   lint-metric-label     an UNBOUNDED value (raw topic path, session /
+#                         stream / request / hop / client id) used as a
+#                         metric label in a counter/gauge/histogram
+#                         family: every distinct label value mints a
+#                         new series FOREVER (the registry never
+#                         forgets), so per-session labels turn the
+#                         metrics plane into a memory leak and make
+#                         every family aggregate meaningless — the
+#                         exact failure Monarch/Prometheus operators
+#                         call a cardinality bomb.  Label by BOUNDED
+#                         dimensions (tenant, kind, reason, pipeline
+#                         name); audited exceptions carry per-line
+#                         waivers
 #   lint-unbounded-queue  accumulation in message/event-handler
 #                         contexts with no visible bound or shed
 #                         policy: a bare deque() (no maxlen) built in a
@@ -76,6 +89,7 @@
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 
 from .findings import ERROR, Finding
@@ -84,7 +98,19 @@ __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
-              "lint-print", "lint-unbounded-queue", "lint-linear-timer")
+              "lint-print", "lint-unbounded-queue", "lint-linear-timer",
+              "lint-metric-label")
+
+# metric-factory call tails whose labels= dict the label rule inspects
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+# identifier fragments that mark a label VALUE as per-request /
+# per-session / per-topic — unbounded by construction.  Purely lexical
+# (matched against the value expression's source text), like every
+# other rule here.
+_UNBOUNDED_LABEL_TOKENS = (
+    "topic", "session", "sid", "stream_id", "request_id", "hop_id",
+    "hop", "client_id", "trace_id", "span_id", "uuid", "frame_id",
+)
 
 # evidence that an accumulation target is bounded or shed within the
 # same function: any of these appearing against the SAME receiver text
@@ -326,6 +352,9 @@ class _Linter(ast.NodeVisitor):
                     f"by it (O(1) on the timer wheel); the sparse "
                     f"periodic heap's internal scan is the one waived "
                     f"exception")
+        if _func_tail(node.func) in _METRIC_FACTORIES and \
+                not self.is_test:
+            self._check_metric_labels(node)
         if self.lock_depth > 0 and \
                 _func_tail(node.func) in ("publish", "route"):
             self.report(
@@ -334,6 +363,46 @@ class _Linter(ast.NodeVisitor):
                 f"delivery can re-enter or block under the lock — "
                 f"buffer under the lock, publish after release")
         self.generic_visit(node)
+
+    # underscores count as separators (unlike \b): "topic_path" and
+    # "session_id" must trip on their stems, "inside"/"shop" must not
+    _LABEL_TOKEN_RE = re.compile(
+        r"(?<![a-z0-9])(" + "|".join(_UNBOUNDED_LABEL_TOKENS)
+        + r")(?![a-z0-9])")
+
+    def _check_metric_labels(self, node) -> None:
+        """lint-metric-label: inspect the labels= dict (or the third
+        positional argument) of a counter/gauge/histogram get-or-create
+        call for unbounded label values — dynamic expressions whose
+        source text names a per-request identity (topic, session id,
+        hop id, ...), or a suspicious label KEY fed a dynamic value."""
+        labels_node = None
+        for keyword in node.keywords:
+            if keyword.arg == "labels":
+                labels_node = keyword.value
+                break
+        if labels_node is None and len(node.args) >= 3:
+            labels_node = node.args[2]
+        if not isinstance(labels_node, ast.Dict):
+            return
+        for key_node, value_node in zip(labels_node.keys,
+                                        labels_node.values):
+            if isinstance(value_node, ast.Constant):
+                continue
+            value_text = ast.unparse(value_node).lower()
+            key_text = "" if key_node is None \
+                else ast.unparse(key_node).lower()
+            if self._LABEL_TOKEN_RE.search(value_text) or \
+                    self._LABEL_TOKEN_RE.search(key_text):
+                label = key_text or value_text
+                self.report(
+                    "lint-metric-label", value_node,
+                    f"metric label {label} takes an unbounded value "
+                    f"({ast.unparse(value_node)}): every distinct "
+                    f"value mints a registry series FOREVER — label by "
+                    f"bounded dimensions (tenant, kind, reason, "
+                    f"pipeline name) or waive the audited site with "
+                    f"`graft: disable=lint-metric-label`")
 
     def visit_With(self, node):
         locked = any(_mentions_lock(item.context_expr)

@@ -29,17 +29,28 @@
 # Scale decisions are themselves observable: counted into
 # autoscaler_decisions_total{action, reason}, mirrored into gauges, and
 # recorded as tracer spans when tracing is enabled.
+#
+# Since ISSUE 11 the intake is the fleet health plane's SeriesStore
+# (observe/series.py) instead of a latest-snapshot dict: every snapshot
+# appends into per-(process, series) ring history, staleness falls out
+# of the store's window (the old ad-hoc _SNAPSHOT_HORIZON pruning is
+# gone), hop p95 is a WINDOWED delta-quantile (a cumulative histogram
+# polluted before this autoscaler started cannot vote forever), the
+# underload veto reads the window's WORST value (a spike inside the
+# window blocks shrinking even if the latest tick looks quiet), and an
+# optional TREND signal (mailbox-depth slope) scales up on the leading
+# edge of a ramp before the level threshold trips.
 
 from __future__ import annotations
 
-import json
 import time
 from dataclasses import dataclass
 
 from .actor import Actor
 from .observe import tracing
-from .observe.export import METRICS_TOPIC_SUFFIX, series_quantile
+from .observe.export import METRICS_TOPIC_SUFFIX, parse_retained_json
 from .observe.metrics import default_registry
+from .observe.series import HistogramSeries, ScalarSeries, SeriesStore
 from .service import ServiceProtocol
 from .utils import get_logger
 
@@ -47,9 +58,10 @@ __all__ = ["Autoscaler", "ScalePolicy", "PROTOCOL_AUTOSCALER"]
 
 PROTOCOL_AUTOSCALER = ServiceProtocol("autoscaler")
 
-# a snapshot older than this many seconds is a corpse (its process died
-# or its publisher stopped) and must not keep voting on load
-_SNAPSHOT_HORIZON = 30.0
+# series families the scale loop reads — the intake appends only these
+# (the aggregator keeps full history; the autoscaler needs four)
+_SIGNAL_FAMILIES = ("event_mailbox_depth", "pipeline_hop_seconds",
+                    "batch_mean_wait_ms", "admission_queue_depth")
 
 
 @dataclass(frozen=True)
@@ -61,9 +73,23 @@ class ScalePolicy:
     mailbox_depth_up: float = 64.0      # queued events, worst process
     hop_p95_up: float = 1.0             # seconds, pipeline_hop_seconds
     batch_wait_up: float = 100.0        # ms, batch_mean_wait_ms
+    # frames queued in the admission fair queue (worst tenant) — the
+    # serving-side backlog the overload plane sheds from (ISSUE 11:
+    # the fair queue's own pressure is a first-class scale signal)
+    queue_depth_up: float = 256.0
     mailbox_depth_down: float = 4.0
     hop_p95_down: float = 0.25
     batch_wait_down: float = 20.0
+    queue_depth_down: float = 8.0
+    # leading-edge signal: worst mailbox-depth SLOPE (events/second
+    # over the window) that votes overload.  None = level-only (the
+    # pre-ISSUE-11 behaviour); a ramp that will cross mailbox_depth_up
+    # in a few windows can then add capacity before it does.
+    mailbox_trend_up: float | None = None
+    # staleness/evidence window: a process silent longer than this
+    # stops voting (replaces the old _SNAPSHOT_HORIZON), and the
+    # underload veto considers the window's worst value
+    window: float = 30.0
     hysteresis: int = 3                 # consecutive breaching evals
     cooldown: float = 10.0              # seconds between scale actions
     step: int = 1                       # clients added/removed per action
@@ -92,7 +118,10 @@ class Autoscaler(Actor):
         # {topic_path}/0/metrics
         self._filter = topic_filter or \
             f"{runtime.namespace}/+/+/{METRICS_TOPIC_SUFFIX}"
-        self._snapshots: dict[str, dict] = {}    # topic_path -> document
+        # windowed series history (ISSUE 11): the store's window doubles
+        # as the staleness horizon and its prune() as the corpse
+        # collection the old snapshot dict did by hand
+        self.store = SeriesStore(window=self.policy.window)
         self._up_streak = 0
         self._down_streak = 0
         self._last_action_at: float | None = None
@@ -110,10 +139,17 @@ class Autoscaler(Actor):
                 "worst observed event mailbox depth", labels),
             "hop_p95": registry.gauge(
                 "autoscaler_signal_hop_p95_s",
-                "worst observed remote-hop p95 seconds", labels),
+                "worst windowed remote-hop p95 seconds", labels),
             "batch_wait": registry.gauge(
                 "autoscaler_signal_batch_wait_ms",
                 "worst observed batch-former mean wait ms", labels),
+            "mailbox_trend": registry.gauge(
+                "autoscaler_signal_mailbox_trend",
+                "worst mailbox-depth slope (events/s over the window)",
+                labels),
+            "queue_depth": registry.gauge(
+                "autoscaler_signal_queue_depth",
+                "worst admission fair-queue depth", labels),
         }
         runtime.add_message_handler(self._metrics_handler, self._filter)
         self._timer = runtime.event.add_timer_handler(self.evaluate,
@@ -121,49 +157,84 @@ class Autoscaler(Actor):
 
     # -- snapshot intake ----------------------------------------------------
     def _metrics_handler(self, topic: str, payload) -> None:
-        try:
-            if isinstance(payload, (bytes, bytearray)):
-                payload = payload.decode("utf-8")
-            document = json.loads(payload)
-        except Exception:
+        document = parse_retained_json(payload, require_key="snapshot")
+        if document is None:
             self.logger.debug("autoscaler %s: unparseable snapshot on "
                               "%s", self.name, topic)
             return
-        if not isinstance(document, dict) or "snapshot" not in document:
-            return
-        document["_received"] = self.runtime.event.clock.now()
-        self._snapshots[str(document.get("topic_path", topic))] = document
+        self.store.append_snapshot(
+            str(document.get("topic_path", topic)),
+            document["snapshot"], self.runtime.event.clock.now(),
+            families=_SIGNAL_FAMILIES)
 
     # -- signal extraction --------------------------------------------------
+    def _worst(self, family: str, read,
+               kind: type = ScalarSeries) -> float:
+        """Worst value of `read(ring)` across a family's rings, rings
+        of the wrong series kind skipped: the store is fed from
+        NETWORK-received snapshots, and a foreign/cross-version
+        publisher shipping a family under the other metric type must
+        not crash every evaluate tick with an AttributeError."""
+        worst = 0.0
+        for _, ring in self.store.rings(family):
+            if not isinstance(ring, kind):
+                continue
+            value = read(ring)
+            if value is not None:
+                worst = max(worst, float(value))
+        return worst
+
     def signals(self) -> dict:
-        """Worst-case load signals across every live snapshot:
-        {"mailbox_depth", "hop_p95", "batch_wait"} (0.0 when a family
-        has no series yet)."""
+        """Worst-case load signals across every process with evidence
+        inside the policy window: levels read the LATEST sample (a
+        silent process stops voting once its history ages out — the
+        store's window IS the staleness horizon), hop p95 is the
+        windowed delta-quantile, and mailbox_trend is the worst
+        depth slope in events/second (the leading-edge signal)."""
         now = self.runtime.event.clock.now()
-        mailbox = hop_p95 = batch_wait = 0.0
-        # prune corpses outright: under restart churn every dead
-        # process left its last full snapshot behind under a unique
-        # pid topic_path — skipping them is not enough, the dict (and
-        # the per-tick iteration) must not grow without bound
-        stale = [key for key, document in self._snapshots.items()
-                 if now - document.get("_received", now)
-                 > _SNAPSHOT_HORIZON]
-        for key in stale:
-            del self._snapshots[key]
-        for document in self._snapshots.values():
-            snapshot = document.get("snapshot", {})
-            for series in snapshot.get("event_mailbox_depth",
-                                       {}).get("series", []):
-                mailbox = max(mailbox, float(series.get("value", 0)))
-            for series in snapshot.get("pipeline_hop_seconds",
-                                       {}).get("series", []):
-                hop_p95 = max(hop_p95, series_quantile(series, 0.95))
-            for series in snapshot.get("batch_mean_wait_ms",
-                                       {}).get("series", []):
-                batch_wait = max(batch_wait,
-                                 float(series.get("value", 0)))
-        return {"mailbox_depth": mailbox, "hop_p95": hop_p95,
-                "batch_wait": batch_wait}
+        window = self.policy.window
+        self.store.prune(now)
+        return {
+            "mailbox_depth": self._worst(
+                "event_mailbox_depth",
+                lambda r: r.latest(now, window)),
+            # baseline_empty: the FIRST snapshot a process ever sends
+            # reports everything its cumulative histogram holds — one
+            # sample is still evidence for capacity decisions (unlike
+            # SLO alerting, which demands a real delta)
+            "hop_p95": self._worst(
+                "pipeline_hop_seconds",
+                lambda r: r.delta_quantile(0.95, now, window,
+                                           baseline_empty=True),
+                kind=HistogramSeries),
+            "batch_wait": self._worst(
+                "batch_mean_wait_ms",
+                lambda r: r.latest(now, window)),
+            "mailbox_trend": self._worst(
+                "event_mailbox_depth",
+                lambda r: r.trend(now, window)),
+            "queue_depth": self._worst(
+                "admission_queue_depth",
+                lambda r: r.latest(now, window)),
+        }
+
+    def _windowed_quiet(self, signals: dict, now: float) -> bool:
+        """The underload veto reads the window's WORST values, not the
+        latest tick: capacity shrinks only when the whole window was
+        quiet — a spike two evaluations ago still blocks the shrink
+        (shrinking is cheap to delay, expensive to regret)."""
+        policy = self.policy
+        window = policy.window
+        worst_mailbox = self._worst("event_mailbox_depth",
+                                    lambda r: r.maximum(now, window))
+        worst_batch = self._worst("batch_mean_wait_ms",
+                                  lambda r: r.maximum(now, window))
+        worst_queue = self._worst("admission_queue_depth",
+                                  lambda r: r.maximum(now, window))
+        return (worst_mailbox <= policy.mailbox_depth_down
+                and signals["hop_p95"] <= policy.hop_p95_down
+                and worst_batch <= policy.batch_wait_down
+                and worst_queue <= policy.queue_depth_down)
 
     # -- the scale loop -----------------------------------------------------
     def _count_decision(self, action: str, reason: str) -> None:
@@ -227,6 +298,9 @@ class Autoscaler(Actor):
             signals["mailbox_depth"])
         self._signal_gauges["hop_p95"].set(signals["hop_p95"])
         self._signal_gauges["batch_wait"].set(signals["batch_wait"])
+        self._signal_gauges["mailbox_trend"].set(
+            signals["mailbox_trend"])
+        self._signal_gauges["queue_depth"].set(signals["queue_depth"])
         total = len(self.manager.clients)
         self._clients_gauge.set(total)
 
@@ -242,11 +316,12 @@ class Autoscaler(Actor):
         overload = (
             signals["mailbox_depth"] >= policy.mailbox_depth_up
             or signals["hop_p95"] >= policy.hop_p95_up
-            or signals["batch_wait"] >= policy.batch_wait_up)
-        underload = (
-            signals["mailbox_depth"] <= policy.mailbox_depth_down
-            and signals["hop_p95"] <= policy.hop_p95_down
-            and signals["batch_wait"] <= policy.batch_wait_down)
+            or signals["batch_wait"] >= policy.batch_wait_up
+            or signals["queue_depth"] >= policy.queue_depth_up
+            or (policy.mailbox_trend_up is not None
+                and signals["mailbox_trend"] >=
+                policy.mailbox_trend_up))
+        underload = not overload and self._windowed_quiet(signals, now)
         if overload:
             self._up_streak += 1
             self._down_streak = 0

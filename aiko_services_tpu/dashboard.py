@@ -61,6 +61,12 @@ class DashboardState:
         self._history_topic = None
         self._history_expected = None
         self.status = ""                # one-line feedback (kill, errors)
+        # SLO alert records (ISSUE 11): retained {namespace}/alert/+
+        # from every HealthAggregator — always subscribed, so the
+        # metrics pane leads with what is FIRING right now
+        self.alerts: dict[str, dict] = {}
+        self._alert_topic = f"{runtime.namespace}/alert/+"
+        runtime.add_message_handler(self._on_alert, self._alert_topic)
 
     # -- services table -----------------------------------------------------
     def services(self) -> list:
@@ -145,11 +151,29 @@ class DashboardState:
         self.page = "metrics"
 
     def _on_metrics(self, _topic, payload) -> None:
-        import json
-        try:
-            self.metrics_doc = json.loads(payload)
-        except (TypeError, ValueError):
-            pass
+        from .observe.export import parse_retained_json
+        document = parse_retained_json(payload)
+        if document is not None:
+            self.metrics_doc = document
+
+    def _on_alert(self, _topic, payload) -> None:
+        from .observe.export import parse_retained_json
+        record = parse_retained_json(payload, require_key="rule")
+        if record is not None:
+            self.alerts[str(record["rule"])] = record
+
+    def alert_lines(self) -> list:
+        """One line per FIRING SLO alert (empty when healthy)."""
+        lines = []
+        for rule in sorted(self.alerts):
+            record = self.alerts[rule]
+            if record.get("state") != "firing":
+                continue
+            description = record.get("description", "")
+            lines.append(f"ALERT {rule} firing since "
+                         f"{record.get('since', '?')}"
+                         + (f" — {description}" if description else ""))
+        return lines
 
     def close_metrics(self) -> None:
         if self._metrics_topic is not None:
@@ -162,12 +186,16 @@ class DashboardState:
         aligned text rows (counters/gauges by series, histograms as
         count / mean / approximate p50+p95 from bucket counts)."""
         doc = self.metrics_doc
+        # firing alerts lead even with no snapshot yet: a dead fleet
+        # (no live publisher) with retained alerts is exactly when the
+        # pane matters most
+        lines = self.alert_lines()
         if not doc:
-            return ["waiting for a metrics snapshot on "
-                    f"{self._metrics_topic} ..."]
+            return lines + ["waiting for a metrics snapshot on "
+                            f"{self._metrics_topic} ..."]
         from .observe.export import series_key, series_quantile
-        lines = [f"process: {doc.get('process', '?')}  "
-                 f"time: {doc.get('time', '?')}"]
+        lines.append(f"process: {doc.get('process', '?')}  "
+                     f"time: {doc.get('time', '?')}")
         snapshot = doc.get("snapshot", {})
         for name in sorted(snapshot):
             entry = snapshot[name]
@@ -342,6 +370,8 @@ class DashboardState:
 
     def terminate(self) -> None:
         self.back()
+        self.runtime.remove_message_handler(self._on_alert,
+                                            self._alert_topic)
         self.cache.terminate()
 
 

@@ -15,5 +15,14 @@ from .tracing import (                                      # noqa: F401
 )
 from .export import (                                       # noqa: F401
     METRICS_TOPIC_SUFFIX, MetricsPublisher, chrome_trace,
-    dump_chrome_trace, render_prometheus, series_key, series_quantile,
+    dump_chrome_trace, render_prometheus, render_snapshot_prometheus,
+    series_key, series_quantile,
+)
+from .series import (                                       # noqa: F401
+    ALERT_TOPIC_PREFIX, HealthAggregator, HistogramSeries, SLORule,
+    ScalarSeries, SeriesStore, parse_selector,
+)
+from .profiler import PhaseProfiler, arm_trace              # noqa: F401
+from .flight import (                                       # noqa: F401
+    DumpOnAlert, FLIGHT_TOPIC_SUFFIX, FlightLogHandler, FlightRecorder,
 )

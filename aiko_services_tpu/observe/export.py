@@ -19,10 +19,31 @@ from .metrics import Histogram, MetricsRegistry, default_registry
 from .tracing import Tracer, tracer as _global_tracer
 
 __all__ = [
-    "render_prometheus", "chrome_trace", "dump_chrome_trace",
-    "MetricsPublisher", "METRICS_TOPIC_SUFFIX", "series_key",
-    "series_quantile",
+    "render_prometheus", "render_snapshot_prometheus", "chrome_trace",
+    "dump_chrome_trace", "MetricsPublisher", "METRICS_TOPIC_SUFFIX",
+    "parse_retained_json", "series_key", "series_quantile",
 ]
+
+
+def parse_retained_json(payload, require_key: str | None = None):
+    """Decode one retained control-plane JSON payload (metrics
+    snapshot, alert record): bytes-tolerant, returns the dict or None
+    on any malformed input — a bad retained record must never fail a
+    subscriber.  `require_key` additionally rejects documents missing
+    that key.  The ONE decode shared by every snapshot/alert consumer
+    (HealthAggregator, Autoscaler, Recorder, Dashboard, metrics_dump),
+    so framing changes have a single seam."""
+    try:
+        if isinstance(payload, (bytes, bytearray)):
+            payload = payload.decode("utf-8")
+        document = json.loads(payload)
+    except Exception:
+        return None
+    if not isinstance(document, dict):
+        return None
+    if require_key is not None and require_key not in document:
+        return None
+    return document
 
 METRICS_TOPIC_SUFFIX = "0/metrics"
 
@@ -75,19 +96,29 @@ def _format_value(value) -> str:
 
 def render_prometheus(registry: MetricsRegistry | None = None) -> str:
     """The registry in Prometheus text exposition format (v0.0.4)."""
-    snapshot = (registry or default_registry()).snapshot()
+    return render_snapshot_prometheus(
+        (registry or default_registry()).snapshot())
+
+
+def render_snapshot_prometheus(snapshot: dict,
+                               extra_labels: dict | None = None) -> str:
+    """One already-captured MetricsRegistry.snapshot() document as
+    Prometheus text exposition.  `extra_labels` merge into every
+    series — the metrics_dump CLI stamps the publishing process's
+    topic_path so a fleet-wide scrape stays per-process attributable."""
     lines: list[str] = []
     for name in sorted(snapshot):
         entry = snapshot[name]
-        if entry["help"]:
+        if entry.get("help"):
             lines.append(f"# HELP {name} {entry['help']}")
-        lines.append(f"# TYPE {name} {entry['type']}")
-        for series in entry["series"]:
-            labels = series["labels"]
-            if entry["type"] == "histogram":
+        lines.append(f"# TYPE {name} {entry.get('type', 'gauge')}")
+        for series in entry.get("series", []):
+            labels = {**series.get("labels", {}),
+                      **(extra_labels or {})}
+            if entry.get("type") == "histogram":
                 cumulative = 0
-                for bound, count in zip(series["bounds"],
-                                        series["counts"]):
+                for bound, count in zip(series.get("bounds", ()),
+                                        series.get("counts", ())):
                     cumulative += count
                     lines.append(
                         f"{name}_bucket"
@@ -95,14 +126,14 @@ def render_prometheus(registry: MetricsRegistry | None = None) -> str:
                         f"{cumulative}")
                 lines.append(
                     f"{name}_bucket{_label_text(labels, {'le': '+Inf'})} "
-                    f"{series['count']}")
+                    f"{series.get('count', 0)}")
                 lines.append(f"{name}_sum{_label_text(labels)} "
-                             f"{_format_value(series['sum'])}")
+                             f"{_format_value(series.get('sum', 0.0))}")
                 lines.append(f"{name}_count{_label_text(labels)} "
-                             f"{series['count']}")
+                             f"{series.get('count', 0)}")
             else:
                 lines.append(f"{name}{_label_text(labels)} "
-                             f"{_format_value(series['value'])}")
+                             f"{_format_value(series.get('value', 0))}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

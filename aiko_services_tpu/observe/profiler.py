@@ -1,0 +1,246 @@
+# Fleet health plane, part 2: the decode-round phase profiler
+# (ISSUE 11).
+#
+# BENCH_r05 measured the decode round at 11.38 ms against a 5.64 ms
+# HBM roofline and could only call the difference "overhead".  This
+# module ATTRIBUTES it: ContinuousDecoder.pump() marks the boundary of
+# every phase of a serving round —
+#
+#   plan           host-side round planning (active mask, budgets,
+#                  cache fit)
+#   scan_dispatch  dispatching the compiled decode scan (async)
+#   spec_verify    same boundary in speculative mode (the dispatched
+#                  program is the widened verify step)
+#   admit_dispatch bucketed prefill admits queued behind the scan
+#   extend_dispatch chunked-prefill extends queued behind the scan
+#   host_sync      the device_get wall — where the device actually
+#                  executes everything dispatched above (THIS is the
+#                  phase the HBM-bytes model explains)
+#   wave_resolve   resolving earlier rounds' deferred admit firsts
+#   deliver        walking emissions into callbacks / retirements
+#   other          whatever the marks did not cover (bookkeeping,
+#                  EWMA) — 1 - other/wall is the attribution fraction
+#                  the bench reports
+#
+# and a PhaseProfiler accumulates wall time per phase.  The mark API
+# costs one perf_counter read per boundary (~9 per round against
+# millisecond-scale rounds), so it is ALWAYS ON — the bench's
+# lat_llama_phase_* fields and the serving_phase_seconds_total registry
+# family read the same accumulators.
+#
+# The HBM-bytes model rides the same phases: the decoder feeds each
+# round's modeled device bytes (weights + sized KV read for the scan,
+# prefill writes for admits/extends) into the phase that explains
+# them, so phase_stats() can report an implied GB/s per phase and the
+# roofline gap decomposes into "device streaming at X% of spec
+# bandwidth" vs "host-side dispatch/walk time" instead of one opaque
+# number.
+#
+# Opt-in deep capture: arm_trace() opens a jax.profiler trace window
+# (XLA-level timeline) for `duration` seconds, armed by environment
+# (AIKO_PROFILE_TRACE=<logdir>, AIKO_PROFILE_TRACE_S=<seconds>) or
+# programmatically — the HealthAggregator's on_alert hook can arm it,
+# so an SLO breach captures the device timeline of the very next
+# rounds.  jax imports lazily: observe/ stays importable without
+# touching the accelerator stack.
+
+from __future__ import annotations
+
+import os
+import time
+
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["PhaseProfiler", "PHASES", "arm_trace", "trace_state"]
+
+PHASES = ("plan", "scan_dispatch", "spec_verify", "admit_dispatch",
+          "extend_dispatch", "host_sync", "wave_resolve", "deliver",
+          "other")
+
+# -- jax.profiler capture window ---------------------------------------------
+
+_trace = {"armed": False, "active": False, "logdir": None,
+          "until": 0.0, "duration": 3.0, "captures": 0, "error": None}
+
+
+def arm_trace(logdir: str, duration: float = 3.0) -> None:
+    """Arm a one-shot jax.profiler capture window: the next profiled
+    round starts the trace, and it stops `duration` seconds later."""
+    _trace["armed"] = True
+    _trace["logdir"] = str(logdir)
+    _trace["duration"] = float(duration)
+
+
+def trace_state() -> dict:
+    return dict(_trace)
+
+
+def _env_arm() -> None:
+    logdir = os.environ.get("AIKO_PROFILE_TRACE", "")
+    if logdir:
+        arm_trace(logdir,
+                  float(os.environ.get("AIKO_PROFILE_TRACE_S", "3.0")))
+
+
+_env_arm()
+
+
+def _trace_tick() -> None:
+    """Advance the capture window state machine (called once per
+    committed round — zero cost when nothing is armed)."""
+    if not (_trace["armed"] or _trace["active"]):
+        return
+    now = time.perf_counter()
+    if _trace["armed"] and not _trace["active"]:
+        _trace["armed"] = False
+        try:
+            import jax
+            jax.profiler.start_trace(_trace["logdir"])
+            _trace["active"] = True
+            _trace["until"] = now + _trace["duration"]
+        except Exception as exc:    # profiler unavailable: disarm, note
+            _trace["error"] = repr(exc)
+        return
+    if _trace["active"] and now >= _trace["until"]:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            _trace["captures"] += 1
+        except Exception as exc:
+            _trace["error"] = repr(exc)
+        _trace["active"] = False
+
+
+class PhaseProfiler:
+    """Per-round wall-time attribution into named phases.
+
+    Usage (the pump loop's shape):
+
+        profiler.begin_round()
+        ...planning...          ; profiler.mark("plan")
+        ...dispatch scan...     ; profiler.mark("scan_dispatch")
+        ...
+        profiler.commit_round()    # or abandon_round() for idle ticks
+
+    mark(name) charges the time since the previous boundary to `name`;
+    commit folds the staged marks into the accumulators and charges
+    the unmarked remainder to "other".  abandon_round() discards the
+    staged marks — idle pump ticks must not dilute the attribution the
+    bench asserts on."""
+
+    def __init__(self, name: str = "decoder",
+                 registry: MetricsRegistry | None = None):
+        self.name = name
+        self.rounds = 0
+        self.wall_s = 0.0
+        self.phase_s = {phase: 0.0 for phase in PHASES}
+        self.phase_bytes = {phase: 0 for phase in PHASES}
+        self._t0 = 0.0
+        self._last = 0.0
+        self._staged: list = []
+        self._staged_bytes: dict = {}
+        registry = registry or default_registry()
+        labels = {"decoder": name}
+        self._seconds_counters = {
+            phase: registry.counter(
+                "serving_phase_seconds_total",
+                "decode-round wall seconds by phase",
+                labels={**labels, "phase": phase})
+            for phase in PHASES}
+        self._bytes_counters = {
+            phase: registry.counter(
+                "serving_phase_bytes_total",
+                "modeled device HBM bytes by phase",
+                labels={**labels, "phase": phase})
+            for phase in PHASES}
+
+    # -- the hot-path mark API (one perf_counter read each) ----------------
+    def begin_round(self) -> None:
+        self._t0 = self._last = time.perf_counter()
+        self._staged = []
+        self._staged_bytes = {}
+
+    def mark(self, phase: str) -> None:
+        now = time.perf_counter()
+        self._staged.append((phase, now - self._last))
+        self._last = now
+
+    def add_bytes(self, phase: str, nbytes: int) -> None:
+        self._staged_bytes[phase] = \
+            self._staged_bytes.get(phase, 0) + int(nbytes)
+
+    def abandon_round(self) -> None:
+        self._staged = []
+        self._staged_bytes = {}
+        # idle ticks still advance the capture window: a trace armed
+        # by an alert must STOP on schedule even if decode work
+        # ceases right after the breach (load shed/collapsed) —
+        # otherwise the capture buffers unboundedly and the artifact
+        # never finalizes
+        _trace_tick()
+
+    def commit_round(self) -> None:
+        total = time.perf_counter() - self._t0
+        marked = 0.0
+        for phase, dt in self._staged:
+            self.phase_s[phase] = self.phase_s.get(phase, 0.0) + dt
+            counter = self._seconds_counters.get(phase)
+            if counter is not None:
+                counter.inc(dt)
+            marked += dt
+        other = max(0.0, total - marked)
+        self.phase_s["other"] += other
+        self._seconds_counters["other"].inc(other)
+        for phase, nbytes in self._staged_bytes.items():
+            self.phase_bytes[phase] = \
+                self.phase_bytes.get(phase, 0) + nbytes
+            counter = self._bytes_counters.get(phase)
+            if counter is not None:
+                counter.inc(nbytes)
+        self.rounds += 1
+        self.wall_s += total
+        self._staged = []
+        self._staged_bytes = {}
+        _trace_tick()
+
+    # -- reporting ----------------------------------------------------------
+    def reset(self) -> None:
+        self.rounds = 0
+        self.wall_s = 0.0
+        for phase in self.phase_s:
+            self.phase_s[phase] = 0.0
+        for phase in self.phase_bytes:
+            self.phase_bytes[phase] = 0
+
+    def attributed_fraction(self) -> float:
+        """Fraction of committed round wall time carrying a NAMED
+        phase (1 - other/wall) — the bench acceptance number."""
+        if self.wall_s <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.phase_s["other"] / self.wall_s)
+
+    def phase_stats(self) -> dict:
+        """{"rounds", "wall_s", "attributed_frac", "phases": {name:
+        {"s", "frac", "ms_per_round", "bytes", "gb_per_s"?}}} — phases
+        with no time AND no bytes are omitted (speculative vs plain
+        mode each uses its own dispatch phase)."""
+        phases = {}
+        for phase in PHASES:
+            seconds = self.phase_s[phase]
+            nbytes = self.phase_bytes[phase]
+            if seconds <= 0.0 and nbytes <= 0:
+                continue
+            entry = {
+                "s": seconds,
+                "frac": seconds / self.wall_s if self.wall_s > 0
+                else 0.0,
+                "ms_per_round": seconds * 1000.0 / self.rounds
+                if self.rounds else 0.0,
+                "bytes": nbytes,
+            }
+            if nbytes and seconds > 0:
+                entry["gb_per_s"] = nbytes / seconds / 1e9
+            phases[phase] = entry
+        return {"rounds": self.rounds, "wall_s": self.wall_s,
+                "attributed_frac": self.attributed_fraction(),
+                "phases": phases}

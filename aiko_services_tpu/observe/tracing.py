@@ -184,6 +184,10 @@ class Tracer:
         self.enabled = enabled
         self.spans: deque = deque(maxlen=maxlen)
         self._stats: dict[str, list] = {}   # name -> [count, total_s]
+        # span taps: callables invoked with each finished SpanRecord
+        # (the flight recorder's intake); fault isolation per tap —
+        # a broken tap must not take tracing down with it
+        self.taps: list = []
 
     def enable(self, maxlen: int | None = None) -> None:
         if maxlen is not None and maxlen != self.spans.maxlen:
@@ -216,10 +220,16 @@ class Tracer:
                 parent_id = parent_id or context.span_id
         else:
             trace_id = ""
-        self.spans.append(SpanRecord(
+        span = SpanRecord(
             name=name, ts=ts, dur=dur, trace_id=trace_id,
             span_id=span_id or "", parent_id=parent_id or "",
-            cat=cat, proc=proc, args=dict(args or {})))
+            cat=cat, proc=proc, args=dict(args or {}))
+        self.spans.append(span)
+        for tap in self.taps:
+            try:
+                tap(span)
+            except Exception:       # a broken tap must not kill tracing
+                pass
         entry = self._stats.get(name)
         if entry is None:
             entry = self._stats[name] = [0, 0.0]

@@ -14,11 +14,11 @@
 
 from __future__ import annotations
 
-import json
 from collections import deque
 
 from .actor import Actor
-from .observe.export import METRICS_TOPIC_SUFFIX
+from .observe.export import METRICS_TOPIC_SUFFIX, parse_retained_json
+from .observe.series import ALERT_TOPIC_PREFIX
 from .service import ServiceProtocol
 from .utils import LRUCache, get_logger
 
@@ -52,9 +52,19 @@ class Recorder(Actor):
             f"{runtime.namespace}/+/+/{METRICS_TOPIC_SUFFIX}"
         runtime.add_message_handler(self._metrics_handler,
                                     self._metrics_filter)
+        # SLO alert records (ISSUE 11): HealthAggregator publishes
+        # retained {namespace}/alert/{rule} — the Recorder keeps the
+        # latest record per rule so a late-joining operator (or the
+        # Dashboard through the Recorder's EC share) sees what fired
+        self.alerts: dict[str, dict] = {}
+        self._alert_filter = \
+            f"{runtime.namespace}/{ALERT_TOPIC_PREFIX}/+"
+        runtime.add_message_handler(self._alert_handler,
+                                    self._alert_filter)
         self.ec_producer.update("topic_count", 0)
         self.ec_producer.update("record_count", 0)
         self.ec_producer.update("metrics_topic_count", 0)
+        self.ec_producer.update("alerts_firing", 0)
 
     def _log_handler(self, topic: str, payload) -> None:
         ring = self.buffers.get(topic)
@@ -67,11 +77,8 @@ class Recorder(Actor):
         self.ec_producer.update("record_count", total)
 
     def _metrics_handler(self, topic: str, payload) -> None:
-        try:
-            if isinstance(payload, (bytes, bytearray)):
-                payload = payload.decode("utf-8")
-            document = json.loads(payload)
-        except Exception:
+        document = parse_retained_json(payload)
+        if document is None:
             self.logger.debug("recorder: unparseable metrics snapshot "
                               "on %s", topic)
             return
@@ -82,6 +89,21 @@ class Recorder(Actor):
             self.ec_producer.update("metrics_topic_count",
                                     len(self.metrics_buffers))
         ring.append(document)
+
+    def _alert_handler(self, topic: str, payload) -> None:
+        record = parse_retained_json(payload, require_key="rule")
+        if record is None:
+            self.logger.debug("recorder: unparseable alert record on "
+                              "%s", topic)
+            return
+        self.alerts[str(record["rule"])] = record
+        self.ec_producer.update("alerts_firing", sum(
+            1 for entry in self.alerts.values()
+            if entry.get("state") == "firing"))
+
+    def alert_records(self) -> dict:
+        """Latest alert record per rule (firing or resolved)."""
+        return dict(self.alerts)
 
     def tail(self, topic: str, count: int = 16) -> list:
         ring = self.buffers.get(topic)
@@ -132,4 +154,6 @@ class Recorder(Actor):
                                             self._log_filter)
         self.runtime.remove_message_handler(self._metrics_handler,
                                             self._metrics_filter)
+        self.runtime.remove_message_handler(self._alert_handler,
+                                            self._alert_filter)
         super().stop()

@@ -1026,7 +1026,16 @@ class ContinuousDecoder:
         # decode-scan emissions vs prompt tokens prefilled — the
         # overhead ISSUE 7 moves off the decode round is exactly their
         # ratio.
+        # decode-round phase profiler (ISSUE 11): every pump round's
+        # wall time attributed to named phases (plan / scan dispatch /
+        # admit+extend dispatch / host sync / wave resolve / deliver),
+        # with the modeled HBM bytes charged to the phase that explains
+        # them — the roofline gap decomposes instead of being one
+        # opaque overhead number.  Always on: the mark API is one
+        # perf_counter read per boundary.
         from .observe.metrics import MirroredStats
+        from .observe.profiler import PhaseProfiler
+        self.profiler = PhaseProfiler(name)
         self.stats = MirroredStats(
             {"steps": 0, "rounds": 0, "completed": 0,
              "prefills": 0, "occupancy_sum": 0.0,
@@ -1239,6 +1248,14 @@ class ContinuousDecoder:
             jnp.asarray(slots + pad_slots, jnp.int32),
             jnp.asarray(valid), jnp.asarray(finish_arr),
             jnp.asarray(final_idx))
+        # HBM model for the extend program: weight stream + per-row
+        # prefix read (dequantize up to offset) + chunk write
+        row_bytes = self._kv_bytes_per_t // self.max_slots
+        self.profiler.add_bytes(
+            "extend_dispatch",
+            self._param_bytes + sum(
+                (offset + chunk) * row_bytes
+                for _, _, offset, _ in batch))
         wave = []
         for j, (slot, request, offset, finish) in enumerate(batch):
             new_pos = len(request.prompt) if finish else offset + chunk
@@ -1407,6 +1424,14 @@ class ContinuousDecoder:
         # with its first token OWED; the stashed wave resolves it at
         # the NEXT round's sync, by which point the admit program has
         # run in the gap between scans.
+        # HBM model for the admit program (executes in the sync gap
+        # behind the scan; bytes attributed to the dispatching phase):
+        # one weight stream plus the quantized/raw K+V rows written
+        # for `width` slots over `bucket` positions
+        self.profiler.add_bytes(
+            "admit_dispatch",
+            self._param_bytes +
+            width * bucket * self._kv_bytes_per_t // self.max_slots)
         wave = []
         for j, request in enumerate(chunk):
             request.slot = slots[j]
@@ -1498,6 +1523,8 @@ class ContinuousDecoder:
         stashed admit outputs (device-complete by now), then this
         round's scan emissions deliver, then retirements fire."""
         self._round_prefill_tokens = 0
+        profiler = self.profiler
+        profiler.begin_round()
         round_start = time.perf_counter()
         # mid-prefill slots hold a slot but don't decode yet
         active = self._active_np                  # preallocated (hot)
@@ -1524,6 +1551,7 @@ class ContinuousDecoder:
             # its discarded emissions out of useful_steps
             scan_active = active & (budgets > 0)
             scanned = bool(scan_active.any())
+        profiler.mark("plan")
         if scanned:
             self.stats["rounds"] += 1
             self.stats["occupancy_sum"] += float(active.mean())
@@ -1543,12 +1571,16 @@ class ContinuousDecoder:
                     jnp.array(scan_active), jnp.array(budgets),
                     self._k, self._v, num_steps=num_steps, eos=eos)
             self.stats["steps"] += num_steps
+            profiler.mark("spec_verify" if self.speculate_k
+                          else "scan_dispatch")
         # prefill rides BETWEEN decode scans: dispatched after the scan,
         # it runs on device while the host below waits out the scan
         # sync and walks the emissions — off the decode critical path,
         # rationed by prefill_budget
         self._admit_pending()
+        profiler.mark("admit_dispatch")
         self._advance_prefills()
+        profiler.mark("extend_dispatch")
         if self._round_prefill_tokens > \
                 self.stats["round_prefill_tokens_max"]:
             self.stats["round_prefill_tokens_max"] = \
@@ -1566,10 +1598,15 @@ class ContinuousDecoder:
                 emitted, emitted_active, wave_firsts = jax.device_get(
                     (emitted, emitted_active, wave_firsts))
             self.stats["decode_s"] += time.perf_counter() - decode_start
-            self.stats["bytes_moved"] += num_steps * (
+            round_bytes = num_steps * (
                 self._param_bytes + self._kv_bytes_per_t * self._cache_t)
+            self.stats["bytes_moved"] += round_bytes
+            # the scan's device bytes execute under the sync wall —
+            # host_sync is the phase whose duration they explain
+            profiler.add_bytes("host_sync", round_bytes)
         elif wave_firsts:
             wave_firsts = jax.device_get(wave_firsts)
+        profiler.mark("host_sync")
         # resolve deferred admits from EARLIER rounds: their prefill
         # programs ran before this round's scan on the in-order device
         # stream, so the fetch never waits on fresh work
@@ -1579,6 +1616,7 @@ class ContinuousDecoder:
                 if self._slots[request.slot] is request and \
                         not request.generated:
                     self._deliver(request.slot, int(firsts[j]), now)
+        profiler.mark("wave_resolve")
         if scanned:
             if self.speculate_k:
                 self._deliver_spec(emitted, emit_mask, occupied,
@@ -1602,12 +1640,18 @@ class ContinuousDecoder:
                         self._deliver(slot, int(emitted[k, slot]), now)
                         delivered += 1
                 self.stats["tokens_decode"] += delivered
+            profiler.mark("deliver")
         if scanned or wave_firsts or self._round_prefill_tokens:
             # working rounds only: idle pump ticks would drag the EWMA
             # toward the timer period and break the admission estimate
+            # (and would dilute the profiler's phase attribution the
+            # same way — idle ticks are abandoned, not committed)
             elapsed = time.perf_counter() - round_start
             self._round_ewma = elapsed if self._round_ewma is None \
                 else 0.7 * self._round_ewma + 0.3 * elapsed
+            profiler.commit_round()
+        else:
+            profiler.abandon_round()
         if self.idle and self.on_idle is not None:
             self.on_idle()
 

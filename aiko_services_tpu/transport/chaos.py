@@ -43,6 +43,7 @@ import random
 from dataclasses import dataclass, field
 from fnmatch import fnmatchcase
 
+from ..observe import flight as _flight
 from ..observe.metrics import MirroredStats
 from .memory import MemoryBroker
 from .message import Message, topic_matches
@@ -196,6 +197,12 @@ class FaultPlan:
                     partition.severs(sender_id, recipient_id):
                 verdict.drop = True
                 self.stats["partitioned"] += 1
+                # flight-recorder evidence (ISSUE 11): every injected
+                # fault lands in the per-runtime rings, so an SLO-breach
+                # dump carries the faults that caused it — a no-op
+                # when no recorder is registered
+                _flight.record_fault("partitioned", topic, sender_id,
+                                     recipient_id, now)
                 return verdict
         for rule in self.rules:
             if not rule.matches(topic, sender_id, recipient_id, payload,
@@ -214,6 +221,8 @@ class FaultPlan:
                 continue
             rule.fired += 1
             self.stats[rule.kind] += 1
+            _flight.record_fault(rule.kind, topic, sender_id,
+                                 recipient_id, now)
             if rule.kind == "drop":
                 verdict.drop = True
                 return verdict

@@ -1221,6 +1221,9 @@ def bench_llama(window: float):
     decoder.ttft_samples.clear()
     decoder.itl_samples.clear()
     decoder.gap_samples.clear()
+    # phase profiler likewise: warmup rounds are compile-dominated and
+    # would swamp the attribution the lat_llama_phase_* fields report
+    decoder.profiler.reset()
     generated[0] = 0
 
     start = time.perf_counter()
@@ -1272,7 +1275,24 @@ def bench_llama(window: float):
     steps = max(decoder.stats["steps"], 1)
     bw_util = (decoder.stats["bytes_moved"] / decode_s / membw) \
         if (membw and decode_s > 0) else None
-    return {
+    # decode-round phase attribution (ISSUE 11): where each round's
+    # wall time went, per phase, so the roofline gap is attributed
+    # rather than just measured — lat_llama_phase_attributed is the
+    # fraction of round wall covered by NAMED phases (acceptance:
+    # >= 0.9 on the CPU smoke)
+    phase = decoder.profiler.phase_stats()
+    phase_fields = {
+        "lat_llama_phase_attributed": round(phase["attributed_frac"],
+                                            4),
+        "lat_llama_phase_rounds": phase["rounds"],
+    }
+    for phase_name, entry in sorted(phase["phases"].items()):
+        phase_fields[f"lat_llama_phase_{phase_name}_ms"] = \
+            round(entry["ms_per_round"], 3)
+        if "gb_per_s" in entry:
+            phase_fields[f"lat_llama_phase_{phase_name}_gbps"] = \
+                round(entry["gb_per_s"], 2)
+    return phase_fields | {
         "llama_tokens_per_sec": round(tokens_per_sec, 1),
         "llama_occupancy": round(decoder.mean_occupancy(), 3),
         "llama_prefill_frac": round(split, 3),

@@ -118,7 +118,8 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
              failure_budget: int = 4, horizon: float = 60.0,
              wav_path: str | None = None, peer: bool = False,
              peer_kill_at: float | None = None, mqtt: bool = False,
-             autoscale: bool = False) -> dict:
+             autoscale: bool = False,
+             health_dump_dir: str | None = None) -> dict:
     """Run the scenario; returns the JSON-able report.
 
     peer=True runs the data plane over registrar-negotiated direct
@@ -144,7 +145,18 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     min_clients=2 floor (ISSUE 9): the mid-run kill drops the fleet
     below the floor and the AUTOSCALER — not the restart backoff — is
     what respawns capacity, provably (autoscaler_decisions_total
-    {action=up, reason=below-floor} in the telemetry block)."""
+    {action=up, reason=below-floor} in the telemetry block).
+
+    health_dump_dir arms the fleet health plane (ISSUE 11): metrics
+    snapshots publish every 0.5 s, a HealthAggregator on the registrar
+    runtime evaluates a hop-p95 SLO rule over windowed series, and
+    FlightRecorders ride the caller + serving runtimes.  The partition
+    window inflates retried-hop latency past the rule's threshold, the
+    burn fires mid-run, and the alert's DumpOnAlert trigger writes
+    EXACTLY ONE merged Perfetto timeline into the directory — spans,
+    metric samples, and the chaos plan's fault events from every
+    runtime, correlated by trace id.  The report gains a "health"
+    block (alerts fired, dump path, ring totals)."""
     import numpy as np
 
     from aiko_services_tpu.compute import ComputeRuntime
@@ -297,6 +309,42 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
     _settle(engine, 2.0)
     assert caller.remote_elements_ready(), "setup: discovery failed"
 
+    # -- fleet health plane (ISSUE 11) ----------------------------------
+    aggregator = None
+    dump_trigger = None
+    publisher = None
+    recorders = []
+    if health_dump_dir is not None:
+        from aiko_services_tpu.observe import (
+            DumpOnAlert, FlightRecorder, HealthAggregator,
+            MetricsPublisher, SLORule)
+        flight_families = ("pipeline_hop_seconds", "chaos_faults_total",
+                           "pipeline_recovery_total",
+                           "event_mailbox_depth")
+        for runtime in [call_rt] + [rt for rt, _ in servings]:
+            recorders.append(FlightRecorder(
+                runtime, sample_interval=0.5,
+                families=flight_families))
+        publisher = MetricsPublisher(call_rt, interval=0.5)
+        dump_trigger = DumpOnAlert(health_dump_dir)
+        # the armed SLO: hop-retry burn.  Retries are charged on the
+        # ENGINE clock (timer expiries), so the rule is deterministic
+        # under the virtual-clock soak; a wall-clock latency rule
+        # (hop p95) would measure how fast the host stepped the
+        # scenario, not the scenario.  Burn = retry fraction of hop
+        # work against a 5% error budget, in both windows.
+        aggregator = HealthAggregator(
+            registrar_rt, rules=[SLORule(
+                name="hop-retry-burn", kind="ratio",
+                bad="pipeline_recovery_total"
+                    "{pipeline=chaos_call,kind=retries}",
+                good="pipeline_hop_seconds{pipeline=chaos_call}",
+                objective=0.95, pairs=((8.0, 2.0, 2.0),),
+                description="remote-hop retries burning the 5% "
+                            "error budget in both windows")],
+            interval=0.5, window=60.0)
+        aggregator.on_alert.append(dump_trigger)
+
     # -- arm the chaos schedule -----------------------------------------
     base = engine.clock.now()
     data_topics = [f"{pipeline.topic_path}/in"
@@ -378,6 +426,10 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
             break
         engine.clock.advance(0.05)
     _settle(engine, 1.0)
+    if aggregator is not None and not aggregator.alerts:
+        # the last retried hops may complete right at loop exit: give
+        # the publisher + evaluator a few more ticks to see them
+        _settle(engine, 3.0)
 
     # -- report + leak checks --------------------------------------------
     completed = {frame.stream_id for frame in done}
@@ -425,6 +477,18 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
                         for i, (rt, _) in enumerate(servings)},
         }
     report["transport"] = "mqtt" if mqtt else "memory"
+    if aggregator is not None:
+        report["health"] = {
+            "alerts": dict(aggregator.alerts),
+            "alerts_fired": sum(aggregator.fired.values()),
+            "dumps": dict(dump_trigger.dumped),
+            "rings": {
+                recorder.name: {
+                    "spans": len(recorder.spans),
+                    "samples": len(recorder.samples),
+                    "faults": len(recorder.faults),
+                } for recorder in recorders},
+        }
     if autoscale:
         report["autoscaler"] = {
             "deaths": manager.restart_stats["deaths"],
@@ -452,6 +516,12 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
         trc.disable()
 
     # -- teardown (serving1 already crashed; leave its corpse be) --------
+    if aggregator is not None:
+        aggregator.stop()
+    if publisher is not None:
+        publisher.stop()
+    for recorder in recorders:
+        recorder.close()
     caller.stop()
     call_rt.terminate()
     if autoscaler is not None:
@@ -486,9 +556,9 @@ def run_tenant_soak(seed: int = 11, polite_frames: int = 6,
                     flood_frames: int = 24,
                     polite_interval: float = 0.5,
                     flood_interval: float = 0.02,
-                    service_time: float = 0.15,
+                    service_time: float = 0.35,
                     inflight_limit: int = 2,
-                    flood_budget: int = 4,
+                    flood_budget: int = 6,
                     frame_deadline: float = 5.0,
                     horizon: float = 30.0) -> dict:
     """Per-tenant fair-queuing acceptance (ISSUE 9): a flooding tenant
@@ -497,9 +567,21 @@ def run_tenant_soak(seed: int = 11, polite_frames: int = 6,
     ONLY the flooder's overflow (newest-first, within its own budget)
     while the polite tenant — higher priority tier — keeps a
     deadline-met fraction of 1.0.  The per-tenant admission_* counters
-    in the report are the proof; deterministic on a VirtualClock."""
+    in the report are the proof; deterministic on a VirtualClock.
+
+    The fleet health plane (ISSUE 11) rides the same scenario: the
+    serving runtime publishes metrics snapshots, a HealthAggregator
+    burns an admission-shed error budget (ratio rule, multi-window),
+    and an Autoscaler reads windowed hop-p95 from the series store.
+    With the flood on, the burn-rate alert fires and the autoscaler's
+    windowed signals drive a scale-up; with flood_frames=0 (the polite
+    baseline), ZERO alerts fire — shed deltas over the window are the
+    evidence, so cumulative counters from earlier scenarios in the
+    same process cannot false-alarm."""
+    from aiko_services_tpu.autoscaler import Autoscaler, ScalePolicy
     from aiko_services_tpu.event import EventEngine, VirtualClock
-    from aiko_services_tpu.observe import default_registry
+    from aiko_services_tpu.observe import (
+        HealthAggregator, MetricsPublisher, SLORule, default_registry)
     from aiko_services_tpu.ops.admission import (
         AdmissionGate, TenantFairQueue, TenantPolicy)
     from aiko_services_tpu.pipeline import (
@@ -563,6 +645,56 @@ def run_tenant_soak(seed: int = 11, polite_frames: int = 6,
         element_classes={"PE_SlowSink": PE_SlowSink},
         auto_create_streams=True, stream_lease_time=30.0,
         admission=gate)
+
+    # fleet health plane over the scenario (ISSUE 11): snapshots out of
+    # the serving runtime, burn-rate alerting + a windowed autoscaler
+    # on the registrar runtime
+    tenant_publisher = MetricsPublisher(serve_rt, interval=0.5)
+    aggregator = HealthAggregator(
+        registrar_rt, rules=[SLORule(
+            name="admission-shed-burn", kind="ratio",
+            bad="admission_shed_total", good="admission_admitted_total",
+            objective=0.99, pairs=((8.0, 2.0, 2.0),),
+            description="admission shed rate burning the 1% error "
+                        "budget in both windows")],
+        interval=0.5, window=60.0)
+
+    class _StubFleet:
+        """Counting actuator: the scenario proves the SIGNALS react;
+        real spawn mechanics have their own soak (--autoscale)."""
+
+        def __init__(self, count):
+            self.clients = {index: object() for index in range(count)}
+            self.scale_ups = 0
+
+        def scale_to(self, count):
+            delta = count - len(self.clients)
+            if delta > 0:
+                self.scale_ups += 1
+                for index in range(len(self.clients), count):
+                    self.clients[index] = object()
+            elif delta < 0:
+                for _ in range(-delta):
+                    self.clients.popitem()
+            return delta
+
+        def ready_count(self):
+            return len(self.clients)
+
+    fleet = _StubFleet(1)
+    # the windowed signal that reacts here is the admission fair
+    # queue's own depth: the flood backs it up within the first virtual
+    # second, the serving snapshot carries the gauge, and the
+    # autoscaler's series store holds it in-window long after the
+    # burst drains (hop p95 is wall-clock and useless on a virtual
+    # scenario; queue depth is engine-deterministic)
+    autoscaler = Autoscaler(
+        registrar_rt, name="tenant_scaler", manager=fleet,
+        policy=ScalePolicy(min_clients=1, max_clients=3,
+                           mailbox_depth_up=1e9, batch_wait_up=1e9,
+                           hop_p95_up=1e9, queue_depth_up=3.0,
+                           hysteresis=2, cooldown=60.0, window=10.0),
+        interval=0.5)
 
     call_rt = make_runtime("tenant_caller")
     caller = Pipeline(
@@ -652,10 +784,22 @@ def run_tenant_soak(seed: int = 11, polite_frames: int = 6,
                         "deadline_rejected")},
         "queue_depth_final": gate.queue.depth(),
         "inflight_final": gate.inflight,
+        "health": {
+            "alerts": dict(aggregator.alerts),
+            "alerts_fired": sum(aggregator.fired.values()),
+            "autoscaler": {
+                "scale_ups": fleet.scale_ups,
+                "clients": len(fleet.clients),
+                "signals": autoscaler.signals(),
+            },
+        },
         "virtual_seconds": round(engine.clock.now() - base, 2),
         "wall_seconds": round(time.monotonic() - wall_start, 2),
     }
 
+    autoscaler.stop()
+    aggregator.stop()
+    tenant_publisher.stop()
     caller.stop()
     call_rt.terminate()
     serving.stop()
@@ -694,6 +838,12 @@ def main(argv=None) -> int:
     parser.add_argument("--tenants", action="store_true",
                         help="run the flooding-tenant admission "
                              "scenario instead of the chaos soak")
+    parser.add_argument("--health-dump-dir", default=None,
+                        metavar="DIR",
+                        help="arm the fleet health plane: SLO "
+                             "burn-rate alerting over windowed series "
+                             "+ a flight-recorder dump into DIR on "
+                             "breach (ISSUE 11)")
     args = parser.parse_args(argv)
     if args.tenants:
         report = run_tenant_soak(seed=args.seed)
@@ -705,7 +855,8 @@ def main(argv=None) -> int:
     report = run_soak(seed=args.seed, frames=args.frames, drop=args.drop,
                       retries=args.retries, horizon=args.horizon,
                       peer=args.peer, mqtt=args.mqtt,
-                      autoscale=args.autoscale)
+                      autoscale=args.autoscale,
+                      health_dump_dir=args.health_dump_dir)
     print(json.dumps(report, indent=2))
     return 0 if report["frames_lost"] <= args.max_lost else 1
 

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+# metrics_dump: scrape a namespace's retained metrics snapshots and
+# print them as Prometheus text exposition or JSON (ISSUE 11 satellite).
+#
+# Every process running a MetricsPublisher leaves a RETAINED snapshot
+# on {namespace}/{host}/{pid}/0/metrics — this CLI subscribes the
+# namespace filter, waits for the broker to replay the retained
+# documents (plus any fresh publishes inside the window), and prints
+# the merged result: ops parity with the Dashboard's 'm' pane, minus
+# the terminal.  Prometheus output stamps each series with a
+# `process="{topic_path}"` label so a fleet-wide scrape stays
+# per-process attributable; JSON output is the raw snapshot documents
+# keyed by topic_path.
+#
+# Usage:
+#   python scripts/metrics_dump.py --host mqtt.local         # live MQTT
+#   python scripts/metrics_dump.py --namespace aiko --wait 3
+#   python scripts/metrics_dump.py --format json --family serving
+#
+# Without --host the scrape runs over the in-process memory broker —
+# only useful embedded (tests import collect_snapshots directly against
+# a live runtime).
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from aiko_services_tpu.observe.export import (          # noqa: E402
+    METRICS_TOPIC_SUFFIX, parse_retained_json,
+    render_snapshot_prometheus)
+
+
+def collect_snapshots(runtime, wait: float = 2.0,
+                      settle=None) -> dict:
+    """Subscribe {namespace}/+/+/0/metrics on `runtime`, drive its
+    engine for `wait` seconds, and return {topic_path: document}.
+    Retained snapshots replay on subscribe, so even a silent fleet
+    answers.  `settle` overrides the drive loop (tests pass a
+    virtual-clock settle; the CLI uses run_until on the real clock)."""
+    documents: dict[str, dict] = {}
+    topic_filter = f"{runtime.namespace}/+/+/{METRICS_TOPIC_SUFFIX}"
+
+    def handler(topic: str, payload) -> None:
+        document = parse_retained_json(payload, require_key="snapshot")
+        if document is not None:
+            documents[str(document.get("topic_path", topic))] = document
+
+    runtime.add_message_handler(handler, topic_filter)
+    try:
+        if settle is not None:
+            settle(runtime.event, wait)
+        else:
+            runtime.event.run_until(lambda: False, timeout=wait)
+    finally:
+        runtime.remove_message_handler(handler, topic_filter)
+    return documents
+
+
+def render(documents: dict, fmt: str = "prom",
+           family: str | None = None) -> str:
+    """Render scraped documents: 'prom' = text exposition with a
+    process label per source, 'json' = the documents verbatim.
+    `family` filters metric families by substring."""
+    if family:
+        documents = {
+            source: {**document, "snapshot": {
+                name: entry
+                for name, entry in document.get("snapshot", {}).items()
+                if family in name}}
+            for source, document in documents.items()}
+    if fmt == "json":
+        return json.dumps(documents, indent=2, default=str,
+                          sort_keys=True)
+    parts = []
+    for source in sorted(documents):
+        snapshot = documents[source].get("snapshot", {})
+        parts.append(render_snapshot_prometheus(
+            snapshot, extra_labels={"process": source}))
+    return "".join(parts)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="scrape retained {topic}/0/metrics snapshots from "
+                    "a namespace and print Prometheus text or JSON")
+    parser.add_argument("--namespace", default=None,
+                        help="namespace to scrape (default: "
+                             "AIKO_NAMESPACE or 'aiko')")
+    parser.add_argument("--host", default=None,
+                        help="MQTT broker host (omit to scrape the "
+                             "in-process memory broker)")
+    parser.add_argument("--port", type=int, default=1883)
+    parser.add_argument("--wait", type=float, default=2.0,
+                        help="seconds to collect before printing")
+    parser.add_argument("--format", choices=("prom", "json"),
+                        default="prom")
+    parser.add_argument("--family", default=None,
+                        help="only families whose name contains this")
+    args = parser.parse_args(argv)
+
+    from aiko_services_tpu.process import ProcessRuntime
+    transport_factory = None
+    if args.host:
+        from aiko_services_tpu.transport.mqtt import MQTTMessage
+
+        def transport_factory(on_message, lwt_topic, lwt_payload,
+                              lwt_retain):
+            return MQTTMessage(
+                on_message=on_message, host=args.host, port=args.port,
+                lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                lwt_retain=lwt_retain)
+
+    runtime = ProcessRuntime(name="metrics_dump",
+                             namespace=args.namespace,
+                             transport_factory=transport_factory)
+    runtime.initialize()
+    try:
+        documents = collect_snapshots(runtime, wait=args.wait)
+        # CLI output IS the product here: graft: disable=lint-print
+        print(render(documents, args.format, args.family), end="")
+    finally:
+        runtime.terminate()
+    if not documents:
+        print(f"no retained metrics snapshots found in namespace "
+              f"{runtime.namespace!r}",  # graft: disable=lint-print
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -202,6 +202,60 @@ class TestHysteresis:
         rt.terminate()
 
 
+class TestWindowedSignals:
+    """ISSUE 11: the autoscaler reads windowed series from the health
+    plane's store — trend scales up on the leading edge of a ramp, and
+    a spike anywhere in the window vetoes shrinking."""
+
+    def test_trend_scales_up_before_level_threshold(self, engine):
+        rt = make_runtime(engine, "trend_rt")
+        manager = StubManager(1)
+        autoscaler = Autoscaler(
+            rt, name="trend", manager=manager,
+            policy=ScalePolicy(min_clients=1, max_clients=4,
+                               mailbox_depth_up=1e9, hop_p95_up=1e9,
+                               batch_wait_up=1e9, mailbox_trend_up=5.0,
+                               hysteresis=2, cooldown=30.0),
+            interval=1.0)
+        # a ramp well below the (parked) level threshold: 0 → 30 at
+        # ~10 events/s — the slope is the signal
+        for depth in (0, 10, 20, 30):
+            publish_snapshot(rt, "p1", mailbox=depth or 0.001)
+            settle_virtual(engine, 1.0)
+        settle_virtual(engine, 2.0)
+        assert manager.actions.count(1) >= 1
+        assert autoscaler.signals()["mailbox_trend"] >= 5.0
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_spike_inside_window_blocks_shrink(self, engine):
+        rt = make_runtime(engine, "veto_rt")
+        manager = StubManager(2)
+        autoscaler = Autoscaler(
+            rt, name="veto", manager=manager,
+            policy=ScalePolicy(min_clients=1, max_clients=4,
+                               mailbox_depth_up=1e9, hop_p95_up=1e9,
+                               batch_wait_up=1e9, window=10.0,
+                               hysteresis=2, cooldown=0.5),
+            interval=1.0)
+        publish_snapshot(rt, "p1", mailbox=200)      # the spike
+        settle_virtual(engine, 1.0)
+        # latest turns quiet immediately, but the spike stays inside
+        # the 10 s window: no shrink while it does
+        for _ in range(6):
+            publish_snapshot(rt, "p1", mailbox=1)
+            settle_virtual(engine, 1.0)
+        assert len(manager.clients) == 2
+        assert all(a >= 0 for a in manager.actions)
+        # once the spike ages out of the window, shrink proceeds
+        for _ in range(10):
+            publish_snapshot(rt, "p1", mailbox=1)
+            settle_virtual(engine, 1.0)
+        assert len(manager.clients) == 1
+        autoscaler.stop()
+        rt.terminate()
+
+
 class TestFloorRestoration:
     def test_crash_respawns_through_lifecycle_manager(self, engine):
         """A serving client crashes (LWT); the autoscaler's below-floor

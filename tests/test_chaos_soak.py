@@ -10,12 +10,13 @@
 # asserts the rest: frame loss within policy (zero), no pending hops,
 # no live hop leases left on the engine.
 
+import json
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
 
-from chaos_soak import run_soak  # noqa: E402
+from chaos_soak import run_soak, run_tenant_soak  # noqa: E402
 
 
 def test_chaos_soak_speech_two_runtimes():
@@ -121,3 +122,83 @@ def test_chaos_soak_mqtt_autoscale():
     # leak checks hold over MQTT too
     assert report["pending_hops"] == 0
     assert report["leaked_hop_leases"] == 0
+
+
+def test_chaos_soak_slo_breach_ships_one_flight_dump(tmp_path):
+    # ISSUE 11 capstone: the same chaos scenario with an SLO rule armed
+    # (hop-retry burn against a 5% error budget).  The partition +
+    # kill provoke retries, the multi-window burn fires mid-run, and
+    # the breach ships EXACTLY ONE merged Perfetto-loadable
+    # flight-recorder dump: spans + metric samples + chaos fault
+    # events from >= 2 runtimes, correlated under shared trace ids.
+    report = run_soak(seed=11, frames=6, horizon=40.0,
+                      health_dump_dir=str(tmp_path))
+
+    # the scenario itself still holds
+    assert report["frames_lost"] == 0, report
+    assert report["frames_recovered"] == 6
+
+    health = report["health"]
+    assert health["alerts_fired"] >= 1
+    assert "hop-retry-burn" in health["alerts"]
+    # exactly ONE dump artifact for the breach, however many ticks the
+    # rule stayed breached
+    dumps = list(tmp_path.glob("*.json"))
+    assert len(dumps) == 1
+    assert health["dumps"] == {"hop-retry-burn": str(dumps[0])}
+
+    with open(dumps[0]) as f:
+        document = json.load(f)
+    assert document["metadata"]["reason"] == "slo-breach:hop-retry-burn"
+    events = document["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    samples = [e for e in events if e.get("ph") == "C"]
+    faults = [e for e in events
+              if e.get("ph") == "i" and e["name"].startswith("fault:")]
+    # all three evidence kinds present
+    assert spans and samples and faults
+    # the chaos plan's injected faults are the recorded ones
+    kinds = {e["name"] for e in faults}
+    assert "fault:partitioned" in kinds or "fault:drop" in kinds
+
+    # recorder identities: one pid per runtime, >= 2 runtimes present
+    pid_names = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M"}
+    assert {"caller", "serving1", "serving2"} <= pid_names
+
+    # correlation: at least one trace id whose spans cross >= 2
+    # runtimes (caller hop + serving process under ONE trace)
+    by_trace: dict = {}
+    for event in spans:
+        trace_id = event["args"].get("trace_id")
+        if trace_id:
+            by_trace.setdefault(trace_id, set()).add(event["pid"])
+    assert any(len(pids) >= 2 for pids in by_trace.values()), \
+        "no trace id spans two runtimes in the merged timeline"
+
+
+def test_tenant_flood_fires_burn_alert_and_windowed_autoscaler():
+    # ISSUE 11: the flooding-tenant scenario with the health plane
+    # armed — the admission-shed burn-rate alert fires and the
+    # autoscaler's windowed queue-depth signal drives a scale-up...
+    report = run_tenant_soak(seed=11)
+    assert report["flood"]["shed"] > 0
+    health = report["health"]
+    assert health["alerts_fired"] >= 1
+    assert "admission-shed-burn" in health["alerts"]
+    assert health["autoscaler"]["scale_ups"] >= 1
+    # the polite tenant's SLO held through the flood AND the alerting
+    assert report["polite"]["deadline_met_fraction"] == 1.0
+
+
+def test_tenant_baseline_zero_alerts():
+    # ... and the polite-tenant baseline (no flood) fires ZERO alerts:
+    # rates come from windowed deltas, so the cumulative shed counters
+    # left behind by the flood run above cannot false-alarm this one.
+    report = run_tenant_soak(seed=11, flood_frames=0)
+    assert report["flood"]["posted"] == 0
+    assert report["polite"]["deadline_met_fraction"] == 1.0
+    health = report["health"]
+    assert health["alerts_fired"] == 0
+    assert health["alerts"] == {}
+    assert health["autoscaler"]["scale_ups"] == 0
