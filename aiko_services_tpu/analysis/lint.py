@@ -52,6 +52,22 @@
 #                         sparse periodic-handler heap keeps the
 #                         identity path for reference parity; its one
 #                         internal scan carries a waiver
+#   lint-wall-clock       time.time() / datetime.now() / utcnow() /
+#                         today() in package (non-test) modules: the
+#                         runtime keeps THREE clocks on purpose — the
+#                         engine clock (virtual in every deterministic
+#                         test; event timestamps, deadlines, windowed
+#                         series), time.monotonic (scheduler stamps),
+#                         and time.perf_counter (span walls) — and the
+#                         wall-epoch clock is none of them.  A
+#                         wall-epoch stamp breaks virtual-clock
+#                         determinism, jumps with NTP, and lands
+#                         instants decades off a merged flight
+#                         timeline (the exact bug class fixed twice in
+#                         the PR 11 FlightLogHandler review).  Sites
+#                         that genuinely need calendar time (report
+#                         filenames, human-readable logs) carry
+#                         per-line waivers
 #   lint-metric-label     an UNBOUNDED value (raw topic path, session /
 #                         stream / request / hop / client id) used as a
 #                         metric label in a counter/gauge/histogram
@@ -99,7 +115,51 @@ __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
               "lint-print", "lint-unbounded-queue", "lint-linear-timer",
-              "lint-metric-label")
+              "lint-metric-label", "lint-wall-clock")
+
+# wall-epoch clock reads (lint-wall-clock): canonical spellings; call
+# targets are CANONICALIZED through the module's actual time/datetime
+# import aliases first (_clock_aliases), so `import datetime as dt;
+# dt.datetime.now()`, `import time as t; t.time()`, and `from time
+# import time; time()` all trip — while an unrelated object attribute
+# named .time() does not (no alias resolves it).
+_WALL_CLOCK_CALLS = {
+    "time.time",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "date.today", "datetime.date.today",
+}
+
+
+def _clock_aliases(tree: ast.AST) -> dict:
+    """Local names bound to the time/datetime modules (or their
+    wall-clock members) by this module's imports: {name: canonical}."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for entry in node.names:
+                if entry.name in ("time", "datetime"):
+                    aliases[entry.asname or entry.name] = entry.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "datetime":
+                for entry in node.names:
+                    if entry.name in ("datetime", "date"):
+                        aliases[entry.asname or entry.name] = \
+                            f"datetime.{entry.name}"
+            elif node.module == "time":
+                for entry in node.names:
+                    if entry.name == "time":
+                        aliases[entry.asname or entry.name] = \
+                            "time.time"
+    return aliases
+
+
+def _canonical_clock_target(target: str, aliases: dict) -> str:
+    head, sep, rest = target.partition(".")
+    canonical = aliases.get(head)
+    if canonical is None:
+        return target
+    return f"{canonical}.{rest}" if sep else canonical
 
 # metric-factory call tails whose labels= dict the label rule inspects
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
@@ -304,6 +364,7 @@ class _Linter(ast.NodeVisitor):
         self.is_test = _is_test_path(path)
         self.handler_names: set = set()
         self.lambda_ids: set = set()
+        self.clock_aliases: dict = {}
         self.lock_depth = 0
 
     # -- waivers -----------------------------------------------------------
@@ -334,6 +395,19 @@ class _Linter(ast.NodeVisitor):
                 "through utils.logger / the observe metrics registry "
                 "(deliberate console output carries a "
                 "`graft: disable=lint-print` waiver)")
+        if not self.is_test and _canonical_clock_target(
+                ast.unparse(node.func),
+                self.clock_aliases) in _WALL_CLOCK_CALLS:
+            self.report(
+                "lint-wall-clock", node,
+                f"{ast.unparse(node.func)}() reads the wall-epoch "
+                f"clock in a package module: use the engine clock "
+                f"(runtime.event.clock.now()) for event/deadline "
+                f"time, time.monotonic()/perf_counter() for "
+                f"durations — wall time breaks virtual-clock "
+                f"determinism and merged flight timelines (calendar-"
+                f"time sites carry a `graft: disable=lint-wall-clock` "
+                f"waiver)")
         if ast.unparse(node.func) == "threading.Lock":
             self.report(
                 "lint-raw-lock", node,
@@ -458,6 +532,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
                         f"syntax error: {exc.msg}")]
     linter = _Linter(path, source)
     linter.handler_names, linter.lambda_ids = _collect_handlers(tree)
+    linter.clock_aliases = _clock_aliases(tree)
     linter.visit(tree)
     return linter.findings
 

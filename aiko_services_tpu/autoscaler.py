@@ -59,7 +59,10 @@ __all__ = ["Autoscaler", "ScalePolicy", "PROTOCOL_AUTOSCALER"]
 PROTOCOL_AUTOSCALER = ServiceProtocol("autoscaler")
 
 # series families the scale loop reads — the intake appends only these
-# (the aggregator keeps full history; the autoscaler needs four)
+# (the aggregator keeps full history; the autoscaler needs four, plus
+# the serving TTFT sketches only when the policy arms that signal —
+# retaining sketch payloads nobody reads would cost per-snapshot copies
+# scaling with fleet size)
 _SIGNAL_FAMILIES = ("event_mailbox_depth", "pipeline_hop_seconds",
                     "batch_mean_wait_ms", "admission_queue_depth")
 
@@ -86,6 +89,13 @@ class ScalePolicy:
     # pre-ISSUE-11 behaviour); a ramp that will cross mailbox_depth_up
     # in a few windows can then add capacity before it does.
     mailbox_trend_up: float | None = None
+    # fleet-true TTFT p95 (seconds) from the MERGED serving sketches
+    # (ISSUE 12): unlike every other signal this is not worst-of-
+    # process — the store merges each runtime's windowed delta sketch,
+    # so the autoscaler scales on the latency the fleet actually
+    # served.  None = signal off.
+    ttft_p95_up: float | None = None
+    ttft_p95_down: float = 0.05
     # staleness/evidence window: a process silent longer than this
     # stops voting (replaces the old _SNAPSHOT_HORIZON), and the
     # underload veto considers the window's worst value
@@ -150,7 +160,14 @@ class Autoscaler(Actor):
             "queue_depth": registry.gauge(
                 "autoscaler_signal_queue_depth",
                 "worst admission fair-queue depth", labels),
+            "ttft_p95": registry.gauge(
+                "autoscaler_signal_ttft_p95_s",
+                "fleet-merged serving TTFT p95 seconds (sketch)",
+                labels),
         }
+        self._families = set(_SIGNAL_FAMILIES)
+        if self.policy.ttft_p95_up is not None:
+            self._families.add("serving_ttft_seconds")
         runtime.add_message_handler(self._metrics_handler, self._filter)
         self._timer = runtime.event.add_timer_handler(self.evaluate,
                                                       self.interval)
@@ -165,7 +182,7 @@ class Autoscaler(Actor):
         self.store.append_snapshot(
             str(document.get("topic_path", topic)),
             document["snapshot"], self.runtime.event.clock.now(),
-            families=_SIGNAL_FAMILIES)
+            families=self._families)
 
     # -- signal extraction --------------------------------------------------
     def _worst(self, family: str, read,
@@ -216,7 +233,23 @@ class Autoscaler(Actor):
             "queue_depth": self._worst(
                 "admission_queue_depth",
                 lambda r: r.latest(now, window)),
+            "ttft_p95": self._merged_ttft_p95(now, window),
         }
+
+    def _merged_ttft_p95(self, now: float, window: float) -> float:
+        """Quantile of the CROSS-SOURCE merged windowed TTFT sketch —
+        fleet-true, not worst-of (ISSUE 12).  baseline_empty for the
+        same reason as hop_p95: one snapshot is still capacity
+        evidence.  Computed only when the policy USES the signal
+        (ttft_p95_up set) — reconstructing and merging every source's
+        delta sketch per evaluate tick is not free, and the default
+        policy ignores the result."""
+        if self.policy.ttft_p95_up is None:
+            return 0.0
+        merged = self.store.merged_sketch(
+            "serving_ttft_seconds", now, window, baseline_empty=True)
+        value = merged.quantile(0.95) if merged is not None else None
+        return float(value) if value is not None else 0.0
 
     def _windowed_quiet(self, signals: dict, now: float) -> bool:
         """The underload veto reads the window's WORST values, not the
@@ -234,7 +267,9 @@ class Autoscaler(Actor):
         return (worst_mailbox <= policy.mailbox_depth_down
                 and signals["hop_p95"] <= policy.hop_p95_down
                 and worst_batch <= policy.batch_wait_down
-                and worst_queue <= policy.queue_depth_down)
+                and worst_queue <= policy.queue_depth_down
+                and (policy.ttft_p95_up is None
+                     or signals["ttft_p95"] <= policy.ttft_p95_down))
 
     # -- the scale loop -----------------------------------------------------
     def _count_decision(self, action: str, reason: str) -> None:
@@ -301,6 +336,7 @@ class Autoscaler(Actor):
         self._signal_gauges["mailbox_trend"].set(
             signals["mailbox_trend"])
         self._signal_gauges["queue_depth"].set(signals["queue_depth"])
+        self._signal_gauges["ttft_p95"].set(signals["ttft_p95"])
         total = len(self.manager.clients)
         self._clients_gauge.set(total)
 
@@ -320,7 +356,9 @@ class Autoscaler(Actor):
             or signals["queue_depth"] >= policy.queue_depth_up
             or (policy.mailbox_trend_up is not None
                 and signals["mailbox_trend"] >=
-                policy.mailbox_trend_up))
+                policy.mailbox_trend_up)
+            or (policy.ttft_p95_up is not None
+                and signals["ttft_p95"] >= policy.ttft_p95_up))
         underload = not overload and self._windowed_quiet(signals, now)
         if overload:
             self._up_streak += 1

@@ -197,6 +197,24 @@ class DashboardState:
         lines.append(f"process: {doc.get('process', '?')}  "
                      f"time: {doc.get('time', '?')}")
         snapshot = doc.get("snapshot", {})
+        # per-tenant SLO rows (ISSUE 12): deadline attainment + merged
+        # sketch p95s lead the pane — the per-series listing below is
+        # forensics, this is the verdict
+        from .observe.journey import tenant_slo_rows
+        rows = tenant_slo_rows([snapshot])
+        if rows:
+            lines.append("  tenant SLO (journeys + merged sketches):")
+            for row in rows:
+                attainment = "-" if row["attainment"] is None else \
+                    f"{row['attainment']:.3f}"
+                ttft = "-" if row["ttft_p95_ms"] is None else \
+                    f"{row['ttft_p95_ms']:.1f}ms"
+                itl = "-" if row["itl_p95_ms"] is None else \
+                    f"{row['itl_p95_ms']:.2f}ms"
+                lines.append(
+                    f"    {row['tenant']:16.16s} met={attainment} "
+                    f"ttft_p95={ttft} itl_p95={itl} "
+                    f"shed={row['shed']} rejected={row['rejected']}")
         for name in sorted(snapshot):
             entry = snapshot[name]
             for series in entry.get("series", []):

@@ -364,8 +364,27 @@ class PE_LlamaAgent(PipelineElement):
                                    self.definition.name,
                                    self._to_outputs(generated))
 
-            self.decoder.submit(f"{frame.stream_id}.{frame.frame_id}",
-                                tokens, self.max_tokens, on_done)
+            # the frame's end-to-end deadline rides the ambient
+            # TraceContext in ENGINE-clock seconds; the decoder's
+            # admission runs on time.monotonic — carry only the
+            # REMAINING budget across the domain boundary (ISSUE 12:
+            # the journey then reports the margin at completion)
+            import time as _time
+            from ..observe.tracing import current_trace
+            context = current_trace()
+            deadline = None
+            if context is not None and context.deadline is not None:
+                remaining = context.remaining(
+                    self.runtime.event.clock.now())
+                if remaining is not None:
+                    deadline = _time.monotonic() + max(0.0, remaining)
+            accepted = self.decoder.submit(
+                f"{frame.stream_id}.{frame.frame_id}", tokens,
+                self.max_tokens, on_done, deadline=deadline)
+            if not accepted:
+                return FrameOutput(False, diagnostic=(
+                    "decoder admission shed: estimated admit wait "
+                    "outruns the remaining deadline budget"))
             return FrameOutput(True, DEFERRED)
 
         prompt = self._pad_prompt(text)

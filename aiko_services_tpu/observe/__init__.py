@@ -6,9 +6,10 @@
 # only framework import allowed here is utils (itself a leaf).
 
 from .metrics import (                                      # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, MirroredStats,
+    Counter, Gauge, Histogram, MetricsRegistry, MirroredStats, Sketch,
     DEFAULT_LATENCY_BUCKETS, default_registry, log_buckets,
 )
+from .sketch import merge_sketches                          # noqa: F401
 from .tracing import (                                      # noqa: F401
     TRACE_MARKER, SpanRecord, TraceContext, Tracer, activate,
     current_trace, new_trace, tracer,
@@ -20,7 +21,11 @@ from .export import (                                       # noqa: F401
 )
 from .series import (                                       # noqa: F401
     ALERT_TOPIC_PREFIX, HealthAggregator, HistogramSeries, SLORule,
-    ScalarSeries, SeriesStore, parse_selector,
+    ScalarSeries, SeriesStore, SketchSeries, parse_selector,
+)
+from .journey import (                                      # noqa: F401
+    JourneyLog, RequestJourney, note_admission, take_admission_note,
+    tenant_slo_rows,
 )
 from .profiler import PhaseProfiler, arm_trace              # noqa: F401
 from .flight import (                                       # noqa: F401

@@ -14,8 +14,12 @@
 from __future__ import annotations
 
 import json
+import random
+import time
+import zlib
 
 from .metrics import Histogram, MetricsRegistry, default_registry
+from .sketch import Sketch
 from .tracing import Tracer, tracer as _global_tracer
 
 __all__ = [
@@ -111,7 +115,11 @@ def render_snapshot_prometheus(snapshot: dict,
         entry = snapshot[name]
         if entry.get("help"):
             lines.append(f"# HELP {name} {entry['help']}")
-        lines.append(f"# TYPE {name} {entry.get('type', 'gauge')}")
+        kind = entry.get("type", "gauge")
+        # sketches render as Prometheus summaries (quantile labels) —
+        # "sketch" is not a text-exposition type
+        lines.append(f"# TYPE {name} "
+                     f"{'summary' if kind == 'sketch' else kind}")
         for series in entry.get("series", []):
             labels = {**series.get("labels", {}),
                       **(extra_labels or {})}
@@ -127,6 +135,23 @@ def render_snapshot_prometheus(snapshot: dict,
                 lines.append(
                     f"{name}_bucket{_label_text(labels, {'le': '+Inf'})} "
                     f"{series.get('count', 0)}")
+                lines.append(f"{name}_sum{_label_text(labels)} "
+                             f"{_format_value(series.get('sum', 0.0))}")
+                lines.append(f"{name}_count{_label_text(labels)} "
+                             f"{series.get('count', 0)}")
+            elif entry.get("type") == "sketch":
+                # Prometheus has no sketch type: expose as a summary
+                # (quantile labels) so scrapers get readable numbers;
+                # the MERGEABLE form lives in the JSON snapshot, not
+                # this lossy text view
+                sketch = Sketch.from_dict(series)
+                for q in (0.5, 0.95, 0.99):
+                    value = sketch.quantile(q) if sketch else None
+                    if value is not None:
+                        lines.append(
+                            f"{name}"
+                            f"{_label_text(labels, {'quantile': str(q)})}"
+                            f" {_format_value(value)}")
                 lines.append(f"{name}_sum{_label_text(labels)} "
                              f"{_format_value(series.get('sum', 0.0))}")
                 lines.append(f"{name}_count{_label_text(labels)} "
@@ -177,24 +202,76 @@ class MetricsPublisher:
 
     Publishes {"process", "topic_path", "time", "snapshot"} as JSON to
     {runtime.topic_path}/0/metrics every `interval` seconds (engine
-    timer, so virtual-clock tests drive it deterministically).  Retained
-    by default: a dashboard opening the pane later still sees the last
-    snapshot, like the process state topic."""
+    timers, so virtual-clock tests drive it deterministically).
+    Retained by default: a dashboard opening the pane later still sees
+    the last snapshot, like the process state topic.
+
+    Interval JITTER (ISSUE 12): a fleet of publishers all constructed
+    at process start with the same interval synchronizes into periodic
+    broker bursts — every runtime serializes its whole registry in the
+    same engine tick.  With `jitter` > 0 each publish reschedules
+    itself as a oneshot at interval × (1 ± jitter), drawn from a
+    SEEDED generator (seed defaults to a hash of the topic, so a
+    process's schedule is reproducible run-to-run while distinct
+    topics decorrelate).  Default 0: windowed-delta tests pin exact
+    cadence; FLEET contexts (the bench wire runtimes, scaled soaks)
+    arm it.  The publish cost itself is observable:
+    `metrics_publish_seconds` gauge (serialize + publish wall)."""
 
     def __init__(self, runtime, interval: float = 5.0,
                  topic: str | None = None,
                  registry: MetricsRegistry | None = None,
-                 retain: bool = True):
+                 retain: bool = True, jitter: float = 0.0,
+                 jitter_seed: int | None = None):
         self.runtime = runtime
         self.registry = registry or default_registry()
         self.topic = topic or \
             f"{runtime.topic_path}/{METRICS_TOPIC_SUFFIX}"
         self.retain = retain
-        self.interval = interval
-        self._timer = runtime.event.add_timer_handler(self.publish_now,
-                                                      interval)
+        self.interval = float(interval)
+        self.jitter = max(0.0, min(float(jitter), 0.9))
+        self._rng = random.Random(
+            jitter_seed if jitter_seed is not None
+            else zlib.crc32(self.topic.encode("utf-8")))
+        # labelled by the runtime's NAME (bounded: a handful of
+        # runtimes per process) — two publishers sharing the process
+        # registry must not overwrite each other's cost reading
+        self._cost_gauge = self.registry.gauge(
+            "metrics_publish_seconds",
+            "wall seconds the last snapshot publish cost "
+            "(serialize + publish)",
+            labels={"publisher": str(getattr(runtime, "name", None)
+                                     or "metrics")})
+        self._timer = None
+        self._stopped = False
+        if self.jitter:
+            # jittered publishers re-arm a ONESHOT per publish (each
+            # delay drawn fresh); unjittered ones keep the periodic
+            # timer, whose heap reschedule (due += period) is EXACT —
+            # the windowed-delta tests pin that cadence
+            self._schedule()
+        else:
+            self._timer = runtime.event.add_timer_handler(
+                self.publish_now, self.interval)
+
+    def _next_delay(self) -> float:
+        return self.interval * (
+            1.0 + self._rng.uniform(-self.jitter, self.jitter))
+
+    def _schedule(self) -> None:
+        self._timer = self.runtime.event.add_oneshot_handler(
+            self._tick, self._next_delay())
+
+    def _tick(self) -> None:
+        self._timer = None
+        try:
+            self.publish_now()
+        finally:
+            if not self._stopped:
+                self._schedule()
 
     def publish_now(self) -> None:
+        started = time.perf_counter()
         document = {
             "process": self.runtime.name,
             "topic_path": self.runtime.topic_path,
@@ -204,8 +281,10 @@ class MetricsPublisher:
         self.runtime.publish(self.topic,
                              json.dumps(document, default=str),
                              retain=self.retain)
+        self._cost_gauge.set(time.perf_counter() - started)
 
     def stop(self) -> None:
+        self._stopped = True
         if self._timer is not None:
             self.runtime.event.remove_timer_handler(self._timer)
             self._timer = None

@@ -315,11 +315,16 @@ def merge(recorder_list=None) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def dump(pathname, recorder_list=None, reason: str = "") -> str:
-    """Write the merged timeline to `pathname`; returns the path."""
+def dump(pathname, recorder_list=None, reason: str = "",
+         metadata: dict | None = None) -> str:
+    """Write the merged timeline to `pathname`; returns the path.
+    `metadata` merges extra keys into the document's metadata block —
+    DumpOnAlert ships the firing alert's exemplar trace ids there, so
+    the artifact itself names which traces to open first."""
     document = merge(recorder_list)
-    if reason:
-        document["metadata"] = {"reason": reason}
+    if reason or metadata:
+        document["metadata"] = {**({"reason": reason} if reason
+                                   else {}), **(metadata or {})}
     with open(pathname, "w", encoding="utf-8") as f:
         json.dump(document, f)
     _logger.info("flight recorder dump -> %s (%d events%s)", pathname,
@@ -345,5 +350,9 @@ class DumpOnAlert:
         if name in self.dumped:
             return None
         pathname = f"{self.directory}/{self.prefix}_{name}.json"
-        self.dumped[name] = dump(pathname, reason=f"slo-breach:{name}")
+        exemplars = (record or {}).get("exemplars") or []
+        self.dumped[name] = dump(
+            pathname, reason=f"slo-breach:{name}",
+            metadata={"exemplars": list(exemplars)} if exemplars
+            else None)
         return self.dumped[name]

@@ -27,11 +27,13 @@
 
 from __future__ import annotations
 
+from .sketch import Sketch
 from ..utils.lock import Lock
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MirroredStats",
-    "default_registry", "log_buckets", "DEFAULT_LATENCY_BUCKETS",
+    "Counter", "Gauge", "Histogram", "Sketch", "MetricsRegistry",
+    "MirroredStats", "default_registry", "log_buckets",
+    "DEFAULT_LATENCY_BUCKETS",
 ]
 
 
@@ -136,7 +138,8 @@ class Histogram:
         return self.bounds[-1]
 
 
-_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram,
+          "sketch": Sketch}
 
 
 class MetricsRegistry:
@@ -195,6 +198,15 @@ class MetricsRegistry:
         return self._get_or_create("histogram", name, help, labels,
                                    buckets=buckets)
 
+    def sketch(self, name: str, help: str = "",
+               labels: dict | None = None, **kwargs) -> Sketch:
+        """Mergeable DDSketch-style quantile sketch (observe/sketch.py):
+        relative-error quantiles that MERGE across processes, with
+        top-k worst trace-id exemplars — the family the serving TTFT /
+        ITL surfaces live in (ISSUE 12)."""
+        return self._get_or_create("sketch", name, help, labels,
+                                   **kwargs)
+
     def value(self, name: str, labels: dict | None = None, default=0):
         """Read one series' current value without creating it."""
         metric = self._metrics.get(self._key(name, labels))
@@ -232,6 +244,9 @@ class MetricsRegistry:
                     "labels": labels, "bounds": list(metric.bounds),
                     "counts": list(metric.counts),
                     "sum": metric.sum, "count": metric.count})
+            elif isinstance(metric, Sketch):
+                entry["series"].append({"labels": labels,
+                                        **metric.to_dict()})
             else:
                 entry["series"].append({"labels": labels,
                                         "value": metric.value})

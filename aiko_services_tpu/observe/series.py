@@ -43,11 +43,13 @@ from dataclasses import dataclass
 
 from .export import METRICS_TOPIC_SUFFIX, parse_retained_json
 from .metrics import MetricsRegistry, default_registry
+from .sketch import Sketch, merge_sketches
 from ..utils import get_logger
 
 __all__ = [
-    "ScalarSeries", "HistogramSeries", "SeriesStore", "SLORule",
-    "HealthAggregator", "parse_selector", "ALERT_TOPIC_PREFIX",
+    "ScalarSeries", "HistogramSeries", "SketchSeries", "SeriesStore",
+    "SLORule", "HealthAggregator", "parse_selector",
+    "ALERT_TOPIC_PREFIX",
 ]
 
 ALERT_TOPIC_PREFIX = "alert"
@@ -198,6 +200,65 @@ class HistogramSeries:
         return sum(counts) if counts else 0
 
 
+class SketchSeries:
+    """Bounded ring of (t, sketch payload dict) samples for one
+    mergeable quantile sketch series (observe/sketch.py).  The payload
+    is the cumulative to_dict() form straight off the snapshot;
+    windowed reads reconstruct a DELTA sketch from the newest/oldest
+    pair (bin-count subtraction — same anti-contamination discipline
+    as HistogramSeries), and the store merges delta sketches ACROSS
+    SOURCES so a level rule reads one fleet-true quantile instead of
+    worst-of-per-process (ISSUE 12)."""
+    __slots__ = ("name", "labels", "points")
+
+    def __init__(self, name: str, labels: dict,
+                 maxlen: int = DEFAULT_RING_SAMPLES):
+        self.name = name
+        self.labels = dict(labels)
+        self.points: deque = deque(maxlen=maxlen)
+
+    def append(self, t: float, payload: dict) -> None:
+        self.points.append((float(t), dict(payload)))
+
+    def _window(self, now: float, window: float) -> list:
+        cutoff = now - window
+        return [(t, p) for t, p in self.points if t >= cutoff]
+
+    def delta_sketch(self, now: float, window: float,
+                     baseline_empty: bool = False) -> Sketch | None:
+        """The window's worth of observations as a fresh Sketch, or
+        None without two samples (a baseline is not a delta; the same
+        rule ScalarSeries.delta applies).  Exemplars keep only entries
+        whose seq postdates the window-start count — clock-free window
+        filtering (sketch.py module doc)."""
+        points = self._window(now, window)
+        if not points:
+            return None
+        if len(points) < 2:
+            return Sketch.from_dict(points[-1][1], self.name,
+                                    self.labels) \
+                if baseline_empty else None
+        newest = Sketch.from_dict(points[-1][1], self.name, self.labels)
+        oldest = Sketch.from_dict(points[0][1])
+        if newest is None:
+            return None
+        if oldest is None or abs(oldest.gamma - newest.gamma) > 1e-12:
+            return newest if baseline_empty else None
+        delta = Sketch(self.name, self.labels, alpha=newest.alpha,
+                       exemplar_k=max(newest.exemplar_k,
+                                      len(newest.exemplars) or 1))
+        delta.bins = {
+            index: count - oldest.bins.get(index, 0)
+            for index, count in newest.bins.items()
+            if count - oldest.bins.get(index, 0) > 0}
+        delta.zero = max(0, newest.zero - oldest.zero)
+        delta.count = delta.zero + sum(delta.bins.values())
+        delta.sum = max(0.0, newest.sum - oldest.sum)
+        delta.exemplars = [list(e) for e in newest.exemplars
+                           if e[2] > oldest.count]
+        return delta
+
+
 class SeriesStore:
     """Per-(source, series) history over registry snapshots.
 
@@ -284,6 +345,28 @@ class SeriesStore:
             ring.append(t, counts)
             self._newest[source] = t
 
+    def append_sketch(self, source: str, name: str, labels: dict,
+                      t: float, payload: dict,
+                      seed_zero_t: float | None = None) -> None:
+        key = self._key(source, name, labels)
+        new_series = key not in self._series
+        ring = self._get(source, name, labels,
+                         lambda: SketchSeries(name, labels,
+                                              self.ring_samples),
+                         SketchSeries)
+        if ring is not None:
+            if new_series and seed_zero_t is not None:
+                # empty cumulative payload at the previous snapshot
+                # time — the same birth-seeding rule as counters: a
+                # sketch born mid-flight from a known source counts its
+                # first burst as the delta it is
+                ring.append(seed_zero_t,
+                            {"alpha": payload.get("alpha"), "bins": {},
+                             "zero": 0, "count": 0, "sum": 0.0,
+                             "exemplars": []})
+            ring.append(t, payload)
+            self._newest[source] = t
+
     def append_snapshot(self, source: str, snapshot: dict, t: float,
                         families=None) -> int:
         """Append every series of one MetricsRegistry.snapshot()
@@ -310,6 +393,12 @@ class SeriesStore:
                         self.append_histogram(source, name, labels, t,
                                               bounds, counts,
                                               seed_zero_t=seed_zero_t)
+                        appended += 1
+                elif kind == "sketch":
+                    if "bins" in series:
+                        self.append_sketch(source, name, labels, t,
+                                           series,
+                                           seed_zero_t=seed_zero_t)
                         appended += 1
                 elif "value" in series:
                     self.append_scalar(source, name, labels, t,
@@ -362,22 +451,98 @@ class SeriesStore:
         for _, ring in self.rings(name, labels):
             if isinstance(ring, HistogramSeries):
                 total += ring.delta_count(now, window)
+            elif isinstance(ring, SketchSeries):
+                delta = ring.delta_sketch(now, window)
+                total += delta.count if delta is not None else 0
             else:
                 total += max(0.0, ring.delta(now, window))
         return total
 
-    def selector_level(self, selector: str, now: float, window: float):
+    def sketch_window(self, selector: str, now: float, window: float,
+                      baseline_empty: bool = False) -> list:
+        """Every matching SketchSeries' windowed delta sketch:
+        [(source, Sketch), ...] — the ONE reconstruction pass both the
+        merged quantile and the exemplar read derive from (a
+        continuously breaching rule must not rebuild every source's
+        delta twice per evaluation tick)."""
+        name, labels, _ = parse_selector(selector)
+        out = []
+        for source, ring in self.rings(name, labels):
+            if not isinstance(ring, SketchSeries):
+                continue
+            delta = ring.delta_sketch(now, window, baseline_empty)
+            if delta is not None:
+                out.append((source, delta))
+        return out
+
+    def merged_sketch(self, selector: str, now: float,
+                      window: float,
+                      baseline_empty: bool = False) -> Sketch | None:
+        """ONE windowed sketch merging every matching SketchSeries
+        across every source — the fleet-true quantile surface (ISSUE
+        12): merged(A, B) equals one-sketch(A ∪ B) by construction, so
+        a level rule over this reads the latency distribution the
+        FLEET served, not the worst process's.  None when no source
+        has windowed evidence."""
+        return merge_sketches(
+            delta for _, delta in self.sketch_window(
+                selector, now, window, baseline_empty))
+
+    def selector_exemplars(self, selector: str, now: float,
+                           window: float, k: int = 8,
+                           deltas: list | None = None) -> list:
+        """Worst-first windowed exemplars across every matching sketch
+        series: [{"trace_id", "value", "source"}, ...] — the trace ids
+        a firing alert points at (metrics → traces).  Pass `deltas`
+        (a sketch_window result) to reuse an already-built pass."""
+        if deltas is None:
+            deltas = self.sketch_window(selector, now, window)
+        entries = []
+        for source, delta in deltas:
+            for value, exemplar_id, _seq in delta.worst_exemplars(k):
+                entries.append({"trace_id": exemplar_id,
+                                "value": value, "source": source})
+        entries.sort(key=lambda e: -e["value"])
+        # one entry per trace id: the same request may be the worst in
+        # several windows/series
+        seen, unique = set(), []
+        for entry in entries:
+            if entry["trace_id"] in seen:
+                continue
+            seen.add(entry["trace_id"])
+            unique.append(entry)
+        return unique[:k]
+
+    def selector_level(self, selector: str, now: float, window: float,
+                       sketch_deltas: list | None = None):
         """Worst (max) windowed value across matching series: histogram
         selectors read the windowed delta-quantile (default p95),
-        scalars the windowed maximum.  None = no evidence in window."""
+        scalars the windowed maximum, and SKETCH selectors the
+        quantile of the cross-source MERGED windowed sketch (fleet-true
+        rather than worst-of).  None = no evidence in window.  Pass
+        `sketch_deltas` (a sketch_window result) to reuse an
+        already-built reconstruction pass."""
         name, labels, quantile = parse_selector(selector)
         worst = None
+        sketch_rings = False
         for _, ring in self.rings(name, labels):
+            if isinstance(ring, SketchSeries):
+                sketch_rings = True
+                continue
             if isinstance(ring, HistogramSeries):
                 value = ring.delta_quantile(quantile or 0.95, now,
                                             window)
             else:
                 value = ring.maximum(now, window)
+            if value is not None and (worst is None or value > worst):
+                worst = value
+        if sketch_rings or sketch_deltas:
+            if sketch_deltas is None:
+                sketch_deltas = self.sketch_window(selector, now,
+                                                   window)
+            merged = merge_sketches(d for _, d in sketch_deltas)
+            value = merged.quantile(quantile or 0.95) \
+                if merged is not None else None
             if value is not None and (worst is None or value > worst):
                 worst = value
         return worst
@@ -451,11 +616,27 @@ class SLORule:
                     breaching = True
             return {"breaching": breaching, "kind": "ratio",
                     "objective": self.objective, "windows": burns}
-        value = store.selector_level(self.series, now, self.window)
-        return {"breaching": value is not None and
-                value >= self.threshold,
-                "kind": "level", "value": value,
-                "threshold": self.threshold, "window_s": self.window}
+        # ONE delta-sketch reconstruction per tick: the level read and
+        # the exemplar read share it
+        deltas = store.sketch_window(self.series, now, self.window)
+        value = store.selector_level(self.series, now, self.window,
+                                     sketch_deltas=deltas)
+        verdict = {"breaching": value is not None and
+                   value >= self.threshold,
+                   "kind": "level", "value": value,
+                   "threshold": self.threshold,
+                   "window_s": self.window}
+        if verdict["breaching"]:
+            # sketch-backed selectors carry the worst windowed
+            # exemplars — the trace ids BEHIND the breaching quantile
+            # (ISSUE 12: alert → journeys closed loop); empty for
+            # histogram/scalar series, which retain no identities
+            exemplars = store.selector_exemplars(self.series, now,
+                                                 self.window,
+                                                 deltas=deltas)
+            if exemplars:
+                verdict["exemplars"] = exemplars
+        return verdict
 
 
 class HealthAggregator:
@@ -571,6 +752,12 @@ class HealthAggregator:
                         "since": state["breach_since"], "time": now,
                         "description": rule.description,
                         "detail": verdict,
+                        # exemplar trace ids hoisted top-level so every
+                        # consumer (Recorder, DumpOnAlert, an operator
+                        # reading the retained record) finds them
+                        # without knowing the verdict schema
+                        "exemplars": [e["trace_id"] for e in
+                                      verdict.get("exemplars", [])],
                     }
                     self.alerts[rule.name] = record
                     self.fired[rule.name] = \

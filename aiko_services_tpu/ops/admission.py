@@ -58,7 +58,7 @@ class TenantPolicy:
 class _TenantState:
     name: str
     policy: TenantPolicy
-    items: deque            # (item, shed_callable, cost)
+    items: deque            # (item, shed_callable, cost, enqueued_t)
     deficit: float = 0.0
     depth_gauge: object = None
 
@@ -79,7 +79,8 @@ class TenantFairQueue:
                  global_budget: int | None = None,
                  quantum: float = 1.0,
                  registry: MetricsRegistry | None = None,
-                 metrics_labels: dict | None = None):
+                 metrics_labels: dict | None = None,
+                 clock: Callable | None = None):
         self._policies = dict(policies or {})
         self._default_policy = default_policy or TenantPolicy()
         self.base_budget = max(1, int(base_budget))
@@ -91,6 +92,26 @@ class TenantFairQueue:
         self._registry = registry or default_registry()
         self._labels = dict(metrics_labels or {})
         self._counter_cache: dict = {}
+        # MEASURED per-tenant queue dwell (ISSUE 12): with a clock
+        # (callable → seconds; the pipeline passes the engine clock so
+        # virtual-clock tests stay deterministic) every drained item
+        # observes (dispatch - enqueue) into
+        # admission_queue_wait_seconds{tenant} — the number the
+        # request journey records, where the gate's estimated_wait is
+        # only a forecast.  last_dispatch_wait exposes the most recent
+        # measurement to the dispatch callback (drain calls dispatch
+        # synchronously right after observing), so callers record ONE
+        # dwell, not a parallel re-measurement.
+        self._clock = clock
+        self._wait_histograms: dict = {}
+        self.last_dispatch_wait: float | None = None
+
+    def set_clock(self, clock: Callable) -> None:
+        """Install a dwell clock unless the builder already chose one
+        — how the Pipeline hands its engine clock to an externally
+        constructed gate."""
+        if self._clock is None:
+            self._clock = clock
 
     # -- metrics -----------------------------------------------------------
     def _count(self, family: str, tenant: str, tier: int,
@@ -145,7 +166,9 @@ class TenantFairQueue:
             if shed is not None:
                 shed(item)
             return False
-        state.items.append((item, shed, float(cost)))
+        state.items.append((item, shed, float(cost),
+                            self._clock() if self._clock is not None
+                            else None))
         state.depth_gauge.set(len(state.items))
         if self.global_budget is not None and \
                 self.depth() > self.global_budget:
@@ -165,7 +188,7 @@ class TenantFairQueue:
         if worst is None:
             return None
         state = self._tenants[worst]
-        item, shed, _ = state.items.pop()          # newest-first
+        item, shed, _, _ = state.items.pop()       # newest-first
         state.depth_gauge.set(len(state.items))
         self._count("shed", worst, state.policy.tier,
                     "global-over-budget")
@@ -195,11 +218,17 @@ class TenantFairQueue:
                     while state.items and \
                             state.deficit >= state.items[0][2] and \
                             (limit is None or released < limit):
-                        item, _, cost = state.items.popleft()
+                        item, _, cost, enqueued_t = \
+                            state.items.popleft()
                         state.deficit -= cost
                         state.depth_gauge.set(len(state.items))
                         self._count("admitted", state.name,
                                     state.policy.tier, "queued")
+                        if enqueued_t is not None:
+                            self._observe_wait(state.name,
+                                               enqueued_t)
+                        else:
+                            self.last_dispatch_wait = None
                         dispatch(item)
                         released += 1
                         progressed = True
@@ -209,6 +238,18 @@ class TenantFairQueue:
                 if not progressed:
                     break
         return released
+
+    def _observe_wait(self, tenant: str, enqueued_t: float) -> None:
+        histogram = self._wait_histograms.get(tenant)
+        if histogram is None:
+            histogram = self._registry.histogram(
+                "admission_queue_wait_seconds",
+                "measured fair-queue dwell per drained frame",
+                labels={**self._labels, "tenant": tenant})
+            self._wait_histograms[tenant] = histogram
+        wait = max(0.0, self._clock() - enqueued_t)
+        self.last_dispatch_wait = wait
+        histogram.observe(wait)
 
     def depth(self, tenant: str | None = None) -> int:
         if tenant is not None:
@@ -222,7 +263,7 @@ class TenantFairQueue:
         count = 0
         for state in self._tenants.values():
             while state.items:
-                item, shed, _ = state.items.pop()
+                item, shed, _, _ = state.items.pop()
                 self._count("shed", state.name, state.policy.tier,
                             reason)
                 if shed is not None:

@@ -709,6 +709,11 @@ class Pipeline(PipelineElement):
             # slowly and exits immediately on an empty queue
             self._admission_timer = runtime.event.add_timer_handler(
                 self._drain_admission, 0.05)
+            # give the fair queue this runtime's engine clock (unless
+            # the builder provided one) so every drained frame observes
+            # its MEASURED dwell into admission_queue_wait_seconds —
+            # the number request journeys carry (ISSUE 12)
+            admission.queue.set_clock(runtime.event.clock.now)
         self._create_elements()
         self._precompute_schedule()
         self.ec_producer.update("element_count", len(self.graph))
@@ -1723,11 +1728,22 @@ class Pipeline(PipelineElement):
                          context, tenant_name, tier)
 
     def _serve_walk(self, key, stream_id, inputs, context, tenant,
-                    tier) -> None:
+                    tier, verdict: str = "admitted",
+                    queue_wait: float | None = None) -> None:
         """Run one admitted remote request's walk.  The tenant tag is
         stamped into the stream's parameters at creation, so elements
         and nested pipelines see it through get_parameter and further
-        hops re-ship it (ISSUE 9)."""
+        hops re-ship it (ISSUE 9).  The admission verdict and measured
+        fair-queue wait are posted as a journey note under the frame's
+        trace id BEFORE the walk runs — a ContinuousDecoder reached
+        synchronously inside this walk claims them into its
+        RequestJourney (ISSUE 12; engine-clock seconds, bounded
+        handoff, no coupling between ops/ and serving/)."""
+        if context is not None and context.trace_id:
+            from .observe.journey import note_admission
+            note_admission(context.trace_id, verdict,
+                           queue_wait_s=queue_wait, tenant=tenant,
+                           tier=tier)
         if tenant and self.auto_create_streams and \
                 stream_id not in self.streams:
             self.create_stream(stream_id,
@@ -1747,7 +1763,14 @@ class Pipeline(PipelineElement):
     # -- admission gate plumbing (ISSUE 9) ----------------------------------
     def _run_admitted(self, item) -> None:
         key, stream_id, inputs, context, tenant, tier = item
-        self._serve_walk(key, stream_id, inputs, context, tenant, tier)
+        # the fair queue measured this frame's dwell as it drained it
+        # (synchronously, just before this dispatch) — ONE measurement
+        # feeds both the admission_queue_wait_seconds histogram and
+        # the journey note
+        queue_wait = self.admission.queue.last_dispatch_wait \
+            if self.admission is not None else None
+        self._serve_walk(key, stream_id, inputs, context, tenant, tier,
+                         verdict="admitted", queue_wait=queue_wait)
 
     def _shed_admitted(self, item) -> None:
         """Fair-queue shed: the frame never ran — answer its caller so

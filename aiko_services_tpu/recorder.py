@@ -105,6 +105,15 @@ class Recorder(Actor):
         """Latest alert record per rule (firing or resolved)."""
         return dict(self.alerts)
 
+    def alert_exemplars(self) -> dict:
+        """Exemplar trace ids per FIRING rule (ISSUE 12): the requests
+        behind each breaching quantile — the ids to grep a flight dump
+        (or this recorder's log rings) for."""
+        return {rule: list(record.get("exemplars", []))
+                for rule, record in self.alerts.items()
+                if record.get("state") == "firing"
+                and record.get("exemplars")}
+
     def tail(self, topic: str, count: int = 16) -> list:
         ring = self.buffers.get(topic)
         return list(ring)[-count:] if ring else []

@@ -214,6 +214,14 @@ class DecodeRequest:
     # the slot's KV cache; prefilling=True while chunks remain
     prefill_pos: int = 0
     prefilling: bool = False
+    # request journey (ISSUE 12): per-request lifecycle record — the
+    # admission verdict, queue/prefill timeline, bounded per-token tick
+    # ring, deadline margin — correlated to the frame's TraceContext
+    # (observe/journey.py).  None only when journeys are disabled.
+    journey: object = None
+    # end-to-end completion deadline (scheduler clock) as passed to
+    # submit(); the journey reports the margin at completion against it
+    deadline: float | None = None
 
 
 def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
@@ -865,7 +873,7 @@ class ContinuousDecoder:
                  fuse_projections: bool = False,
                  kv_cache_dtype: str | None = None,
                  speculate_k: int = 0, speculate_ngram: int = 2,
-                 name: str = "decoder"):
+                 name: str = "decoder", registry=None):
         self.config = config
         # int8 KV cache (ISSUE 7): the slot caches store int8 values
         # with per-(slot, head, position) f32 scales
@@ -1033,9 +1041,21 @@ class ContinuousDecoder:
         # them — the roofline gap decomposes instead of being one
         # opaque overhead number.  Always on: the mark API is one
         # perf_counter read per boundary.
-        from .observe.metrics import MirroredStats
+        from .observe.journey import JourneyLog
+        from .observe.metrics import MirroredStats, default_registry
         from .observe.profiler import PhaseProfiler
         self.profiler = PhaseProfiler(name)
+        self._registry = registry or default_registry()
+        # request journeys + mergeable SLO sketches (ISSUE 12): every
+        # request gets a RequestJourney correlated to the ambient
+        # TraceContext, and TTFT/ITL observations land in per-tenant
+        # DDSketch families (serving_{ttft,itl}_seconds{decoder,tenant})
+        # whose retained-snapshot form MERGES across processes — the
+        # fleet-true percentile surface the health plane alerts on,
+        # with the worst requests' trace ids as exemplars.
+        self.journeys = JourneyLog(name=name, proc=name,
+                                   registry=self._registry)
+        self._slo_sketches: dict = {}
         self.stats = MirroredStats(
             {"steps": 0, "rounds": 0, "completed": 0,
              "prefills": 0, "occupancy_sum": 0.0,
@@ -1052,6 +1072,7 @@ class ContinuousDecoder:
             # levels and time-sums stay dict-only: a high-water mark or
             # a seconds accumulator inside an events-by-kind counter
             # family would make rate()/sum() over the family meaningless
+            registry=self._registry,
             skip=("occupancy_sum", "prefill_s", "decode_s",
                   "accepted_per_step", "round_prefill_tokens_max"))
         # SLO samples (seconds): TTFT per request, mean inter-token
@@ -1084,20 +1105,66 @@ class ContinuousDecoder:
         return self._round_ewma * \
             (1.0 + (waiting - free + 1) / max(1, self.max_slots))
 
+    def _slo_sketch(self, kind: str, tenant: str):
+        """Per-(kind, tenant) mergeable SLO sketch, lazily registered:
+        serving_{kind}_seconds{decoder, tenant} (ISSUE 12).  Tenant is
+        a BOUNDED label (tenant names come from serving policy, not
+        request identity — lint-metric-label's discipline)."""
+        key = (kind, tenant)
+        sketch = self._slo_sketches.get(key)
+        if sketch is None:
+            sketch = self._registry.sketch(
+                f"serving_{kind}_seconds",
+                f"per-request {kind} seconds (mergeable quantile "
+                f"sketch with worst-request trace-id exemplars)",
+                labels={"decoder": self.journeys.name,
+                        "tenant": tenant or "default"})
+            self._slo_sketches[key] = sketch
+        return sketch
+
     def submit(self, request_id: str, prompt, max_new_tokens: int,
                callback, deadline: float | None = None) -> bool:
         """Enqueue one request; returns False when deadline-aware
         admission rejected it instead (the callback is NOT invoked —
         the caller owns the refusal).  `deadline` (absolute,
-        time.monotonic seconds) is the request's first-token target: a
-        request whose deadline cannot survive the estimated admit wait
-        is refused NOW, so the caller fails over or degrades instead of
-        queueing doomed work (ISSUE 9)."""
+        time.monotonic seconds) is the request's END-TO-END completion
+        target — the frame deadline the serving walk carries, crossed
+        into this clock domain (PE_LlamaAgent does the conversion).
+        Admission uses the estimated admit wait (a time-to-FIRST-token
+        bound) as its necessary condition: a request that cannot even
+        reach its first token inside the budget is refused NOW, so the
+        caller fails over or degrades instead of queueing doomed work
+        (ISSUE 9); the journey's deadline margin is judged at
+        completion against the same end-to-end target (ISSUE 12).
+
+        Every submission opens a RequestJourney (ISSUE 12) correlated
+        to the AMBIENT TraceContext — the serving walk runs under the
+        caller's context, so the journey's spans join the same trace as
+        the wire hop — and claims the pipeline admission note (verdict
+        + measured fair-queue wait) posted for that trace id."""
+        from .observe.journey import RequestJourney, take_admission_note
+        from .observe.tracing import current_trace
+        now = time.monotonic()
+        context = current_trace()
+        note = take_admission_note(context.trace_id) \
+            if context is not None else None
+        journey = RequestJourney(
+            request_id, now,
+            trace_id=context.trace_id if context is not None else "",
+            parent_span_id=context.span_id
+            if context is not None else "",
+            tenant=(note or {}).get("tenant", ""),
+            tier=(note or {}).get("tier", 1),
+            deadline=deadline,
+            admission_verdict=(note or {}).get("verdict", ""),
+            admission_wait_s=(note or {}).get("queue_wait_s"),
+            prompt_tokens=len(prompt))
         if deadline is not None:
             wait = self.estimated_admit_wait()
-            if wait is not None and \
-                    time.monotonic() + wait >= float(deadline):
+            if wait is not None and now + wait >= float(deadline):
                 self.stats["admission_shed"] += 1
+                self.journeys.finish(journey, time.monotonic(),
+                                     outcome="shed")
                 return False
         # keep the TAIL on overflow (recent context matters most).
         # Without chunked prefill the largest bucket is a hard cap (an
@@ -1112,7 +1179,7 @@ class ContinuousDecoder:
         prompt = ([int(t) for t in prompt] or [0])[-limit:]
         self._pending.append(DecodeRequest(
             request_id, prompt, int(max_new_tokens), callback,
-            submit_time=time.monotonic()))
+            submit_time=now, journey=journey, deadline=deadline))
         return True
 
     def attach(self, engine, period: float = 0.002) -> int:
@@ -1262,6 +1329,8 @@ class ContinuousDecoder:
             self.stats["tokens_prefill"] += max(
                 0, new_pos - request.prefill_pos)
             request.prefill_pos = new_pos
+            if request.journey is not None:
+                request.journey.wave("extend")
             if finish:
                 request.prefilling = False
                 request.generated = []    # first token owed (wave)
@@ -1373,6 +1442,7 @@ class ContinuousDecoder:
                 groups.setdefault(bucket, []).append(request)
             taken += 1
         del self._pending[:taken]
+        admit_t = time.monotonic() if (chunked or groups) else 0.0
         for request in chunked:
             slot = free.pop(0)
             request.slot = slot
@@ -1380,6 +1450,8 @@ class ContinuousDecoder:
             request.prefill_pos = 0
             self._slots[slot] = request
             self.stats["chunk_admits"] += 1
+            if request.journey is not None:
+                request.journey.admitted(admit_t, slot, "chunk-admit")
         if not groups:
             return
         # grow-only here (admits scatter [:bucket]); the round planner
@@ -1433,12 +1505,15 @@ class ContinuousDecoder:
             self._param_bytes +
             width * bucket * self._kv_bytes_per_t // self.max_slots)
         wave = []
+        admit_t = time.monotonic()
         for j, request in enumerate(chunk):
             request.slot = slots[j]
             request.generated = []            # first token pending
             self._slots[slots[j]] = request
             self.stats["prefills"] += 1
             self.stats["tokens_prefill"] += len(request.prompt)
+            if request.journey is not None:
+                request.journey.admitted(admit_t, slots[j], "admit")
             wave.append((j, request))
         self._admit_waves.append((firsts, wave))
 
@@ -1450,14 +1525,25 @@ class ContinuousDecoder:
 
     def _retire(self, slot: int) -> None:
         request = self._slots[slot]
+        journey = request.journey
         self._slots[slot] = None
         self.stats["completed"] += 1
         count = len(request.generated)
         if count >= 2 and request.last_time > request.first_time:
-            self.itl_samples.append(
-                (request.last_time - request.first_time) / (count - 1))
+            itl = (request.last_time - request.first_time) / (count - 1)
+            self.itl_samples.append(itl)
+            self._slo_sketch(
+                "itl", journey.tenant if journey else "").observe(
+                itl, exemplar=(journey.trace_id or request.request_id)
+                if journey else None)
         if request.max_gap > 0:
             self.gap_samples.append(request.max_gap)
+        if journey is not None:
+            # completion closes the journey: deadline margin computed,
+            # outcome counted per tenant, spans emitted under the
+            # frame's trace id (flight-dumpable)
+            self.journeys.finish(journey, request.last_time
+                                 or time.monotonic())
         generated = request.generated
         if self.eos_token is not None and generated and \
                 generated[-1] == self.eos_token:
@@ -1708,12 +1794,23 @@ class ContinuousDecoder:
         stall metric is the worst gap BETWEEN bursts (same-burst tokens
         contribute no gap)."""
         request = self._slots[slot]
+        journey = request.journey
         if not request.generated:
             request.first_time = now
-            self.ttft_samples.append(now - request.submit_time)
+            ttft = now - request.submit_time
+            self.ttft_samples.append(ttft)
+            # mergeable SLO surface (ISSUE 12): the same number the
+            # deque keeps, but fleet-mergeable and carrying the worst
+            # requests' trace ids as exemplars
+            self._slo_sketch(
+                "ttft", journey.tenant if journey else "").observe(
+                ttft, exemplar=(journey.trace_id or request.request_id)
+                if journey else None)
         elif now > request.last_time:
             request.max_gap = max(request.max_gap,
                                   now - request.last_time)
+        if journey is not None:
+            journey.token(now)
         request.generated.append(token)
         request.last_time = now
         if self._finished(request, token):
@@ -1736,6 +1833,36 @@ class ContinuousDecoder:
             "ttft_count": len(self.ttft_samples),
             "itl_count": len(self.itl_samples),
         }
+
+    def slo_sketch_stats(self) -> dict:
+        """The SAME latency SLOs as slo_stats, but read from the
+        mergeable sketches (ISSUE 12): p50/p95/p99 per kind merged
+        across this decoder's tenants, plus the worst exemplar ids.
+        This is the form the bench artifact quotes (lat_llama_ttft_*)
+        — fleet-aggregatable, with per-request attribution behind
+        every percentile."""
+        from .observe.sketch import merge_sketches
+        out: dict = {}
+        for kind in ("ttft", "itl"):
+            merged = merge_sketches(
+                sketch for (sketch_kind, _), sketch in
+                self._slo_sketches.items() if sketch_kind == kind)
+            for q, suffix in ((0.5, "p50"), (0.95, "p95"),
+                              (0.99, "p99")):
+                value = merged.quantile(q) if merged is not None \
+                    else None
+                out[f"{kind}_{suffix}_ms"] = \
+                    None if value is None else value * 1000.0
+            out[f"{kind}_exemplars"] = [] if merged is None else \
+                [e[1] for e in merged.worst_exemplars(4)]
+        return out
+
+    def clear_slo_sketches(self) -> None:
+        """Drop sketch observations and exemplars (bench warmup
+        boundary — compile-time TTFTs must not contaminate the
+        measured percentiles, same rule as the sample deques)."""
+        for sketch in self._slo_sketches.values():
+            sketch.clear()
 
     def wasted_fraction(self) -> float:
         total = self.stats["useful_steps"] + self.stats["wasted_steps"]

@@ -586,10 +586,29 @@ class PipelineBench:
         # when several frames of a stream are in flight.  Shared by the
         # wire-mode subclass so both rungs measure identically.
         import collections
+
+        from aiko_services_tpu.observe import default_registry
         self._post_times = collections.defaultdict(collections.deque)
         self._latencies: list[float] = []
         self._posted = 0
         self._completed = 0
+        # mergeable round-latency sketch (ISSUE 12): the same post →
+        # completion wall the _latencies list keeps, in the fleet-
+        # aggregatable form — lat_wire_round_* percentiles derive from
+        # it, exemplar ids name the worst rounds' streams
+        self.round_sketch = default_registry().sketch(
+            "wire_round_seconds",
+            "bench frame post -> completion wall (mergeable sketch)",
+            labels={"bench": "wire"})
+
+    def round_sketch_quantiles(self) -> dict:
+        """{p50_ms, p95_ms} of the CURRENT rung's sketch (callers
+        clear() it at rung boundaries, like recent_waits)."""
+        out = {}
+        for q, suffix in ((0.5, "p50_ms"), (0.95, "p95_ms")):
+            value = self.round_sketch.quantile(q)
+            out[suffix] = None if value is None else value * 1000.0
+        return out
 
     def _ensure_streams(self, n: int) -> None:
         # membership check, not a high-water counter: a transient
@@ -612,7 +631,10 @@ class PipelineBench:
     def _on_frame(self, frame) -> None:
         queue = self._post_times[frame.stream_id]
         if queue:
-            self._latencies.append(time.perf_counter() - queue.popleft())
+            elapsed = time.perf_counter() - queue.popleft()
+            self._latencies.append(elapsed)
+            self.round_sketch.observe(elapsed,
+                                      exemplar=frame.stream_id)
         self._completed += 1
 
     def warmup(self, batch: int) -> None:
@@ -907,8 +929,11 @@ class WirePipelineBench(PipelineBench):
         # counters the JSON artifact does not carry
         from aiko_services_tpu.observe import MetricsPublisher
         self.metrics_publishers = [
-            MetricsPublisher(serve_rt, interval=2.0),
-            MetricsPublisher(call_rt, interval=2.0),
+            # seeded interval jitter (ISSUE 12): a scaled fleet's
+            # retained-snapshot publishes must not synchronize into
+            # periodic broker bursts
+            MetricsPublisher(serve_rt, interval=2.0, jitter=0.2),
+            MetricsPublisher(call_rt, interval=2.0, jitter=0.2),
         ]
         # envelope accounting now comes from the metrics registry
         # (ISSUE 5): the SAME pipeline_wire_envelopes_total /
@@ -1217,10 +1242,12 @@ def bench_llama(window: float):
         decoder.stats[key] = 0 if isinstance(decoder.stats[key], int) \
             else 0.0
     # SLO sample deques too: warmup TTFTs include compile time and
-    # would contaminate the measured percentiles
+    # would contaminate the measured percentiles (the mergeable
+    # sketches follow the same rule)
     decoder.ttft_samples.clear()
     decoder.itl_samples.clear()
     decoder.gap_samples.clear()
+    decoder.clear_slo_sketches()
     # phase profiler likewise: warmup rounds are compile-dominated and
     # would swamp the attribution the lat_llama_phase_* fields report
     decoder.profiler.reset()
@@ -1280,8 +1307,26 @@ def bench_llama(window: float):
     # rather than just measured — lat_llama_phase_attributed is the
     # fraction of round wall covered by NAMED phases (acceptance:
     # >= 0.9 on the CPU smoke)
+    # sketch-derived SLO percentiles (ISSUE 12): the r06 artifact
+    # quotes THESE — mergeable across serving runtimes, with the worst
+    # requests' ids as exemplars behind every percentile.  The legacy
+    # llama_ttft_* fields (np.percentile over the sample deque) stay
+    # for cross-round comparability; the two must agree within the
+    # sketch's 1% relative error plus the deque's 8192-sample bound.
+    sketch_slo = decoder.slo_sketch_stats()
+    sketch_fields = {}
+    for kind in ("ttft", "itl"):
+        for suffix in ("p50", "p95"):
+            value = sketch_slo[f"{kind}_{suffix}_ms"]
+            if value is not None:
+                sketch_fields[f"lat_llama_{kind}_{suffix}_ms"] = \
+                    round(value, 2)
+    if sketch_fields:
+        sketch_fields["lat_llama_slo_source"] = (
+            "serving_ttft/itl_seconds mergeable sketches "
+            "(alpha=0.01, exemplar-attributed)")
     phase = decoder.profiler.phase_stats()
-    phase_fields = {
+    phase_fields = sketch_fields | {
         "lat_llama_phase_attributed": round(phase["attributed_frac"],
                                             4),
         "lat_llama_phase_rounds": phase["rounds"],
@@ -1405,6 +1450,7 @@ def bench_llama_interactive(window: float = 12.0):
     decoder.ttft_samples.clear()
     decoder.itl_samples.clear()
     decoder.gap_samples.clear()
+    decoder.clear_slo_sketches()
 
     # ~60% load keeps queues short so TTFT measures admission+prefill,
     # not backlog.  Prior: a round of `sps` steps costs ~sps*6ms device
@@ -1763,6 +1809,7 @@ def bench_latency():
         # cumulative counters
         program.scheduler.recent_waits.clear()
         program.recent_service.clear()
+        bench.round_sketch.clear()
         deadline_before = program.scheduler.stats["deadline_dispatches"]
         wire_before = bench.wire_counters()
         ok, p50, done, mean_batch = bench.measure(
@@ -1779,11 +1826,22 @@ def bench_latency():
         envelopes = wire_after["envelopes"] - wire_before["envelopes"]
         wire_frames = wire_after["frames"] - wire_before["frames"]
         wire_retries = wire_after["retries"] - wire_before["retries"]
+        # the SAME percentiles re-derived from the mergeable sketch
+        # (ISSUE 12) — fleet-aggregatable, exemplar-attributed; must
+        # agree with the list-based numbers within the sketch's 1%
+        # relative error
+        sketch_q = bench.round_sketch_quantiles()
         return {
             "lat_wire_streams": n,
             "lat_wire_sustained": bool(ok),
             "lat_wire_p50_ms": round(p50 * 1000.0, 1),
             "lat_wire_p95_ms": round(p95 * 1000.0, 1),
+            "lat_wire_round_p50_ms":
+                None if sketch_q["p50_ms"] is None
+                else round(sketch_q["p50_ms"], 1),
+            "lat_wire_round_p95_ms":
+                None if sketch_q["p95_ms"] is None
+                else round(sketch_q["p95_ms"], 1),
             "lat_queue_p50_ms": round(queue_p50 * 1000.0, 1),
             "lat_service_p50_ms": round(service_p50 * 1000.0, 1),
             # wire = in-flight service minus the device-only round at
