@@ -93,6 +93,23 @@
 #                         whose bound lives elsewhere (a drain method,
 #                         a lease) carry per-line waivers so the audit
 #                         trail stays in the diff
+#   lint-unbounded-cache  dict/OrderedDict CACHES mutated from
+#                         event-handler or `graft: hot-path` contexts
+#                         with no eviction on the same receiver: a
+#                         subscript store (`self._cache[key] = ...`) or
+#                         .setdefault() whose receiver the function
+#                         never pops/popitems/clears, len()-checks, or
+#                         deletes from.  The queue rule's sibling for
+#                         keyed state: a keyed cache grows one entry
+#                         per DISTINCT key forever (per-request keys =
+#                         a memory leak with a hit rate), exactly the
+#                         failure the prefix cache's budget eviction
+#                         and the reply replay cache's byte caps exist
+#                         to prevent.  Per-call locals are exempt;
+#                         fixed-key or externally-bounded receivers
+#                         (MirroredStats counters, stream-lifetime
+#                         state) carry per-line waivers so the audit
+#                         trail stays in the diff
 #
 # Hot-path marking: a `graft: hot-path` comment on (or directly above)
 # a `def` line opts that function into the allocation rule — purely
@@ -114,7 +131,8 @@ __all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
 
 LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
-              "lint-print", "lint-unbounded-queue", "lint-linear-timer",
+              "lint-print", "lint-unbounded-queue",
+              "lint-unbounded-cache", "lint-linear-timer",
               "lint-metric-label", "lint-wall-clock")
 
 # wall-epoch clock reads (lint-wall-clock): canonical spellings; call
@@ -284,6 +302,14 @@ class _ContextScanner(ast.NodeVisitor):
             or f"len({receiver})" in self._source \
             or f"del {receiver}" in self._source
 
+    def _cache_exempt(self, receiver: str) -> bool:
+        """lint-unbounded-cache exemptions beyond _receiver_bounded:
+        per-stream scratch space (stream.variables — torn down with
+        the stream, the sanctioned keyed-state home for elements) is
+        bounded by stream lifetime, not by code in this function."""
+        return receiver.endswith("stream.variables") or \
+            self._receiver_bounded(receiver)
+
     def visit_FunctionDef(self, node):      # no descent (see docstring)
         pass
 
@@ -323,6 +349,18 @@ class _ContextScanner(ast.NodeVisitor):
                         f"it (maxlen / len() check / shed-oldest) or "
                         f"waive the audited site with `graft: "
                         f"disable=lint-unbounded-queue`")
+        if (self.event or self.hot) and tail == "setdefault" and \
+                isinstance(node.func, ast.Attribute) and node.args and \
+                not isinstance(node.args[0], ast.Constant):
+            receiver = ast.unparse(node.func.value)
+            if not self._cache_exempt(receiver):
+                self.lint.report(
+                    "lint-unbounded-cache", node,
+                    f"{receiver}.setdefault() grows a keyed cache in "
+                    f"context {self.context!r} with no eviction on the "
+                    f"same receiver: pop/popitem/clear or a len() "
+                    f"budget check must bound it, or waive the audited "
+                    f"site with `graft: disable=lint-unbounded-cache`")
         if self.hot and tail in _ALLOC_TAILS and \
                 target.rpartition(".")[0] in _ALLOC_MODULES:
             self.lint.report(
@@ -352,6 +390,32 @@ class _ContextScanner(ast.NodeVisitor):
                 f"— handler-side accumulation without a bound queues "
                 f"until deadlines blow instead of shedding at "
                 f"admission")
+        # a keyed store (`cache[key] = value`) in an event-handler or
+        # hot-path context with no eviction on the same receiver: the
+        # unbounded-queue rule's sibling for dict/OrderedDict caches —
+        # one entry per distinct key forever.  Plain Assign only:
+        # AugAssign on a subscript (`stats[k] += 1`) mutates an
+        # EXISTING entry, the counter idiom, not insertion growth.
+        # Constant keys are exempt (a fixed-field record update cannot
+        # grow — `state["latest"] = frame` is a register, not a cache);
+        # growth requires a DYNAMIC key.
+        if self.event or self.hot:
+            for target in node.targets:
+                if not isinstance(target, ast.Subscript) or \
+                        isinstance(target.slice, ast.Constant):
+                    continue
+                receiver = ast.unparse(target.value)
+                if self._cache_exempt(receiver):
+                    continue
+                self.lint.report(
+                    "lint-unbounded-cache", node,
+                    f"{receiver}[...] = stores into a keyed cache in "
+                    f"context {self.context!r} with no eviction on "
+                    f"the same receiver (pop/popitem/clear/del/len() "
+                    f"budget check): a per-key cache grows FOREVER — "
+                    f"bound it like the prefix cache's byte budgets, "
+                    f"or waive the audited site with `graft: "
+                    f"disable=lint-unbounded-cache`")
         self.generic_visit(node)
 
 

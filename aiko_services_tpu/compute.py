@@ -260,6 +260,8 @@ class ComputeRuntime(Actor):
             program.in_flight["now"] = max(
                 0, program.in_flight["now"] - 1)
         if bucket not in program.first_call_times:
+            # keyed by the program's fixed bucket ladder — bounded:
+            # graft: disable=lint-unbounded-cache
             program.first_call_times[bucket] = elapsed
             self.ec_producer.update(f"first_call.{program.name}.{bucket}",
                                     round(elapsed, 3))

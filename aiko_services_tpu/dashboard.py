@@ -160,6 +160,8 @@ class DashboardState:
         from .observe.export import parse_retained_json
         record = parse_retained_json(payload, require_key="rule")
         if record is not None:
+            # keyed by configured SLO rule names — bounded:
+            # graft: disable=lint-unbounded-cache
             self.alerts[str(record["rule"])] = record
 
     def alert_lines(self) -> list:

@@ -8,6 +8,13 @@ from ..pipeline import DEFERRED, Frame, FrameOutput, PipelineElement
 __all__ = ["PE_Detect", "PE_LlamaAgent"]
 
 
+def _session_key(raw: str) -> str:
+    """SessionTable keys may not contain '.', '/', or spaces; stream /
+    frame ids may (stream ids embed topic-ish paths).  Deterministic
+    sanitization keeps the same stream mapping to the same session."""
+    return raw.replace(".", "-").replace("/", "-").replace(" ", "-")
+
+
 class PE_Detect(PipelineElement):
     """Batched object detection through the ComputeRuntime (the detect
     stage of video → detect → tracker).  Emits {"boxes": [[x1,y1,x2,y2]..],
@@ -203,6 +210,8 @@ class PE_LlamaAgent(PipelineElement):
         super().__init__(*args, **kwargs)
         self._setup_done = False
         self._stats_timer = None
+        self.prefix_cache = None
+        self._session_table = None
         self.tokenizer = lambda text: [b % 250 for b in
                                        text.encode("utf-8")][:120]
         self.detokenizer = lambda tokens: " ".join(str(t) for t in tokens)
@@ -272,15 +281,60 @@ class PE_LlamaAgent(PipelineElement):
             # iteration-level scheduling: requests join/leave the running
             # batch between decode steps (serving.ContinuousDecoder) —
             # ragged generation lengths no longer idle the MXU
-            from ..serving import ContinuousDecoder
+            from ..serving import ContinuousDecoder, PrefixKVCache
+            from ..utils import parse_bool
             steps_per_sync, _ = self.get_parameter("steps_per_sync", 4)
             eos_token, _ = self.get_parameter("eos_token", -1)
+            # prefix/KV reuse (ISSUE 13): parameter `prefix_block` > 0
+            # binds a hash-addressed prefix cache to the decoder, so
+            # shared system prompts and multi-turn histories skip
+            # re-prefill.  Chunked prefill is forced on (default: one
+            # bucket-sized chunk) because conversation histories
+            # outgrow the prefill bucket, and chunking lifts the
+            # prompt cap to max_seq.
+            prefix_block, _ = self.get_parameter("prefix_block", 0)
+            prefill_chunk, _ = self.get_parameter("prefill_chunk", 0)
+            self.prefix_cache = None
+            if int(prefix_block) > 0:
+                cache_mb, _ = self.get_parameter("prefix_cache_mb", 64)
+                tenant_mb, _ = self.get_parameter("prefix_tenant_mb", 0)
+                self.prefix_cache = PrefixKVCache(
+                    block_tokens=int(prefix_block),
+                    max_bytes=int(float(cache_mb) * (1 << 20)),
+                    tenant_max_bytes=int(float(tenant_mb) * (1 << 20))
+                    or None,
+                    name=self.definition.name)
+                prefill_chunk = int(prefill_chunk) or \
+                    int(self.prompt_length)
             self.decoder = ContinuousDecoder(
                 self.params, config, max_slots=int(max_batch),
                 prefill_buckets=(int(self.prompt_length),),
                 steps_per_sync=int(steps_per_sync),
+                prefill_chunk=int(prefill_chunk) or None,
                 eos_token=int(eos_token) if int(eos_token) >= 0 else None,
-                name=self.definition.name)
+                name=self.definition.name,
+                prefix_cache=self.prefix_cache)
+            # session-resident conversation KV (ISSUE 13 / PR 10
+            # residue c): parameter `sessions` persists per-(tenant,
+            # session) history in a SessionTable; each turn re-submits
+            # its whole history and the prefix cache longest-matches
+            # it, so a returning session resumes decode instead of
+            # re-prefilling.  Lease expiry / byte-budget demotion
+            # release the pinned KV handles through the table's hooks.
+            sessions, _ = self.get_parameter("sessions", False)
+            self._session_table = None
+            if parse_bool(sessions, False) and \
+                    self.prefix_cache is not None:
+                from ..state.sessions import SessionTable
+                session_lease, _ = self.get_parameter(
+                    "session_lease", 300.0)
+                session_shards, _ = self.get_parameter(
+                    "session_shards", 2)
+                self._session_table = SessionTable(
+                    self.pipeline, num_shards=int(session_shards),
+                    lease_time=float(session_lease),
+                    on_expired=self.prefix_cache.release_sessions,
+                    on_demoted=self.prefix_cache.release_sessions)
             self._setup_done = True
             return
 
@@ -339,6 +393,8 @@ class PE_LlamaAgent(PipelineElement):
         if self._stats_timer is not None:
             self.runtime.event.remove_timer_handler(self._stats_timer)
             self._stats_timer = None
+        if self._session_table is not None:
+            self._session_table.stop()
         self.decoder.detach(self.runtime.event)
 
     def _pad_prompt(self, text):
@@ -357,9 +413,54 @@ class PE_LlamaAgent(PipelineElement):
         self._setup()
 
         if self.mode == "continuous":
-            tokens = self.tokenizer(str(text)) or [1]
+            turn = self.tokenizer(str(text)) or [1]
+            # conversation state (ISSUE 13): with sessions on, the turn
+            # prompt is the session's WHOLE history plus the new text —
+            # re-submitted every turn, which is exactly what the prefix
+            # cache longest-matches, so only the new tokens prefill
+            tenant_param, _ = self.get_parameter("tenant", "",
+                                                 frame.stream)
+            # ONE normalized tenant key for decoder, cache, and table:
+            # harvested blocks, session pins, and table keys must
+            # share a root or session_store would match nothing — and
+            # SessionTable keys may not contain '.', '/', or spaces,
+            # so the key is sanitized up front
+            tenant = _session_key(str(tenant_param or "default"))
+            table = self._session_table
+            session_id = ""
+            history: list = []
+            cap = self.decoder.max_seq - self.max_tokens - 2
+            if table is not None:
+                session_param, _ = self.get_parameter("session", "",
+                                                      frame.stream)
+                session_id = _session_key(
+                    str(session_param or frame.stream_id))
+                payload = table.get(tenant, session_id)
+                if isinstance(payload, dict):
+                    history = [int(t) for t in
+                               payload.get("history", ())]
+            tokens = (history + turn)[-cap:] if history else turn[-cap:]
 
             def on_done(_rid, generated):
+                if table is not None:
+                    # the finished turn IS the next turn's prefix:
+                    # pin its chain under the session handle and
+                    # persist the history in the state plane (lease
+                    # expiry / demotion release the pin via the
+                    # table's hooks).  A shed create (tenant at its
+                    # session-count budget) must release the pin it
+                    # just took — no table entry means no expiry hook
+                    # would ever drop it.
+                    new_history = (tokens + [int(t) for t in
+                                             generated])[-cap:]
+                    leaf, kv_tokens = self.prefix_cache.session_store(
+                        tenant, session_id, new_history)
+                    if not table.create(tenant, session_id,
+                                        {"history": new_history,
+                                         "kv": leaf or "",
+                                         "kv_tokens": kv_tokens}):
+                        self.prefix_cache.session_release(tenant,
+                                                          session_id)
                 self.pipeline.post("resume_frame", frame,
                                    self.definition.name,
                                    self._to_outputs(generated))
@@ -380,7 +481,9 @@ class PE_LlamaAgent(PipelineElement):
                     deadline = _time.monotonic() + max(0.0, remaining)
             accepted = self.decoder.submit(
                 f"{frame.stream_id}.{frame.frame_id}", tokens,
-                self.max_tokens, on_done, deadline=deadline)
+                self.max_tokens, on_done, deadline=deadline,
+                tenant=tenant if self.prefix_cache is not None
+                else None)
             if not accepted:
                 return FrameOutput(False, diagnostic=(
                     "decoder admission shed: estimated admit wait "

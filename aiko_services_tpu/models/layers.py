@@ -28,6 +28,8 @@ __all__ = [
     "mha_init", "mha", "mha_axes", "precompute_kv", "init_kv_cache",
     "update_kv_cache", "quantize_linear", "quantize_linear_tree",
     "quantize_kv_cache", "dequantize_kv_cache",
+    "slice_kv_rows", "split_kv_blocks", "concat_kv_rows",
+    "kv_rows_nbytes",
     "linear_logits",
     "sinusoid_position_encoding", "gelu", "rope_frequencies", "apply_rope",
 ]
@@ -362,6 +364,56 @@ def dequantize_kv_cache(kv, dtype):
     if isinstance(kv, dict) and "q" in kv:
         return kv["q"].astype(dtype) * kv["s"][..., None].astype(dtype)
     return kv
+
+
+def slice_kv_rows(cache, slot, start: int, stop: int):
+    """One slot's K/V rows [start, stop) from a SERVING slot cache leaf
+    — a plain [S, H, T, D] array or the int8 serving form
+    {"q" int8 [S, H, T, D], "s" f32 [S, H, T]} (quantize_kv_cache).
+    Returns [H, t, D] (or the dict with s [H, t]) as a device-side
+    slice COPY: the harvest read behind serving's prefix/KV reuse
+    cache.  Slicing the quantized form keeps q and s together, so a
+    cached block stores exactly the bytes decode would read — a later
+    hit is a bytes win AND bit-faithful to the donor's cache."""
+    if isinstance(cache, dict):
+        return {"q": cache["q"][slot, :, start:stop],
+                "s": cache["s"][slot, :, start:stop]}
+    return cache[slot, :, start:stop]
+
+
+def split_kv_blocks(rows, block_tokens: int):
+    """Split harvested rows [H, n*B, D] (or the quantized dict form)
+    into n per-block leaves [H, B, D] along the time axis — the unit
+    the prefix cache stores and hash-addresses."""
+    if isinstance(rows, dict):
+        count = rows["q"].shape[1] // block_tokens
+        return [{"q": rows["q"][:, i * block_tokens:
+                                (i + 1) * block_tokens],
+                 "s": rows["s"][:, i * block_tokens:
+                                (i + 1) * block_tokens]}
+                for i in range(count)]
+    count = rows.shape[1] // block_tokens
+    return [rows[:, i * block_tokens:(i + 1) * block_tokens]
+            for i in range(count)]
+
+
+def concat_kv_rows(blocks):
+    """Concatenate per-block K/V leaves back into contiguous rows along
+    the time axis (inverse of split_kv_blocks) — the copy-in side of a
+    prefix-cache hit.  Handles the quantized dict form leaf-wise so an
+    int8 chain lands in the slot cache without a dequantize/requantize
+    round trip (no double rounding)."""
+    if isinstance(blocks[0], dict):
+        return {"q": jnp.concatenate([b["q"] for b in blocks], axis=1),
+                "s": jnp.concatenate([b["s"] for b in blocks], axis=1)}
+    return jnp.concatenate(blocks, axis=1)
+
+
+def kv_rows_nbytes(rows) -> int:
+    """Accounting bytes of one K or V rows leaf (array or quantized
+    dict) — the prefix cache's budget currency."""
+    return int(sum(leaf.size * jnp.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree_util.tree_leaves(rows)))
 
 
 def mha(params, x, kv_input=None, mask=None, cache=None,

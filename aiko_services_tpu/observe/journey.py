@@ -96,7 +96,7 @@ class RequestJourney:
                  "first_token_t", "done_t", "admission_verdict",
                  "admission_wait_s", "slot", "waves", "token_ticks",
                  "tokens_total", "deadline", "deadline_margin_s",
-                 "outcome", "prompt_tokens")
+                 "outcome", "prompt_tokens", "prefix_hit_tokens")
 
     def __init__(self, request_id: str, submit_t: float,
                  trace_id: str = "", parent_span_id: str = "",
@@ -126,6 +126,11 @@ class RequestJourney:
         self.deadline_margin_s: float | None = None
         self.outcome = ""
         self.prompt_tokens = int(prompt_tokens)
+        # prompt tokens satisfied from the prefix/KV reuse cache at
+        # admit (ISSUE 13): 0 = cold prefill, >0 = cached — the
+        # decoder stamps it at slot assignment, and the journey's
+        # spans/outcome counters carry the cached-vs-cold tag
+        self.prefix_hit_tokens = 0
 
     # -- lifecycle hooks (decoder clock) -------------------------------------
     def admitted(self, t: float, slot: int, kind: str = "admit") -> None:
@@ -187,6 +192,7 @@ class RequestJourney:
             "token_ticks": list(self.token_ticks),
             "tokens_total": self.tokens_total,
             "prompt_tokens": self.prompt_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
             "ttft_s": self.ttft_s(),
             "queue_wait_s": self.queue_wait_s(),
             "itl_s": self.itl_s(),
@@ -221,6 +227,8 @@ class RequestJourney:
                {"request_id": self.request_id, "tenant": self.tenant,
                 "outcome": self.outcome, "slot": self.slot,
                 "tokens": self.tokens_total,
+                "prefill": "cached" if self.prefix_hit_tokens
+                else "cold",
                 "deadline_margin_s": self.deadline_margin_s},
                span_id=self.span_id, parent=self.parent_span_id)
         record("journey:admission", self.submit_t,
@@ -236,7 +244,8 @@ class RequestJourney:
             record("journey:prefill", self.admitted_t,
                    first - self.admitted_t,
                    {"waves": dict(self.waves),
-                    "prompt_tokens": self.prompt_tokens})
+                    "prompt_tokens": self.prompt_tokens,
+                    "prefix_hit_tokens": self.prefix_hit_tokens})
         for index, tick in enumerate(self.token_ticks):
             record("journey:token", tick, 0.0, {"index": index})
         return emitted
@@ -258,16 +267,19 @@ class JourneyLog:
         self._registry = registry or default_registry()
         self._counters: dict = {}
 
-    def _count(self, tenant: str, outcome: str) -> None:
-        key = (tenant, outcome)
+    def _count(self, tenant: str, outcome: str,
+               prefill: str = "cold") -> None:
+        key = (tenant, outcome, prefill)
         counter = self._counters.get(key)
         if counter is None:
             counter = self._registry.counter(
                 "journey_requests_total",
-                "completed request journeys by tenant and outcome",
+                "completed request journeys by tenant, outcome, and "
+                "cached/cold prefill",
                 labels={"log": self.name,
                         "tenant": tenant or "default",
-                        "outcome": outcome})
+                        "outcome": outcome,
+                        "prefill": prefill})
             self._counters[key] = counter
         counter.inc()
 
@@ -275,7 +287,8 @@ class JourneyLog:
                outcome: str = "") -> None:
         journey.finish(t, outcome)
         self.completed.append(journey)
-        self._count(journey.tenant, journey.outcome)
+        self._count(journey.tenant, journey.outcome,
+                    "cached" if journey.prefix_hit_tokens else "cold")
         journey.emit_spans(proc=self.proc)
 
     def journey_for(self, trace_id: str) -> RequestJourney | None:
@@ -303,12 +316,19 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
         "attainment" (None without deadlines), "ttft_p50_ms"...,
         "itl_p95_ms"..., "shed", "rejected", "exemplars", "met"}, ...]
 
+    TTFT sketches carrying the serving prefill label (ISSUE 13) are
+    ADDITIONALLY merged per population into ttft_{cached,cold}_p50_ms /
+    _p95_ms rows, so the report quotes what the prefix cache actually
+    bought each tenant (the blended percentile hides a cache that only
+    helps the warm half).
+
     `met` is the per-tenant verdict against `objective` (None =
     reporting only, every tenant passes)."""
     from .sketch import Sketch, merge_sketches
 
     outcomes: dict[str, dict] = {}
     sketches: dict[tuple, list] = {}      # (tenant, family) -> [Sketch]
+    split_ttft: dict[tuple, list] = {}    # (tenant, prefill) -> [Sketch]
     shed: dict[str, float] = {}
     rejected: dict[str, float] = {}
 
@@ -332,6 +352,11 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
                     if sketch is not None:
                         key = (tenant_of(labels), family)
                         sketches.setdefault(key, []).append(sketch)
+                        prefill = str(labels.get("prefill") or "")
+                        if prefill and family == "serving_ttft_seconds":
+                            split_ttft.setdefault(
+                                (tenant_of(labels), prefill),
+                                []).append(sketch)
                 elif family == "admission_shed_total":
                     tenant = tenant_of(labels)
                     shed[tenant] = shed.get(tenant, 0) + \
@@ -376,6 +401,14 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
                 row["exemplars"] = [
                     e[1] for e in merged.worst_exemplars(8)
                     if not (e[1] in seen or seen.add(e[1]))][:4]
+        for prefill in ("cached", "cold"):
+            merged = merge_sketches(
+                split_ttft.get((tenant, prefill), []))
+            if merged is not None:
+                for q, suffix in ((0.5, "p50"), (0.95, "p95")):
+                    value = merged.quantile(q)
+                    row[f"ttft_{prefill}_{suffix}_ms"] = \
+                        None if value is None else value * 1000.0
         row["met"] = True if objective is None or attainment is None \
             else attainment >= objective
         rows.append(row)

@@ -732,6 +732,8 @@ class HealthAggregator:
         now = self.runtime.event.clock.now()
         self.store.prune(now)
         for rule in self.rules:
+            # keyed by the aggregator's fixed rule set — bounded:
+            # graft: disable=lint-unbounded-cache
             state = self._state.setdefault(
                 rule.name, {"breach_since": None, "firing": False})
             try:
@@ -759,7 +761,9 @@ class HealthAggregator:
                         "exemplars": [e["trace_id"] for e in
                                       verdict.get("exemplars", [])],
                     }
+                    # graft: disable=lint-unbounded-cache
                     self.alerts[rule.name] = record
+                    # graft: disable=lint-unbounded-cache (rule set)
                     self.fired[rule.name] = \
                         self.fired.get(rule.name, 0) + 1
                     self._count_alert(rule.name, "firing")
@@ -782,6 +786,7 @@ class HealthAggregator:
                               "time": now,
                               "description": rule.description,
                               "detail": verdict}
+                    # graft: disable=lint-unbounded-cache
                     self.alerts[rule.name] = record
                     self._count_alert(rule.name, "resolved")
                     self._publish_alert(record)

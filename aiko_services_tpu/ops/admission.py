@@ -320,6 +320,16 @@ class AdmissionGate:
         occupancy (ops/batching.py estimated_wait)."""
         self.add_wait_estimator(scheduler.estimated_wait)
 
+    def watch_decoder(self, decoder) -> None:
+        """Convenience: estimate from a ContinuousDecoder's admit-wait
+        heuristic (serving.estimated_admit_wait — round EWMA × backlog
+        share).  With a prefix cache bound the decoder's estimate
+        credits expected prefix hits when probed with a prompt, so the
+        serving side sheds on the CACHED cost of a conversation turn,
+        not its cold re-prefill cost (ISSUE 13); the gate's argless
+        call sees the backlog component."""
+        self.add_wait_estimator(decoder.estimated_admit_wait)
+
     def estimated_wait(self) -> float | None:
         waits = []
         for estimator in self._estimators:

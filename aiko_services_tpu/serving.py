@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import os
 import time
 from collections import deque
@@ -59,7 +60,8 @@ from .models import layers as L
 from .models.llama import LlamaConfig, llama_ffn
 from .utils import get_logger
 
-__all__ = ["ContinuousDecoder", "DecodeRequest", "measure_device_step"]
+__all__ = ["ContinuousDecoder", "DecodeRequest", "PrefixKVCache",
+           "prefix_chain_keys", "measure_device_step"]
 
 
 def measure_device_step(decoder, steps_per_sync: int = 64,
@@ -222,6 +224,336 @@ class DecodeRequest:
     # end-to-end completion deadline (scheduler clock) as passed to
     # submit(); the journey reports the margin at completion against it
     deadline: float | None = None
+    # prefix/KV reuse (ISSUE 13): tokens satisfied from the prefix
+    # cache at admit (0 = cold), the pinned chain keys (released at
+    # retire), whether the cache was already probed (miss metrics must
+    # count once per request, not once per deferred round), and the
+    # tenant the request bills its cache traffic to
+    prefix_hit: int = 0
+    prefix_nodes: list = dataclasses.field(default_factory=list)
+    prefix_probed: bool = False
+    tenant: str = ""
+
+
+def prefix_chain_keys(tenant: str, tokens, block_tokens: int) -> list:
+    """Hash-chain block keys for a token sequence: block i is keyed
+    blake2b(parent_key, block_i_tokens), with block 0's parent the
+    TENANT root — so every key commits to the entire token prefix
+    behind it (the path identity of SGLang's RadixAttention, in hash
+    form over fixed blocks like vLLM's prefix caching) and two tenants
+    never share a block (isolation by construction, per-tenant byte
+    accounting for free).  Only complete blocks are keyed; the ragged
+    tail is always prefilled."""
+    tenant = str(tenant or "default")
+    parent = b"t\x00" + tenant.encode("utf-8")
+    keys = []
+    for i in range(len(tokens) // block_tokens):
+        digest = hashlib.blake2b(parent, digest_size=16)
+        digest.update(np.asarray(
+            tokens[i * block_tokens:(i + 1) * block_tokens],
+            np.int64).tobytes())
+        parent = digest.digest()
+        keys.append(parent.hex())
+    return keys
+
+
+class _PrefixBlock:
+    """One cached block: per-layer K/V rows in the DECODER's storage
+    layout ([H, B, D] arrays, or {"q", "s"} int8 dicts — a hit on an
+    int8 cache is a bytes win too), plus the tree bookkeeping eviction
+    needs (parent/children for leaf-first order, refs for pinning)."""
+
+    __slots__ = ("key", "parent", "tenant", "k_rows", "v_rows",
+                 "refs", "children", "nbytes")
+
+    def __init__(self, key, parent, tenant, k_rows, v_rows, nbytes):
+        self.key = key
+        self.parent = parent
+        self.tenant = tenant
+        self.k_rows = k_rows
+        self.v_rows = v_rows
+        self.refs = 0
+        self.children: set = set()
+        self.nbytes = int(nbytes)
+
+
+class PrefixKVCache:
+    """Hash-addressed prefix/KV reuse cache for ContinuousDecoder
+    (ISSUE 13, ROADMAP item 3).
+
+    Prompts are chunked into fixed `block_tokens` blocks, each keyed by
+    hash(parent_key, block_tokens) — see prefix_chain_keys.  Admit does
+    a longest-prefix match: a hit copies the cached K/V rows into the
+    slot cache and prefill runs only on the uncached suffix, so a
+    shared system prompt or a conversation's whole history costs one
+    block copy instead of a re-prefill.  Blocks are harvested when a
+    request RETIRES (prompt + all generated tokens but the last, whose
+    K/V is never written), so a multi-turn session's next turn
+    longest-matches everything it has ever said.
+
+    HBM budgeting: a global byte cap plus an optional per-tenant cap;
+    over budget, eviction walks LRU order and takes LEAF blocks only
+    (refs == 0 and no children — evicting an interior block would
+    orphan its entire subtree), so a block pinned by a live slot or a
+    session handle is never dropped.  Session handles
+    (session_store/session_release) pin a (tenant, sid) chain between
+    turns; the PR 10 SessionTable's lease expiry / demotion hooks
+    release them.
+
+    Mirrors serving_prefix_{hit,miss}_tokens_total{tenant} counters and
+    the prefix_cache_bytes gauge into the registry so the PR 11/12
+    observability planes see reuse as a first-class signal.
+
+    Single-threaded like the decoder that owns it (pump runs on the
+    event engine); shareable across decoders of the SAME geometry
+    (bind() enforces layout agreement)."""
+
+    def __init__(self, block_tokens: int = 32,
+                 max_bytes: int | None = 512 << 20,
+                 tenant_max_bytes: int | None = None,
+                 name: str = "prefix", registry=None):
+        self.block_tokens = int(block_tokens)
+        if self.block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}")
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.tenant_max_bytes = int(tenant_max_bytes) \
+            if tenant_max_bytes else None
+        self.name = str(name)
+        # one OrderedDict is both storage and LRU order (oldest-
+        # touched first; eviction walks from the front, touch is
+        # move_to_end) — bounded by eviction itself (budget caps)
+        from collections import OrderedDict
+        self._nodes: OrderedDict = OrderedDict()
+        self._tenant_bytes: dict = {}
+        self._sessions: dict = {}       # (tenant, sid) -> [keys]
+        self.bytes_used = 0
+        self._layout = None
+        from .observe.metrics import MirroredStats, default_registry
+        self._registry = registry or default_registry()
+        self.stats = MirroredStats(
+            {"hits": 0, "misses": 0, "hit_tokens": 0, "miss_tokens": 0,
+             "inserts": 0, "evictions": 0, "insert_refused": 0,
+             "session_handles": 0, "session_released": 0},
+            metric="prefix_cache_events_total",
+            help="prefix KV cache events by kind",
+            registry=self._registry,
+            skip=("hit_tokens", "miss_tokens"))
+        self._gauge_bytes = self._registry.gauge(
+            "prefix_cache_bytes",
+            "bytes pinned by cached prefix KV blocks",
+            labels={"cache": self.name})
+        self._gauge_blocks = self._registry.gauge(
+            "prefix_cache_blocks", "cached prefix KV blocks",
+            labels={"cache": self.name})
+        self._token_counters: dict = {}
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, layout: tuple) -> None:
+        """Record (and enforce) the storage layout this cache holds:
+        decoders sharing a cache must agree on (layers, kv heads, head
+        dim, dtype, int8-ness, block size) or a hit would scatter rows
+        of the wrong shape into a live slot."""
+        if self._layout is None:
+            self._layout = tuple(layout)
+        elif self._layout != tuple(layout):
+            raise ValueError(
+                f"prefix cache {self.name!r} already bound to layout "
+                f"{self._layout}, decoder wants {tuple(layout)}")
+
+    # -- lookup ------------------------------------------------------------
+    def keys_for(self, tenant: str, tokens) -> list:
+        return prefix_chain_keys(tenant, tokens, self.block_tokens)
+
+    def has(self, key: str) -> bool:
+        return key in self._nodes
+
+    def nodes(self, keys) -> list:
+        return [self._nodes[key] for key in keys]
+
+    def match(self, tenant: str, tokens,
+              limit: int | None = None) -> tuple:
+        """(chain keys, hit tokens) of the longest cached prefix, over
+        at most `limit` tokens (callers cap at len-1 so at least one
+        suffix token remains to produce the first output).  Pure probe:
+        no refcounts, no LRU movement, no metrics — what the admission
+        estimator uses (ISSUE 13 satellite)."""
+        cap = len(tokens) if limit is None else min(limit, len(tokens))
+        count = max(0, cap) // self.block_tokens
+        if count == 0:
+            return [], 0
+        keys = self.keys_for(tenant, tokens[:count * self.block_tokens])
+        hit = 0
+        for key in keys:
+            if key not in self._nodes:
+                break
+            hit += 1
+        return keys[:hit], hit * self.block_tokens
+
+    def acquire(self, tenant: str, tokens,
+                limit: int | None = None) -> tuple:
+        """match() + pin: refs++ on every chain node (released by the
+        owner at retire), LRU touch, and the per-tenant hit/miss token
+        counters the bench and the SLO planes read."""
+        keys, hit = self.match(tenant, tokens, limit)
+        for key in keys:
+            self._nodes[key].refs += 1
+            self._nodes.move_to_end(key)
+        self.stats["hits" if hit else "misses"] += 1
+        self.stats["hit_tokens"] += hit
+        self.stats["miss_tokens"] += len(tokens) - hit
+        self._count_tokens(tenant, hit, len(tokens) - hit)
+        return keys, hit
+
+    def release(self, keys) -> None:
+        for key in keys:
+            node = self._nodes.get(key)
+            if node is not None and node.refs > 0:
+                node.refs -= 1
+
+    def hit_rate(self) -> float:
+        total = self.stats["hit_tokens"] + self.stats["miss_tokens"]
+        return self.stats["hit_tokens"] / total if total else 0.0
+
+    def _count_tokens(self, tenant: str, hit: int, miss: int) -> None:
+        tenant = str(tenant or "default")
+        counters = self._token_counters.get(tenant)
+        if counters is None:
+            counters = tuple(self._registry.counter(
+                f"serving_prefix_{kind}_tokens_total",
+                f"prompt tokens {kind} by the prefix KV cache",
+                labels={"cache": self.name, "tenant": tenant})
+                for kind in ("hit", "miss"))
+            self._token_counters[tenant] = counters
+        if hit:
+            counters[0].inc(hit)
+        if miss:
+            counters[1].inc(miss)
+
+    # -- insertion / eviction ----------------------------------------------
+    def insert(self, tenant: str, parent: str, key: str,
+               k_rows, v_rows) -> bool:
+        """Register one block (per-layer K/V leaves).  Content-
+        addressed: an existing key is just touched.  Returns False when
+        the byte budgets refused it (everything evictable was already
+        evicted and the budget still doesn't fit) — the caller must
+        stop its chain there, or children would dangle."""
+        tenant = str(tenant or "default")
+        if key in self._nodes:
+            self._nodes.move_to_end(key)
+            return True
+        nbytes = L.kv_rows_nbytes(k_rows) + L.kv_rows_nbytes(v_rows)
+        node = _PrefixBlock(key, parent, tenant, k_rows, v_rows, nbytes)
+        self._nodes[key] = node
+        parent_node = self._nodes.get(parent)
+        if parent_node is not None:
+            parent_node.children.add(key)
+        self.bytes_used += nbytes
+        self._tenant_bytes[tenant] = \
+            self._tenant_bytes.get(tenant, 0) + nbytes
+        self.stats["inserts"] += 1
+        self._evict_to_budget(tenant)
+        if key not in self._nodes:      # budget evicted the newcomer
+            self.stats["insert_refused"] += 1
+            self._publish_gauges()
+            return False
+        self._publish_gauges()
+        return True
+
+    def tenant_bytes(self, tenant: str) -> int:
+        return self._tenant_bytes.get(str(tenant or "default"), 0)
+
+    def _over_budget(self, tenant: str) -> str | None:
+        if self.tenant_max_bytes is not None and \
+                self.tenant_bytes(tenant) > self.tenant_max_bytes:
+            return tenant
+        if self.max_bytes is not None and \
+                self.bytes_used > self.max_bytes:
+            return ""                   # global breach: any tenant
+        return None
+
+    def _evict_to_budget(self, tenant: str) -> None:
+        """Evict LRU-first LEAVES (unpinned, childless) until budgets
+        hold.  A pass that frees nothing ends the loop: pinned bytes
+        may legitimately exceed the budget (a block pinned by a live
+        slot is never evicted), and interior blocks become leaves as
+        their subtrees drain on later passes."""
+        while True:
+            scope = self._over_budget(tenant)
+            if scope is None:
+                return
+            victim = None
+            for node in self._nodes.values():
+                if node.refs or node.children:
+                    continue
+                if scope and node.tenant != scope:
+                    continue
+                victim = node
+                break
+            if victim is None:
+                return
+            self._evict(victim)
+
+    def _evict(self, node: _PrefixBlock) -> None:
+        del self._nodes[node.key]
+        parent = self._nodes.get(node.parent)
+        if parent is not None:
+            parent.children.discard(node.key)
+        self.bytes_used -= node.nbytes
+        remaining = self._tenant_bytes.get(node.tenant, 0) - node.nbytes
+        if remaining > 0:
+            self._tenant_bytes[node.tenant] = remaining
+        else:
+            self._tenant_bytes.pop(node.tenant, None)
+        self.stats["evictions"] += 1
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        self._gauge_bytes.set(self.bytes_used)
+        self._gauge_blocks.set(len(self._nodes))
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    # -- session-resident conversation KV (ISSUE 13 / PR 10 residue c) -----
+    def session_store(self, tenant: str, sid: str, tokens) -> tuple:
+        """Pin the longest cached chain for `tokens` under a
+        (tenant, sid) handle — the finished turn's history, registered
+        so the session's blocks survive eviction between turns.
+        Replaces (and releases) the session's previous handle.
+        Returns (leaf key | None, pinned tokens)."""
+        self.session_release(tenant, sid)
+        keys, hit = self.match(tenant, tokens)
+        if not keys:
+            return None, 0
+        for key in keys:
+            self._nodes[key].refs += 1
+            self._nodes.move_to_end(key)
+        self._sessions[(str(tenant or "default"), str(sid))] = keys
+        self.stats["session_handles"] += 1
+        return keys[-1], hit
+
+    def session_release(self, tenant: str, sid: str) -> bool:
+        """Drop a session's pin (SessionTable lease expiry / demotion
+        path): the chain stays cached but becomes evictable."""
+        keys = self._sessions.pop(
+            (str(tenant or "default"), str(sid)), None)
+        if keys is None:
+            return False
+        self.release(keys)
+        self.stats["session_released"] += 1
+        return True
+
+    def session_tokens(self, tenant: str, sid: str) -> int:
+        keys = self._sessions.get(
+            (str(tenant or "default"), str(sid)), ())
+        return len(keys) * self.block_tokens
+
+    def release_sessions(self, keys) -> None:
+        """Batch form matching SessionTable's on_expired/on_demoted
+        callback shape: [(tenant, sid), ...]."""
+        for tenant, sid in keys:
+            self.session_release(tenant, sid)
 
 
 def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
@@ -873,7 +1205,8 @@ class ContinuousDecoder:
                  fuse_projections: bool = False,
                  kv_cache_dtype: str | None = None,
                  speculate_k: int = 0, speculate_ngram: int = 2,
-                 name: str = "decoder", registry=None):
+                 name: str = "decoder", registry=None,
+                 prefix_cache: PrefixKVCache | None = None):
         self.config = config
         # int8 KV cache (ISSUE 7): the slot caches store int8 values
         # with per-(slot, head, position) f32 scales
@@ -990,6 +1323,25 @@ class ContinuousDecoder:
             jnp.int32)
         self._resize_fns: dict = {}
 
+        # prefix/KV reuse cache (ISSUE 13): hash-addressed block
+        # sharing across requests and sessions.  The cache stores rows
+        # in THIS decoder's storage layout (int8 dicts when kv_int8 —
+        # a hit is a bytes win too); bind() enforces layout agreement
+        # when several decoders share one cache.  Harvest at retire,
+        # longest-match at admit, copy-in via _prefix_copy_fn_for.
+        self.prefix_cache = prefix_cache
+        if prefix_cache is not None:
+            item = jnp.dtype(config.dtype).itemsize
+            prefix_cache.bind((config.num_layers, config.num_kv_heads,
+                               config.head_dim, str(config.dtype),
+                               self.kv_int8,
+                               prefix_cache.block_tokens, item))
+        self._prefix_pad = None         # lazy zero pad block (copy-in)
+        # measured host dispatch seconds per prefill token (EWMA): the
+        # prompt-cost term of estimated_admit_wait, which prefix hits
+        # credit away (ISSUE 13 satellite)
+        self._prefill_token_ewma: float | None = None
+
         self._step = _spec_step_for(config, self.speculate_k,
                                     self.speculate_ngram, KV_WRITE) \
             if self.speculate_k else _step_for(config, KV_WRITE,
@@ -1065,7 +1417,8 @@ class ContinuousDecoder:
              "spec_proposed": 0, "spec_accepted": 0,
              "accepted_per_step": 0.0,
              "bytes_moved": 0, "prefill_chunks": 0,
-             "chunk_admits": 0, "round_prefill_tokens_max": 0,
+             "chunk_admits": 0, "prefix_admits": 0,
+             "round_prefill_tokens_max": 0,
              "admission_shed": 0},
             metric="serving_decoder_total",
             help="continuous-decoder events by kind",
@@ -1087,7 +1440,8 @@ class ContinuousDecoder:
         self._round_ewma: float | None = None
 
     # -- public API --------------------------------------------------------
-    def estimated_admit_wait(self) -> float | None:
+    def estimated_admit_wait(self, prompt=None,
+                             tenant: str = "") -> float | None:
         """Coarse time-to-first-token wait estimate for the NEXT
         submitted request: at least one working round when a slot is
         free, scaled by the backlog's share of the slot pool when all
@@ -1095,41 +1449,89 @@ class ContinuousDecoder:
         it exists to shed requests that are grossly doomed under
         overload (the deadline-aware admission gate, ISSUE 9), not to
         predict TTFT; None until a round has been measured, because
-        admission must not drop work on a number it doesn't have."""
+        admission must not drop work on a number it doesn't have.
+
+        With `prompt`, the estimate adds that prompt's prefill cost at
+        the measured per-token dispatch rate, CREDITING expected
+        prefix-cache hits (a pure block-key probe, no side effects) —
+        a cached-heavy tenant's real admit cost is near the round
+        floor, and shedding or autoscaling on the cold re-prefill
+        number would over-shed/over-scale it (ISSUE 13)."""
         if self._round_ewma is None:
             return None
         free = sum(1 for request in self._slots if request is None)
         waiting = len(self._pending)
         if waiting < free:
-            return self._round_ewma
-        return self._round_ewma * \
-            (1.0 + (waiting - free + 1) / max(1, self.max_slots))
+            wait = self._round_ewma
+        else:
+            wait = self._round_ewma * \
+                (1.0 + (waiting - free + 1) / max(1, self.max_slots))
+        if prompt is not None and self._prefill_token_ewma:
+            uncached = len(prompt)
+            if self.prefix_cache is not None and len(prompt) > 1:
+                _, hit = self.prefix_cache.match(
+                    tenant, prompt, limit=len(prompt) - 1)
+                uncached -= hit
+            wait += uncached * self._prefill_token_ewma
+        return wait
 
-    def _slo_sketch(self, kind: str, tenant: str):
-        """Per-(kind, tenant) mergeable SLO sketch, lazily registered:
-        serving_{kind}_seconds{decoder, tenant} (ISSUE 12).  Tenant is
-        a BOUNDED label (tenant names come from serving policy, not
-        request identity — lint-metric-label's discipline)."""
-        key = (kind, tenant)
+    def _note_prefill_rate(self, tokens: int, elapsed: float) -> None:
+        """Fold one prefill dispatch's (tokens, wall) into the
+        per-token EWMA the admission estimate charges prompts at.
+        Asymmetric on purpose: a LOWER rate is taken outright while a
+        higher one is damped and clamped — dispatch walls that include
+        a jit compile (first sight of a (chunk, width, cache_t) shape)
+        are orders of magnitude above the real cost, and an EWMA that
+        believed them would shed deadline-carrying prompts on a number
+        that is compiler overhead, not serving cost.  One clean round
+        snaps the estimate back to the measured floor."""
+        if tokens <= 0 or elapsed <= 0.0:
+            return
+        rate = elapsed / tokens
+        current = self._prefill_token_ewma
+        if current is None or rate < current:
+            self._prefill_token_ewma = rate
+        else:
+            self._prefill_token_ewma = \
+                0.7 * current + 0.3 * min(rate, 10.0 * current)
+
+    def _slo_sketch(self, kind: str, tenant: str,
+                    prefill: str | None = None):
+        """Per-(kind, tenant[, prefill]) mergeable SLO sketch, lazily
+        registered: serving_{kind}_seconds{decoder, tenant[, prefill]}
+        (ISSUE 12).  Tenant is a BOUNDED label (tenant names come from
+        serving policy, not request identity — lint-metric-label's
+        discipline); `prefill` splits the TTFT population into
+        cached/cold (ISSUE 13) so the SLO report and the conversation
+        bench can quote both."""
+        key = (kind, tenant, prefill)
         sketch = self._slo_sketches.get(key)
         if sketch is None:
+            labels = {"decoder": self.journeys.name,
+                      "tenant": tenant or "default"}
+            if prefill is not None:
+                labels["prefill"] = prefill
             sketch = self._registry.sketch(
                 f"serving_{kind}_seconds",
                 f"per-request {kind} seconds (mergeable quantile "
                 f"sketch with worst-request trace-id exemplars)",
-                labels={"decoder": self.journeys.name,
-                        "tenant": tenant or "default"})
+                labels=labels)
             self._slo_sketches[key] = sketch
         return sketch
 
     def submit(self, request_id: str, prompt, max_new_tokens: int,
-               callback, deadline: float | None = None) -> bool:
+               callback, deadline: float | None = None,
+               tenant: str | None = None) -> bool:
         """Enqueue one request; returns False when deadline-aware
         admission rejected it instead (the callback is NOT invoked —
         the caller owns the refusal).  `deadline` (absolute,
         time.monotonic seconds) is the request's END-TO-END completion
         target — the frame deadline the serving walk carries, crossed
         into this clock domain (PE_LlamaAgent does the conversion).
+        `tenant`, when given, overrides the admission note's tenant —
+        the caller that also keys session KV handles (PE_LlamaAgent)
+        passes the SAME normalized key here, so harvested blocks and
+        session pins land under one tenant root (ISSUE 13).
         Admission uses the estimated admit wait (a time-to-FIRST-token
         bound) as its necessary condition: a request that cannot even
         reach its first token inside the budget is refused NOW, so the
@@ -1153,23 +1555,20 @@ class ContinuousDecoder:
             trace_id=context.trace_id if context is not None else "",
             parent_span_id=context.span_id
             if context is not None else "",
-            tenant=(note or {}).get("tenant", ""),
+            tenant=tenant if tenant is not None
+            else (note or {}).get("tenant", ""),
             tier=(note or {}).get("tier", 1),
             deadline=deadline,
             admission_verdict=(note or {}).get("verdict", ""),
             admission_wait_s=(note or {}).get("queue_wait_s"),
             prompt_tokens=len(prompt))
-        if deadline is not None:
-            wait = self.estimated_admit_wait()
-            if wait is not None and now + wait >= float(deadline):
-                self.stats["admission_shed"] += 1
-                self.journeys.finish(journey, time.monotonic(),
-                                     outcome="shed")
-                return False
         # keep the TAIL on overflow (recent context matters most).
         # Without chunked prefill the largest bucket is a hard cap (an
         # oversized prompt would blow up _admit's scatter); with it,
         # long prompts stream in chunks and the cap is max_seq itself.
+        # Normalized BEFORE admission so the wait estimate's prefill
+        # term (and its prefix-cache probe) sees the prompt that will
+        # actually admit.
         if self.prefill_chunk:
             limit = self.max_seq - 1
         else:
@@ -1177,9 +1576,18 @@ class ContinuousDecoder:
         # empty prompts would seed generation from a pad position —
         # normalize to a single pad token at position 0
         prompt = ([int(t) for t in prompt] or [0])[-limit:]
+        if deadline is not None:
+            wait = self.estimated_admit_wait(prompt=prompt,
+                                             tenant=journey.tenant)
+            if wait is not None and now + wait >= float(deadline):
+                self.stats["admission_shed"] += 1
+                self.journeys.finish(journey, time.monotonic(),
+                                     outcome="shed")
+                return False
         self._pending.append(DecodeRequest(
             request_id, prompt, int(max_new_tokens), callback,
-            submit_time=now, journey=journey, deadline=deadline))
+            submit_time=now, journey=journey, deadline=deadline,
+            tenant=journey.tenant))
         return True
 
     def attach(self, engine, period: float = 0.002) -> int:
@@ -1229,66 +1637,101 @@ class ContinuousDecoder:
                 bool(self.speculate_k))
         return self._prefill_fns[key]
 
-    def _extend_fn(self, width: int):
+    def _extend_fn(self, chunk: int, width: int):
         """Compiled once per (chunk, admit-width): advances up to
-        `width` mid-prefill slots by one `prefill_chunk`-token chunk of
-        their prompt — see _extend_fn_for.  Shared process-wide."""
-        key = ("extend", width)
+        `width` mid-prefill slots by one `chunk`-token piece of their
+        prompt — see _extend_fn_for.  Shared process-wide.  The chunk
+        is prefill_chunk for chunked admits; prefix-hit suffixes
+        without a global prefill_chunk use a pow2-sized chunk of their
+        own (bounded compile variants)."""
+        key = ("extend", chunk, width)
         if key not in self._prefill_fns:
             self._prefill_fns[key] = _extend_fn_for(
-                self.config, self.prefill_chunk, width, self.kv_int8,
+                self.config, chunk, width, self.kv_int8,
                 bool(self.speculate_k))
         return self._prefill_fns[key]
-
 
     def _advance_prefills(self) -> None:
         """Run one prompt chunk for mid-prefill slots (batched, pow2
         widths).  Slots closest to completion go first so in-flight
         prompts finish (and start emitting) sooner; prefill_budget
-        rations how many rows advance per round."""
-        if not self.prefill_chunk:
-            return
+        rations how many rows advance per round.  Prefix-hit admits
+        (ISSUE 13) stream their uncached SUFFIX through the same
+        machinery: with prefill_chunk set they ride the normal chunk
+        size, without it each suffix runs as one pow2-sized chunk."""
         rows = [s for s in range(self.max_slots)
                 if self._slots[s] is not None
                 and self._slots[s].prefilling]
         if not rows:
             return
-        chunk = self.prefill_chunk
         rows.sort(key=lambda s: len(self._slots[s].prompt) -
                   self._slots[s].prefill_pos)      # fewest remaining first
-        if self.prefill_budget is not None:
-            remaining = self.prefill_budget - self._round_prefill_tokens
-            rows = rows[:max(1, remaining // chunk)]
         # the extend writes up to offset+chunk; never let a decode-side
         # shrink cut below it (grow-only: max with current size)
         need = 0
-        plans = []
+        spent = self._round_prefill_tokens
+        planned = 0
+        plans_by_chunk: dict[int, list] = {}
         for slot in rows:
             request = self._slots[slot]
             total = len(request.prompt)
-            if total - request.prefill_pos > chunk:
+            remaining = total - request.prefill_pos
+            chunk = self.prefill_chunk or min(
+                self._next_pow2(max(1, remaining)), self.max_seq - 1)
+            if self.prefill_budget is not None and planned and \
+                    spent + chunk > self.prefill_budget:
+                break          # ration; first row always progresses
+            spent += chunk
+            planned += 1
+            if remaining > chunk:
                 offset, finish = request.prefill_pos, False
             else:
                 # final chunk slides BACK to end exactly at the prompt
                 # tail: the overlap recomputes identical K/V
                 # (idempotent) and offset+chunk stays <= total, so the
-                # cache never needs to grow past the prompt itself
-                offset, finish = max(0, total - chunk), True
-            plans.append((slot, request, offset, finish))
+                # cache never needs to grow past the prompt itself —
+                # EXCEPT below a prefix-cache hit, whose rows must not
+                # be recomputed (the savings are the point): anchor at
+                # the written boundary and pad forward instead (the
+                # garbage tail past the prompt is dead cells, same as
+                # the shorter-than-chunk admit)
+                offset = max(0, total - chunk)
+                if offset < request.prefix_hit:
+                    # ...but never let the write extent leave the
+                    # cache: near the seq cap the forward pad would
+                    # exceed max_seq, where _fit_caches clamps and the
+                    # extend's dynamic_update_slice would CLAMP the
+                    # start index — silently shifting rows onto wrong
+                    # positions.  Sliding back into the cached region
+                    # there is the correct fallback: the overlap
+                    # recompute is idempotent (same program, same
+                    # offset, same prefix bytes as the donor's own
+                    # final chunk).
+                    offset = min(request.prefill_pos,
+                                 self.max_seq - chunk)
+                finish = True
+            plans_by_chunk.setdefault(chunk, []).append(
+                (slot, request, offset, finish))
             # the write extent is always offset+chunk (a prompt shorter
             # than one chunk pads — the garbage tail is overwritten by
             # decode tokens before it is ever attended)
             need = max(need, offset + chunk)
+        if not plans_by_chunk:
+            return
         self._fit_caches(max(need, self._cache_t))
         start = time.perf_counter()
-        while plans:
-            width = min(self.max_slots, self._next_pow2(len(plans)))
-            batch, plans = plans[:width], plans[width:]
-            self._extend_group(width, batch)
-        self.stats["prefill_s"] += time.perf_counter() - start
+        before = self.stats["tokens_prefill"]
+        for chunk, plans in plans_by_chunk.items():
+            while plans:
+                width = min(self.max_slots, self._next_pow2(len(plans)))
+                batch, plans = plans[:width], plans[width:]
+                self._extend_group(chunk, width, batch)
+        elapsed = time.perf_counter() - start
+        self.stats["prefill_s"] += elapsed
+        self._note_prefill_rate(self.stats["tokens_prefill"] - before,
+                                elapsed)
 
-    def _extend_group(self, width: int, batch: list) -> None:
-        chunk = self.prefill_chunk
+    def _extend_group(self, chunk: int, width: int, batch: list) -> None:
         n = len(batch)
         slots = [slot for slot, *_ in batch]
         used = set(slots)
@@ -1308,7 +1751,7 @@ class ContinuousDecoder:
             valid[j] = True
             finish_arr[j] = finish
         (firsts, self._k, self._v, self._tokens, self._lengths,
-         self._context) = self._extend_fn(width)(
+         self._context) = self._extend_fn(chunk, width)(
             self.params, self._k, self._v, self._tokens,
             self._lengths, self._context, jnp.asarray(chunk_tokens),
             jnp.asarray(offsets),
@@ -1412,23 +1855,39 @@ class ContinuousDecoder:
 
     def _admit_pending(self) -> None:
         """Admit as many pending requests as there are free slots, in
-        FIFO order.  Short prompts go through bucketed single-shot
-        prefill groups; prompts longer than the largest bucket (only
-        when prefill_chunk is set) just claim a slot here and stream in
-        via _advance_prefills.  With prefill_budget set, bucketed
-        admission stops for the round once the budget is spent —
-        arrivals defer rather than stall active decode slots."""
+        FIFO order.  With a prefix cache bound (ISSUE 13), each request
+        is longest-prefix-matched FIRST: a hit claims a slot, copies
+        the cached K/V chain in (no forward pass), and streams only the
+        uncached suffix via _advance_prefills.  Cold short prompts go
+        through bucketed single-shot prefill groups; cold prompts
+        longer than the largest bucket (only when prefill_chunk is set)
+        claim a slot here and stream in chunks.  With prefill_budget
+        set, bucketed admission stops for the round once the budget is
+        spent — arrivals defer rather than stall active decode slots
+        (prefix copies are exempt: they move bytes, not FLOPs)."""
         free = [s for s in range(self.max_slots)
                 if self._slots[s] is None]
         if not free or not self._pending:
             return
         groups: dict[int, list[DecodeRequest]] = {}
         chunked: list[DecodeRequest] = []
+        cached: list[DecodeRequest] = []
         taken = 0
         for request in self._pending:
             if taken >= len(free):
                 break
-            if self.prefill_chunk and \
+            if self.prefix_cache is not None and \
+                    not request.prefix_probed:
+                request.prefix_probed = True
+                keys, hit = self.prefix_cache.acquire(
+                    request.tenant, request.prompt,
+                    limit=len(request.prompt) - 1)
+                if hit:
+                    request.prefix_nodes = list(keys)
+                    request.prefix_hit = hit
+            if request.prefix_hit:
+                cached.append(request)
+            elif self.prefill_chunk and \
                     len(request.prompt) > self.prefill_buckets[-1]:
                 chunked.append(request)
             else:
@@ -1442,7 +1901,15 @@ class ContinuousDecoder:
                 groups.setdefault(bucket, []).append(request)
             taken += 1
         del self._pending[:taken]
-        admit_t = time.monotonic() if (chunked or groups) else 0.0
+        admit_t = time.monotonic() if (chunked or groups or cached) \
+            else 0.0
+        if cached:
+            self._fit_caches(max(max(self._prefix_write_len(r)
+                                     for r in cached), self._cache_t))
+            start = time.perf_counter()
+            for request in cached:
+                self._prefix_admit(free.pop(0), request, admit_t)
+            self.stats["prefill_s"] += time.perf_counter() - start
         for request in chunked:
             slot = free.pop(0)
             request.slot = slot
@@ -1458,13 +1925,124 @@ class ContinuousDecoder:
         # owns shrinking, with full knowledge of every active context
         self._fit_caches(max(max(groups), self._cache_t))
         start = time.perf_counter()
+        before = self.stats["tokens_prefill"]
         for bucket, requests in groups.items():
             while requests:
                 width = min(self.max_slots,
                             self._next_pow2(len(requests)))
                 chunk, requests = requests[:width], requests[width:]
                 self._admit_group(bucket, width, chunk, free)
-        self.stats["prefill_s"] += time.perf_counter() - start
+        elapsed = time.perf_counter() - start
+        self.stats["prefill_s"] += elapsed
+        self._note_prefill_rate(self.stats["tokens_prefill"] - before,
+                                elapsed)
+
+    # -- prefix/KV reuse (ISSUE 13) ----------------------------------------
+    def _prefix_write_len(self, request: DecodeRequest) -> int:
+        """Copy-in write extent for a hit: the chain's tokens padded up
+        to a pow2 block count (bounded compile variants), capped at
+        max_seq — near the cap the exact length compiles instead."""
+        blocks = request.prefix_hit // self.prefix_cache.block_tokens
+        padded = self._next_pow2(blocks) * self.prefix_cache.block_tokens
+        return padded if padded <= self.max_seq else request.prefix_hit
+
+    def _prefix_zero_block(self):
+        """One shared zero pad block in the cache storage layout."""
+        if self._prefix_pad is None:
+            config = self.config
+            shape = (config.num_kv_heads,
+                     self.prefix_cache.block_tokens, config.head_dim)
+            if self.kv_int8:
+                self._prefix_pad = {
+                    "q": jnp.zeros(shape, jnp.int8),
+                    "s": jnp.zeros(shape[:2], jnp.float32)}
+            else:
+                self._prefix_pad = jnp.zeros(shape, config.dtype)
+        return self._prefix_pad
+
+    def _prefix_admit(self, slot: int, request: DecodeRequest,
+                      admit_t: float) -> None:
+        """Admit a prefix-hit request: copy the pinned chain's K/V rows
+        into the slot cache (one scatter program, queued behind the
+        decode scan like every other prefill dispatch), seed the
+        speculative context with the cached prompt tokens, and leave
+        the slot mid-prefill at the hit boundary — _advance_prefills
+        runs the uncached suffix, and the finish extend produces the
+        first token exactly like a chunked admit."""
+        cache = self.prefix_cache
+        config = self.config
+        t_write = self._prefix_write_len(request)
+        pad = (t_write - request.prefix_hit) // cache.block_tokens
+        chain = cache.nodes(request.prefix_nodes)
+        k_rows, v_rows = [], []
+        for i in range(config.num_layers):
+            k_blocks = [node.k_rows[i] for node in chain]
+            v_blocks = [node.v_rows[i] for node in chain]
+            if pad:
+                zero = self._prefix_zero_block()
+                k_blocks = k_blocks + [zero] * pad
+                v_blocks = v_blocks + [zero] * pad
+            k_rows.append(L.concat_kv_rows(k_blocks))
+            v_rows.append(L.concat_kv_rows(v_blocks))
+        ctx = np.zeros((t_write,), np.int32)
+        ctx[:request.prefix_hit] = request.prompt[:request.prefix_hit]
+        fn = _prefix_copy_fn_for(config, t_write, self.kv_int8,
+                                 bool(self.speculate_k))
+        self._k, self._v, self._context = fn(
+            self._k, self._v, self._context, k_rows, v_rows,
+            jnp.asarray(slot, jnp.int32), jnp.asarray(ctx))
+        # the copy writes t_write rows of K+V per layer — bytes, the
+        # whole point: no weight stream, no FLOPs
+        self.profiler.add_bytes(
+            "admit_dispatch",
+            t_write * self._kv_bytes_per_t // self.max_slots)
+        request.slot = slot
+        request.prefilling = True
+        request.prefill_pos = request.prefix_hit
+        self._slots[slot] = request
+        self.stats["prefix_admits"] += 1
+        if request.journey is not None:
+            request.journey.prefix_hit_tokens = request.prefix_hit
+            request.journey.admitted(admit_t, slot, "prefix-admit")
+
+    def _prefix_harvest(self, slot: int, request: DecodeRequest) -> None:
+        """Register a retiring request's K/V rows as cache blocks: the
+        prompt plus every generated token but the LAST (an emitted
+        token's K/V lands only when it is consumed as the next input,
+        so the final token's rows are never written).  Already-cached
+        blocks are skipped by key — no device work; the chain extends
+        the request's own hit, so a conversation's next turn
+        longest-matches its entire history (ISSUE 13)."""
+        cache = self.prefix_cache
+        block = cache.block_tokens
+        tokens = list(request.prompt) + \
+            [int(t) for t in request.generated[:-1]]
+        count = len(tokens) // block
+        if count == 0:
+            return
+        keys = cache.keys_for(request.tenant, tokens[:count * block])
+        start = 0
+        while start < count and cache.has(keys[start]):
+            start += 1
+        if start >= count:
+            return
+        base, end = start * block, count * block
+        layers = self.config.num_layers
+        k_splits = [L.split_kv_blocks(
+            L.slice_kv_rows(self._k[i], slot, base, end), block)
+            for i in range(layers)]
+        v_splits = [L.split_kv_blocks(
+            L.slice_kv_rows(self._v[i], slot, base, end), block)
+            for i in range(layers)]
+        parent = keys[start - 1] if start else ""
+        for j in range(start, count):
+            inserted = cache.insert(
+                request.tenant, parent, keys[j],
+                [k_splits[i][j - start] for i in range(layers)],
+                [v_splits[i][j - start] for i in range(layers)])
+            if not inserted:
+                break        # budget refused: stop, or children dangle
+            parent = keys[j]
 
     def _admit_group(self, bucket: int, width: int,
                      chunk: list, free: list) -> None:
@@ -1526,6 +2104,17 @@ class ContinuousDecoder:
     def _retire(self, slot: int) -> None:
         request = self._slots[slot]
         journey = request.journey
+        if self.prefix_cache is not None:
+            # harvest BEFORE releasing the request's own pins: the hit
+            # chain must stay resident while the new blocks link to it
+            try:
+                self._prefix_harvest(slot, request)
+            except Exception:
+                self.logger.exception("prefix harvest failed for %s",
+                                      request.request_id)
+            if request.prefix_nodes:
+                self.prefix_cache.release(request.prefix_nodes)
+                request.prefix_nodes = []
         self._slots[slot] = None
         self.stats["completed"] += 1
         count = len(request.generated)
@@ -1801,9 +2390,13 @@ class ContinuousDecoder:
             self.ttft_samples.append(ttft)
             # mergeable SLO surface (ISSUE 12): the same number the
             # deque keeps, but fleet-mergeable and carrying the worst
-            # requests' trace ids as exemplars
+            # requests' trace ids as exemplars.  Split cached/cold by
+            # the prefill label (ISSUE 13) so attainment can be quoted
+            # per population — a cache that only helps the warm half
+            # must not hide behind a blended percentile.
             self._slo_sketch(
-                "ttft", journey.tenant if journey else "").observe(
+                "ttft", journey.tenant if journey else "",
+                "cached" if request.prefix_hit else "cold").observe(
                 ttft, exemplar=(journey.trace_id or request.request_id)
                 if journey else None)
         elif now > request.last_time:
@@ -1834,19 +2427,24 @@ class ContinuousDecoder:
             "itl_count": len(self.itl_samples),
         }
 
-    def slo_sketch_stats(self) -> dict:
+    def slo_sketch_stats(self, prefill: str | None = None) -> dict:
         """The SAME latency SLOs as slo_stats, but read from the
         mergeable sketches (ISSUE 12): p50/p95/p99 per kind merged
         across this decoder's tenants, plus the worst exemplar ids.
         This is the form the bench artifact quotes (lat_llama_ttft_*)
         — fleet-aggregatable, with per-request attribution behind
-        every percentile."""
+        every percentile.  `prefill` ("cached"/"cold") restricts the
+        TTFT merge to one population (ISSUE 13 — the conversation
+        rung's A/B surface); ITL has no prefill split."""
         from .observe.sketch import merge_sketches
         out: dict = {}
         for kind in ("ttft", "itl"):
             merged = merge_sketches(
-                sketch for (sketch_kind, _), sketch in
-                self._slo_sketches.items() if sketch_kind == kind)
+                sketch for (sketch_kind, _tenant, sketch_prefill),
+                sketch in self._slo_sketches.items()
+                if sketch_kind == kind and
+                (prefill is None or kind != "ttft" or
+                 sketch_prefill == prefill))
             for q, suffix in ((0.5, "p50"), (0.95, "p95"),
                               (0.99, "p99")):
                 value = merged.quantile(q) if merged is not None \
@@ -1943,6 +2541,45 @@ def _admit_fn_for(config: LlamaConfig, bucket: int, width: int,
     return jax.jit(
         admit, donate_argnames=("k_caches", "v_caches", "tokens",
                                 "lengths", "context"))
+
+
+@functools.lru_cache(maxsize=64)
+def _prefix_copy_fn_for(config: LlamaConfig, t_write: int,
+                        kv_int8: bool, speculative: bool):
+    """Builder for the prefix-hit admit copy: writes a cached chain's
+    concatenated K/V rows into ONE slot's cache rows [0, t_write) and
+    seeds the speculative context with the cached prompt tokens.
+    Compiled once per (geometry, pow2-padded write length) — pad rows
+    are zeros landing at positions >= the hit, dead cells under the
+    same overwrite-before-attend invariant as the admit scatter's
+    padding.  No forward pass at all: a full-block hit costs one
+    scatter where a cold admit costs a prefill."""
+
+    def copy(k_caches, v_caches, context, k_rows, v_rows, slot,
+             ctx_tokens):
+        for i in range(config.num_layers):
+            if kv_int8:
+                k_caches[i] = {
+                    "q": k_caches[i]["q"].at[slot, :, :t_write].set(
+                        k_rows[i]["q"]),
+                    "s": k_caches[i]["s"].at[slot, :, :t_write].set(
+                        k_rows[i]["s"])}
+                v_caches[i] = {
+                    "q": v_caches[i]["q"].at[slot, :, :t_write].set(
+                        v_rows[i]["q"]),
+                    "s": v_caches[i]["s"].at[slot, :, :t_write].set(
+                        v_rows[i]["s"])}
+            else:
+                k_caches[i] = k_caches[i].at[slot, :, :t_write].set(
+                    k_rows[i])
+                v_caches[i] = v_caches[i].at[slot, :, :t_write].set(
+                    v_rows[i])
+        if speculative:
+            context = context.at[slot, :t_write].set(ctx_tokens)
+        return k_caches, v_caches, context
+
+    return jax.jit(copy, donate_argnames=("k_caches", "v_caches",
+                                          "context"))
 
 
 @functools.lru_cache(maxsize=64)

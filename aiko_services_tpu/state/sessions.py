@@ -158,11 +158,15 @@ class SessionTable:
                  snapshot_interval: float = 0.0,
                  default_budget: TenantBudget | None = None,
                  budgets: dict[str, TenantBudget] | None = None,
-                 on_expired=None):
+                 on_expired=None, on_demoted=None):
         """`service` supplies the runtime and the topic root (a Service
         or anything with .runtime/.topic_path).  `on_expired(keys)` is
         the expiry-batch callback: one call per wheel advance that
         lapsed anything, with every lapsed (tenant, sid).
+        `on_demoted(keys)` fires when the byte budget demotes sessions
+        to dedup-only — both hooks release whatever the payload pinned
+        OUTSIDE the table (the serving prefix cache's conversation KV
+        handles ride them, ISSUE 13 / PR 10 residue (c)).
         `snapshot_interval` > 0 re-synchronizes dirty shards' live
         consumers periodically (compacted snapshot: current state, not
         the delta history); 0 leaves recovery to lease re-requests."""
@@ -173,6 +177,7 @@ class SessionTable:
         self.default_budget = default_budget or TenantBudget()
         self.budgets = dict(budgets or {})
         self.on_expired = on_expired
+        self.on_demoted = on_demoted
         self._sessions: dict[tuple, _Session] = {}
         # per-tenant insertion-ordered sid → session (touch re-inserts,
         # so iteration order IS oldest-touched-first: the demote scan
@@ -350,6 +355,7 @@ class SessionTable:
         over = self._tenant_bytes.get(tenant, 0) - budget.max_bytes
         if over <= 0:
             return
+        demoted = []
         for session in list(held.values()):
             if over <= 0:
                 break
@@ -364,6 +370,11 @@ class SessionTable:
             self._gauge_bytes.dec(freed)
             self.stats["demoted"] += 1
             self._publish(session)
+            demoted.append(session.key)
+        if demoted and self.on_demoted is not None:
+            # demotion drops the payload, so whatever it pinned outside
+            # the table (conversation KV handles) must release too
+            self.on_demoted(demoted)
 
     def _advance(self) -> None:
         """The ONE engine timer behind every session lease: advance the

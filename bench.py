@@ -1489,6 +1489,139 @@ def bench_llama_interactive(window: float = 12.0):
     return fields
 
 
+# prefix/KV reuse cache on the conversation rung (ISSUE 13): block
+# size in tokens, or "off" to A/B the cold path (every turn re-prefills
+# its whole history — the pre-PR 13 behavior).
+LLAMA_PREFIX = os.environ.get("AIKO_BENCH_LLAMA_PREFIX", "32")
+
+
+def bench_llama_conversation(window: float = 10.0):
+    """Multi-turn conversation rung (ISSUE 13): a seeded multi-session
+    dialog over one ContinuousDecoder with the prefix/KV reuse cache.
+    Each arriving session carries a pre-existing 400-token transcript
+    (the "returning session" case — shared system prompt + its own
+    history), every turn re-submits the WHOLE history, and sessions
+    retire after a fixed turn count so fresh arrivals keep entering the
+    measured window: turn 1 re-prefills the transcript COLD, turns 2+
+    longest-match everything but the new user text — both populations
+    flow continuously at comparable prompt lengths.  Emits cached/cold
+    TTFT percentiles from the PR 12 mergeable sketches (the ttft
+    sketch's prefill label splits the populations) and the block hit
+    rate; AIKO_BENCH_LLAMA_PREFIX=off A/Bs the cold path under the
+    identical workload."""
+    import dataclasses as _dc
+
+    from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+    from aiko_services_tpu.serving import ContinuousDecoder, PrefixKVCache
+
+    base = LLAMA_PRESETS[LLAMA_PRESET]
+    config = _dc.replace(base, dtype=jnp.bfloat16, max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    prefix_off = LLAMA_PREFIX.lower() in ("off", "0", "false", "")
+    block = 32 if prefix_off else int(LLAMA_PREFIX)
+    cache = None if prefix_off else PrefixKVCache(
+        block_tokens=block, max_bytes=2 << 30, name="bench_conv")
+    slots, sps, max_new = 16, 8, 32
+    transcript, turns_per_session, user_len = 600, 6, 24
+    decoder = ContinuousDecoder(params, config, max_slots=slots,
+                                max_seq=1024, prefill_buckets=(64,),
+                                steps_per_sync=sps, prefill_chunk=64,
+                                prefix_cache=cache, name="bench_conv")
+    rng = np.random.default_rng(31)
+    sessions: dict = {}
+    turns_done = [0]
+    session_seq = [0]
+    deadline = time.perf_counter() + 3600.0
+
+    def new_session():
+        sid = f"s{session_seq[0]}"
+        session_seq[0] += 1
+        # a PRIVATE seeded transcript per session (the restored-from-
+        # state-plane shape): nothing of it is cached yet, so turn 1 is
+        # a genuinely cold full-history prefill and the cold/cached
+        # populations split cleanly — a shared system prompt would make
+        # even turn 1 a partial hit and blur the A/B (shared-prefix
+        # reuse is scored by the hit-rate field and the parity tests)
+        history = rng.integers(1, config.vocab,
+                               size=transcript).tolist()
+        sessions[sid] = {"history": history, "turns": 0}
+        return sid
+
+    def submit_turn(sid):
+        state = sessions[sid]
+        user = rng.integers(1, config.vocab, size=user_len).tolist()
+        prompt = state["history"] + user
+
+        def on_done(_rid, generated):
+            state["history"] = prompt + list(generated)
+            state["turns"] += 1
+            turns_done[0] += 1
+            if time.perf_counter() >= deadline:
+                return
+            if state["turns"] >= turns_per_session:
+                del sessions[sid]       # retired; a fresh cold
+                submit_turn(new_session())   # arrival replaces it
+            else:
+                submit_turn(sid)
+
+        decoder.submit(f"{sid}.t{state['turns']}", prompt, max_new,
+                       on_done)
+
+    # warmup: one full session generation — turn 1 compiles the cold
+    # admit / extend widths, turns 2+ the prefix-copy widths and the
+    # cached extends; measured percentiles must not carry compile
+    # stalls
+    for _ in range(8):
+        submit_turn(new_session())
+    while turns_done[0] < 8 * turns_per_session:
+        decoder.pump()
+    decoder.ttft_samples.clear()
+    decoder.itl_samples.clear()
+    decoder.gap_samples.clear()
+    decoder.clear_slo_sketches()
+    decoder.profiler.reset()
+    hit0 = (0, 0) if cache is None else (cache.stats["hit_tokens"],
+                                         cache.stats["miss_tokens"])
+
+    start = time.perf_counter()
+    deadline = start + window
+    measured0 = turns_done[0]
+    while time.perf_counter() < deadline or not decoder.idle:
+        decoder.pump()
+        if decoder.idle and time.perf_counter() >= deadline:
+            break
+
+    turns = turns_done[0] - measured0
+    if cache is None:
+        hit_rate = 0.0
+    else:
+        hits = cache.stats["hit_tokens"] - hit0[0]
+        misses = cache.stats["miss_tokens"] - hit0[1]
+        hit_rate = hits / (hits + misses) if hits + misses else 0.0
+    fields = {
+        "lat_llama_conv_config":
+            f"{LLAMA_PRESET} bf16, {slots} slots, {sps} steps/sync, "
+            f"8 concurrent sessions x {turns_per_session} turns, "
+            f"{transcript}-token restored transcript, "
+            f"{user_len}-token turns, "
+            f"prefix=" + ("off" if prefix_off else f"block{block}"),
+        "lat_llama_conv_sessions": session_seq[0],
+        "lat_llama_conv_turns": turns,
+        "lat_llama_conv_prefix_hit_rate": round(hit_rate, 4),
+    }
+    if cache is not None:
+        fields["lat_llama_conv_prefix_blocks"] = len(cache)
+        fields["lat_llama_conv_prefix_bytes"] = cache.bytes_used
+    for label in ("cold", "cached"):
+        slo = decoder.slo_sketch_stats(prefill=label)
+        for suffix in ("p50", "p95"):
+            value = slo[f"ttft_{suffix}_ms"]
+            if value is not None:
+                fields[f"lat_llama_conv_ttft_{label}_{suffix}_ms"] = \
+                    round(value, 2)
+    return fields
+
+
 # -- low-latency operating point ---------------------------------------------
 # The <150 ms p50 budget is ARCHITECTURALLY unreachable at 5 s chunks
 # (a full chunk must exist before it can be posted).  This section runs
@@ -1999,6 +2132,14 @@ def main() -> None:
               file=sys.stderr)
     except Exception as exc:
         print(f"llama interactive bench failed: {exc!r}",
+              file=sys.stderr)
+    try:
+        llama |= bench_llama_conversation()
+        print(f"llama conversation (prefix reuse): "
+              f"{ {k: v for k, v in llama.items() if '_conv_' in k} }",
+              file=sys.stderr)
+    except Exception as exc:
+        print(f"llama conversation bench failed: {exc!r}",
               file=sys.stderr)
     import gc
     gc.collect()

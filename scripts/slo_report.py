@@ -82,6 +82,17 @@ def render_report(rows: list, fmt: str = "text",
             f"{ms(row['itl_p95_ms'], 2)}/{ms(row['itl_p99_ms'], 2)} "
             f"{row['shed']:>6d} {row['rejected']:>5d}  "
             f"{','.join(row['exemplars']) or '-'}{verdict}")
+        # cached/cold TTFT split (ISSUE 13): present only when the
+        # serving side ran with prefill-labeled sketches — quotes what
+        # the prefix cache actually bought this tenant
+        if any(row.get(f"ttft_{p}_p50_ms") is not None
+               for p in ("cached", "cold")):
+            lines.append(
+                f"{'':16s} {'':>7s} prefix: cached p50/p95 "
+                f"{ms(row.get('ttft_cached_p50_ms'))}/"
+                f"{ms(row.get('ttft_cached_p95_ms'))} ms, cold "
+                f"{ms(row.get('ttft_cold_p50_ms'))}/"
+                f"{ms(row.get('ttft_cold_p95_ms'))} ms")
     if objective is not None:
         missed = [row["tenant"] for row in rows if not row["met"]]
         lines.append(

@@ -434,6 +434,79 @@ class TestLint:
                   "        self.buffer.append(x)\n")
         assert not lint_source(source, "element.py")
 
+    # -- lint-unbounded-cache (ISSUE 13) ----------------------------------
+    def test_dict_store_in_handler_flagged(self):
+        # the queue rule's sibling for KEYED state: one entry per
+        # distinct key forever — a memory leak with a hit rate
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        self._cache[frame.frame_id] = x\n")
+        assert ("lint-unbounded-cache", 3) in rules
+
+    def test_dict_store_in_hot_path_flagged(self):
+        rules = self._rules_at(
+            "def pump(self):   # graft: hot-path\n"
+            "    self._results[self._round] = 1\n")
+        assert ("lint-unbounded-cache", 2) in rules
+
+    def test_setdefault_in_handler_flagged(self):
+        rules = self._rules_at(
+            "class A:\n"
+            "    def _on_msg(self, topic, payload):\n"
+            "        self._by_topic.setdefault(topic, []).append(1)\n"
+            "    def setup(self, rt):\n"
+            "        rt.add_message_handler(self._on_msg, 't')\n")
+        assert ("lint-unbounded-cache", 3) in rules
+
+    def test_dict_store_with_eviction_exempt(self):
+        # pop/popitem/clear/del/len() on the SAME receiver is the
+        # eviction evidence — the bounded-cache idiom
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        self._cache[frame.frame_id] = x\n"
+            "        if len(self._cache) > 64:\n"
+            "            self._cache.popitem()\n")
+        assert not any(r == "lint-unbounded-cache" for r, _ in rules)
+
+    def test_constant_key_store_exempt(self):
+        # a fixed-field record update cannot grow — growth requires a
+        # DYNAMIC key
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        self._state['latest'] = x\n")
+        assert not any(r == "lint-unbounded-cache" for r, _ in rules)
+
+    def test_local_dict_store_exempt(self):
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        out = {}\n"
+            "        out[frame.frame_id] = x\n"
+            "        return out\n")
+        assert not any(r == "lint-unbounded-cache" for r, _ in rules)
+
+    def test_stream_variables_store_exempt(self):
+        # per-stream scratch is bounded by stream lifetime, not by
+        # code in this function
+        rules = self._rules_at(
+            "class PE_X:\n"
+            "    def start_stream(self, stream):\n"
+            "        stream.variables[self.name] = {}\n"
+            "    def process_frame(self, frame, x=None):\n"
+            "        frame.stream.variables[self.name] = x\n")
+        assert not any(r == "lint-unbounded-cache" for r, _ in rules)
+
+    def test_unbounded_cache_waiver(self):
+        source = ("class PE_X:\n"
+                  "    def process_frame(self, frame, x=None):\n"
+                  "        # audited: keyed by fixed rule names"
+                  "  # graft: disable=lint-unbounded-cache\n"
+                  "        self._cache[frame.frame_id] = x\n")
+        assert not lint_source(source, "element.py")
+
     # -- lint-linear-timer (ISSUE 10) -------------------------------------
     def test_remove_by_handler_identity_flagged(self):
         # cancelling by the FUNCTION is a linear scan over every

@@ -1,0 +1,567 @@
+# Prefix/KV reuse cache tests (serving.PrefixKVCache, ISSUE 13):
+# hash-addressed block prefix sharing must be BIT-IDENTICAL to cold
+# prefill across every serving composition (int8 KV, chunked prefill,
+# mid-stream admits, speculative decode), budgets must evict leaf-first
+# LRU without ever dropping a pinned block, and the SessionTable hooks
+# must release conversation KV handles on lease expiry / demotion.
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models.llama import (LLAMA_PRESETS,
+                                            llama_greedy_decode,
+                                            llama_init)
+from aiko_services_tpu.serving import (ContinuousDecoder, PrefixKVCache,
+                                       prefix_chain_keys)
+
+CONFIG = dataclasses.replace(LLAMA_PRESETS["tiny"], max_seq_len=96)
+PROMPT = [(i * 13) % 50 + 1 for i in range(40)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama_init(jax.random.PRNGKey(0), CONFIG)
+
+
+def oracle(params, prompt, max_new):
+    out = llama_greedy_decode(params, CONFIG,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def run(decoder, requests, rounds=400):
+    done = {}
+    for rid, (prompt, max_new) in requests.items():
+        decoder.submit(rid, prompt, max_new,
+                       lambda rid, t: done.update({rid: t}))
+    for _ in range(rounds):
+        decoder.pump()
+        if len(done) == len(requests):
+            break
+    assert len(done) == len(requests), \
+        f"{len(done)}/{len(requests)} completed"
+    return done
+
+
+_PAIR_SEQ = [0]
+
+
+def make_pair(params, block=8, cache_kwargs=None, **kwargs):
+    """(cold decoder, warm decoder, cache) at the same geometry."""
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("prefill_buckets", (64,))
+    kwargs.setdefault("steps_per_sync", 4)
+    cold = ContinuousDecoder(params, CONFIG, **kwargs)
+    _PAIR_SEQ[0] += 1
+    cache = PrefixKVCache(block_tokens=block, max_bytes=64 << 20,
+                          name=f"t{_PAIR_SEQ[0]}",
+                          **(cache_kwargs or {}))
+    warm = ContinuousDecoder(params, CONFIG, prefix_cache=cache,
+                             **kwargs)
+    return cold, warm, cache
+
+
+# -- key chain ------------------------------------------------------------
+
+def test_chain_keys_commit_to_path_and_tenant():
+    tokens = list(range(32))
+    keys = prefix_chain_keys("a", tokens, 8)
+    assert len(keys) == 4 and len(set(keys)) == 4
+    # content-addressed: same inputs, same chain
+    assert keys == prefix_chain_keys("a", tokens, 8)
+    # a key commits to the ENTIRE prefix behind it: changing an early
+    # token changes every later key
+    mutated = [99] + tokens[1:]
+    other = prefix_chain_keys("a", mutated, 8)
+    assert all(a != b for a, b in zip(keys, other))
+    # tenants never share blocks
+    assert prefix_chain_keys("b", tokens, 8)[0] != keys[0]
+    # "" normalizes to the default tenant (agent/decoder agreement)
+    assert prefix_chain_keys("", tokens, 8) == \
+        prefix_chain_keys("default", tokens, 8)
+    # only complete blocks are keyed
+    assert len(prefix_chain_keys("a", tokens[:15], 8)) == 1
+
+
+# -- cache parity: hit/partial/miss vs cold prefill -----------------------
+
+def test_full_hit_partial_hit_and_miss_parity(params):
+    """Greedy decode over full-hit, partial-block-hit, and miss admits
+    is bit-identical to cold prefill, and the hit actually skipped
+    prefill work (tokens_prefill counts only the uncached suffix)."""
+    cold, warm, cache = make_pair(params, prefill_chunk=16)
+    requests = {"donor": (PROMPT, 10)}
+    probes = {"full": (PROMPT, 10),
+              "part": (PROMPT[:24] + [7, 9, 3], 8),
+              "miss": ([9, 4, 2], 6)}
+    cold_out = run(cold, requests) | run(cold, probes)
+    assert run(warm, requests) == {"donor": cold_out["donor"]}
+    donor_prefill = warm.stats["tokens_prefill"]
+    warm_out = run(warm, probes)
+    assert warm_out == {k: cold_out[k] for k in probes}
+    for rid, prompt in (("full", PROMPT),
+                        ("part", probes["part"][0]),
+                        ("miss", probes["miss"][0])):
+        assert warm_out[rid] == oracle(params, prompt, probes[rid][1]), \
+            rid
+    # full hit = 4 blocks of 8 (capped at len-1), partial = 3 blocks
+    assert warm.stats["prefix_admits"] == 2
+    probe_prefill = warm.stats["tokens_prefill"] - donor_prefill
+    cold_tokens = sum(len(p) for p, _ in probes.values())
+    assert probe_prefill == cold_tokens - 32 - 24
+    assert cache.stats["hit_tokens"] == 56
+    # pins drain when slots retire
+    assert all(n.refs == 0 for n in cache._nodes.values())
+
+
+def test_int8_kv_compose_parity(params):
+    """A hit on an int8 decoder copies the {"q","s"} quantized form —
+    bit-faithful to the donor's cache (no double rounding) and a bytes
+    win — and stays token-identical to the cold int8 engine."""
+    cold, warm, cache = make_pair(params, kv_cache_dtype="int8",
+                                  prefill_chunk=16)
+    requests = {"donor": (PROMPT, 10)}
+    probes = {"full": (PROMPT, 10), "part": (PROMPT[:16] + [1, 2], 8)}
+    cold_out = run(cold, requests) | run(cold, probes)
+    run(warm, requests)
+    assert run(warm, probes) == {k: cold_out[k] for k in probes}
+    assert warm.stats["prefix_admits"] == 2
+    node = next(iter(cache._nodes.values()))
+    assert isinstance(node.k_rows[0], dict)
+    assert node.k_rows[0]["q"].dtype == jnp.int8
+
+
+@pytest.mark.slow   # >10 s call — tier-1 wall budget (ISSUE 7)
+def test_speculative_chunked_midstream_compose_parity(params):
+    """The whole composition: speculative decode x int8 KV x chunked
+    multi-wave prefill x mid-stream admits, warm vs cold — the cached
+    copy-in and suffix extends must not perturb the verify scan, the
+    side-buffer merges, or any co-resident slot."""
+    for extra in (dict(speculate_k=2),
+                  dict(speculate_k=2, kv_cache_dtype="int8")):
+        cold, warm, cache = make_pair(params, prefill_chunk=16, **extra)
+
+        def staged(decoder):
+            done = {}
+            decoder.submit("donor", PROMPT, 10,
+                           lambda rid, t: done.update({rid: t}))
+            while "donor" not in done:
+                decoder.pump()
+            # a long-running request decodes while cached admits join
+            decoder.submit("bg", [3, 1, 4, 1, 5, 9], 16,
+                           lambda rid, t: done.update({rid: t}))
+            for _ in range(2):
+                decoder.pump()
+            for rid, (p, n) in {"full": (PROMPT, 10),
+                                "part": (PROMPT[:24] + [7, 9], 8),
+                                "loop": ([7, 8, 9] * 4, 12)}.items():
+                decoder.submit(rid, p, n,
+                               lambda rid, t: done.update({rid: t}))
+            for _ in range(400):
+                decoder.pump()
+                if len(done) == 5:
+                    break
+            assert len(done) == 5
+            return done
+
+        assert staged(warm) == staged(cold), extra
+        assert warm.stats["prefix_admits"] >= 2
+        assert all(n.refs == 0 for n in cache._nodes.values())
+
+
+def test_prefix_hit_at_seq_cap_stays_bit_identical(params):
+    """A 95-token prompt at max_seq 96: the hit covers all but the
+    ragged tail, and the finish chunk's forward anchor would write
+    past max_seq — where the cache clamp plus dynamic_update_slice's
+    index clamping silently misplaced rows (found by review).  The
+    final chunk must slide back into the cached region instead
+    (idempotent overlap recompute) and stay bit-identical to cold."""
+    cold, warm, cache = make_pair(params, prefill_buckets=(16,),
+                                  max_slots=2, prefill_chunk=16)
+    prompt = [(i * 3) % 70 + 1 for i in range(95)]
+    cold_out = run(cold, {"a": (prompt, 8)})
+    run(warm, {"donor": (prompt, 8)})
+    assert run(warm, {"hit": (prompt, 8)}) == {"hit": cold_out["a"]}
+    assert warm.stats["prefix_admits"] == 1
+
+
+def test_suffix_extends_without_global_prefill_chunk(params):
+    """Prefix-hit suffixes stream through pow2-sized extends of their
+    own when prefill_chunk is unset — chunking is not a precondition
+    for reuse, and the compiled extend table stays bounded."""
+    cold, warm, cache = make_pair(params)       # no prefill_chunk
+    cold_out = run(cold, {"donor": (PROMPT, 10)}) | \
+        run(cold, {"full": (PROMPT, 10)})
+    run(warm, {"donor": (PROMPT, 10)})
+    assert run(warm, {"full": (PROMPT, 10)}) == \
+        {"full": cold_out["full"]}
+    assert warm.stats["prefix_admits"] == 1
+    # suffix of 8 uncached tokens -> one pow2 extend chunk
+    assert any(key[0] == "extend" for key in warm._prefill_fns)
+
+
+# -- eviction, budgets, pinning -------------------------------------------
+
+def _fake_rows(n_layers=2, heads=2, block=4, dim=16):
+    return [jnp.zeros((heads, block, dim), jnp.float32)
+            for _ in range(n_layers)]
+
+
+def _insert_chain(cache, tenant, tokens, block=4):
+    keys = cache.keys_for(tenant, tokens)
+    parent = ""
+    for key in keys:
+        assert cache.insert(tenant, parent, key,
+                            _fake_rows(block=block),
+                            _fake_rows(block=block))
+        parent = key
+    return keys
+
+
+def test_eviction_is_leaf_first_lru_and_respects_pins():
+    block_bytes = 2 * 2 * 2 * 4 * 16 * 4        # k+v, layers, h, b, d, f32
+    cache = PrefixKVCache(block_tokens=4, max_bytes=6 * block_bytes,
+                          name="evict")
+    chain_a = _insert_chain(cache, "t", list(range(12)))     # 3 blocks
+    # pin chain A under a session handle: it must survive any pressure
+    assert cache.session_store("t", "s1", list(range(12)))[1] == 12
+    chain_b = _insert_chain(cache, "t", [90 + i for i in range(12)])
+    assert cache.bytes_used <= 6 * block_bytes
+    # pressure: a third chain forces eviction of B's leaves (LRU,
+    # unpinned), never A's pinned blocks, never a parent before its
+    # child
+    _insert_chain(cache, "t", [60 + i for i in range(12)])
+    assert cache.bytes_used <= 6 * block_bytes
+    assert all(key in cache._nodes for key in chain_a)
+    surviving_b = [key in cache._nodes for key in chain_b]
+    # leaf-first: a surviving B block never sits above an evicted one
+    assert surviving_b == sorted(surviving_b, reverse=True)
+    for key, node in cache._nodes.items():
+        for child in node.children:
+            assert child in cache._nodes, "dangling child"
+    # releasing the pin makes A evictable; refcounts drain to zero
+    assert cache.session_release("t", "s1")
+    assert all(n.refs == 0 for n in cache._nodes.values())
+    _insert_chain(cache, "t", [30 + i for i in range(12)])
+    assert cache.bytes_used <= 6 * block_bytes
+
+
+def test_tenant_budget_isolates_and_tenants_never_share():
+    block_bytes = 2 * 2 * 2 * 4 * 16 * 4
+    cache = PrefixKVCache(block_tokens=4, max_bytes=None,
+                          tenant_max_bytes=2 * block_bytes,
+                          name="tenants")
+    _insert_chain(cache, "a", list(range(8)))          # 2 blocks: at cap
+    _insert_chain(cache, "b", list(range(8)))          # same TOKENS
+    # same tokens, different tenant -> different keys, no sharing
+    assert len(cache) == 4
+    assert cache.match("a", list(range(8)))[1] == 8
+    # tenant A over ITS budget evicts A's blocks only
+    _insert_chain(cache, "a", [50 + i for i in range(8)])
+    assert cache.tenant_bytes("a") <= 2 * block_bytes
+    assert cache.tenant_bytes("b") == 2 * block_bytes
+    assert cache.match("b", list(range(8)))[1] == 8
+
+
+def test_insert_refused_when_everything_is_pinned():
+    block_bytes = 2 * 2 * 2 * 4 * 16 * 4
+    cache = PrefixKVCache(block_tokens=4, max_bytes=2 * block_bytes,
+                          name="pinned")
+    _insert_chain(cache, "t", list(range(8)))
+    cache.session_store("t", "s", list(range(8)))      # pin everything
+    keys = cache.keys_for("t", [70, 71, 72, 73])
+    assert not cache.insert("t", "", keys[0], _fake_rows(), _fake_rows())
+    assert cache.stats["insert_refused"] == 1
+    assert keys[0] not in cache._nodes
+    # the pinned chain is intact
+    assert cache.match("t", list(range(8)))[1] == 8
+
+
+def test_serving_eviction_under_pressure_budgets_enforced(params):
+    """Harvest under a tiny byte budget: the decoder keeps serving,
+    budgets hold, live-slot pins always survive, refcounts drain."""
+    cache = PrefixKVCache(block_tokens=8, max_bytes=6 * 4096,
+                          name="pressure")
+    decoder = ContinuousDecoder(params, CONFIG, max_slots=4,
+                                prefill_buckets=(64,), steps_per_sync=4,
+                                prefill_chunk=16, prefix_cache=cache)
+    rng = np.random.default_rng(3)
+    for wave in range(4):
+        requests = {
+            f"w{wave}_{i}": (rng.integers(
+                1, CONFIG.vocab, size=int(rng.integers(20, 45))
+            ).tolist(), 6)
+            for i in range(3)}
+        out = run(decoder, requests)
+        for rid, (prompt, max_new) in requests.items():
+            assert out[rid] == oracle(params, prompt, max_new), rid
+        assert cache.bytes_used <= 6 * 4096
+    assert cache.stats["evictions"] > 0
+    assert all(n.refs == 0 for n in cache._nodes.values())
+
+
+# -- session-resident conversation KV (SessionTable hooks) ----------------
+
+def test_session_table_expiry_releases_handles(make_runtime, engine):
+    from aiko_services_tpu.event import settle_virtual
+    from aiko_services_tpu.service import Service
+    from aiko_services_tpu.state.sessions import SessionTable
+
+    runtime = make_runtime("kv_host").initialize()
+    service = Service(runtime, "kv_table")
+    cache = PrefixKVCache(block_tokens=4, name="sess")
+    table = SessionTable(service, num_shards=2, lease_time=2.0,
+                         on_expired=cache.release_sessions,
+                         on_demoted=cache.release_sessions)
+    _insert_chain(cache, "t", list(range(8)))
+    leaf, pinned = cache.session_store("t", "s1", list(range(8)))
+    assert leaf is not None and pinned == 8
+    assert table.create("t", "s1", {"kv": leaf, "kv_tokens": pinned})
+    assert any(n.refs for n in cache._nodes.values())
+    # lease lapses -> the expiry batch releases the KV handle
+    settle_virtual(engine, 2.5)
+    assert len(table) == 0
+    assert cache.stats["session_released"] == 1
+    assert all(n.refs == 0 for n in cache._nodes.values())
+    table.stop()
+
+
+def test_session_table_demotion_releases_handles(make_runtime, engine):
+    from aiko_services_tpu.service import Service
+    from aiko_services_tpu.state.sessions import SessionTable, \
+        TenantBudget
+
+    runtime = make_runtime("kv_demote").initialize()
+    service = Service(runtime, "kv_table2")
+    cache = PrefixKVCache(block_tokens=4, name="demote")
+    table = SessionTable(service, num_shards=1, lease_time=30.0,
+                         budgets={"t": TenantBudget(max_bytes=120)},
+                         on_expired=cache.release_sessions,
+                         on_demoted=cache.release_sessions)
+    _insert_chain(cache, "t", list(range(8)))
+    cache.session_store("t", "s1", list(range(8)))
+    table.create("t", "s1", {"history": "x" * 100})
+    # the second session pushes s1 over the byte budget -> demotion
+    # drops its payload AND releases its conversation KV pin
+    table.create("t", "s2", {"history": "y" * 100})
+    assert table.get("t", "s1") is None
+    assert cache.stats["session_released"] == 1
+    assert all(n.refs == 0 for n in cache._nodes.values())
+    table.stop()
+
+
+def test_llama_agent_sessions_resume_conversation(make_runtime, engine):
+    """PE_LlamaAgent with sessions=true: each turn re-submits the
+    session's whole history from the SessionTable, the prefix cache
+    longest-matches it (turn 2+ admits cached), the finished turn's
+    chain is pinned under the session handle, and lease expiry
+    releases the pins through the table hooks."""
+    from aiko_services_tpu.compute import ComputeRuntime
+    from aiko_services_tpu.event import settle_virtual
+    from aiko_services_tpu.pipeline import (Pipeline,
+                                            parse_pipeline_definition)
+
+    runtime = make_runtime("conv_host").initialize()
+    ComputeRuntime(runtime, "compute")
+    definition = parse_pipeline_definition({
+        "version": 0, "name": "p_conv", "runtime": "jax",
+        "graph": ["(PE_LlamaAgent)"],
+        "parameters": {
+            "PE_LlamaAgent.preset": "tiny",
+            "PE_LlamaAgent.max_tokens": 6,
+            "PE_LlamaAgent.prompt_length": 16,
+            "PE_LlamaAgent.mode": "continuous",
+            "PE_LlamaAgent.max_batch": 2,
+            "PE_LlamaAgent.steps_per_sync": 2,
+            "PE_LlamaAgent.prefix_block": 8,
+            "PE_LlamaAgent.sessions": True,
+            "PE_LlamaAgent.session_lease": 5.0,
+        },
+        "elements": [{
+            "name": "PE_LlamaAgent",
+            "input": [{"name": "text"}],
+            "output": [{"name": "response"},
+                       {"name": "response_tokens"}],
+            "parameters": {},
+        }],
+    })
+    pipeline = Pipeline(runtime, definition, stream_lease_time=0)
+    done = []
+    pipeline.add_frame_handler(done.append)
+    pipeline.create_stream("s1", lease_time=0)
+    agent = next(node.element for node in pipeline.graph.nodes()
+                 if node.name == "PE_LlamaAgent")
+
+    def turn(text, expect):
+        pipeline.post("process_frame", "s1", {"text": text})
+        for _ in range(4000):
+            if len(done) == expect:
+                break
+            engine.clock.advance(0.002)
+            engine.step()
+        assert len(done) == expect
+
+    turn("hello there agent", 1)
+    table = agent._session_table
+    assert table is not None and len(table) == 1
+    payload = table.get("default", next(iter(table._sessions))[1])
+    assert payload["kv_tokens"] > 0 and payload["history"]
+    assert agent.prefix_cache.stats["session_handles"] == 1
+    pinned = sum(n.refs for n in agent.prefix_cache._nodes.values())
+    assert pinned > 0
+    # turn 2 re-submits history + new text: admits through the cache
+    turn("and again please", 2)
+    assert agent.decoder.stats["prefix_admits"] >= 1
+    journeys = agent.decoder.journeys.journeys()
+    assert journeys[-1].prefix_hit_tokens > 0
+    # the second turn's prompt starts with the first turn's history
+    history_2 = table.get("default",
+                          next(iter(table._sessions))[1])["history"]
+    assert len(history_2) > len(payload["history"])
+    # lease lapses -> table expiry releases the conversation KV pins
+    settle_virtual(engine, 6.0)
+    assert len(table) == 0
+    assert all(n.refs == 0
+               for n in agent.prefix_cache._nodes.values())
+    pipeline.destroy_stream("s1")
+
+
+# -- admission estimate credits prefix hits -------------------------------
+
+def test_estimated_admit_wait_credits_prefix_hits(params):
+    """The deadline-admission estimate charges a prompt's prefill at
+    the measured per-token rate but credits expected prefix hits — a
+    cached-heavy tenant's estimate sits near the round floor instead
+    of the cold re-prefill cost (no over-shedding)."""
+    _, warm, cache = make_pair(params, prefill_chunk=16)
+    run(warm, {"donor": (PROMPT, 10)})
+    assert warm._prefill_token_ewma is not None
+    warm._round_ewma = 0.010
+    cold_prompt = [77] * len(PROMPT)
+    cold_wait = warm.estimated_admit_wait(prompt=cold_prompt)
+    warm_wait = warm.estimated_admit_wait(prompt=PROMPT)
+    base_wait = warm.estimated_admit_wait()
+    assert cold_wait > warm_wait >= base_wait
+    # the credit is the hit: 32 of 40 tokens cached
+    assert cold_wait - warm_wait == pytest.approx(
+        32 * warm._prefill_token_ewma)
+    # gate integration (ops/admission.py): the decoder estimator
+    # registers like any wait source
+    from aiko_services_tpu.ops.admission import AdmissionGate
+    gate = AdmissionGate()
+    gate.watch_decoder(warm)
+    assert gate.estimated_wait() == pytest.approx(base_wait)
+
+
+# -- journey + SLO surfaces -----------------------------------------------
+
+def test_journey_and_sketches_tag_cached_vs_cold(params):
+    from aiko_services_tpu.observe.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    cache = PrefixKVCache(block_tokens=8, name="jt", registry=registry)
+    decoder = ContinuousDecoder(params, CONFIG, max_slots=4,
+                                prefill_buckets=(64,), steps_per_sync=4,
+                                prefill_chunk=16, prefix_cache=cache,
+                                registry=registry)
+    run(decoder, {"donor": (PROMPT, 8)})
+    run(decoder, {"warm": (PROMPT, 8)})
+    journeys = {j.request_id: j for j in decoder.journeys.journeys()}
+    assert journeys["donor"].prefix_hit_tokens == 0
+    assert journeys["warm"].prefix_hit_tokens == 32
+    assert journeys["warm"].to_dict()["prefix_hit_tokens"] == 32
+    snapshot = registry.snapshot()
+    outcomes = snapshot["journey_requests_total"]["series"]
+    by_prefill = {s["labels"]["prefill"]: s["value"] for s in outcomes}
+    assert by_prefill == {"cold": 1, "cached": 1}
+    ttft = snapshot["serving_ttft_seconds"]["series"]
+    assert {s["labels"]["prefill"] for s in ttft} == {"cold", "cached"}
+    hits = snapshot["serving_prefix_hit_tokens_total"]["series"]
+    assert hits[0]["value"] == 32
+    assert snapshot["prefix_cache_bytes"]["series"][0]["value"] == \
+        cache.bytes_used
+    # the per-population merge the conversation rung reads
+    assert decoder.slo_sketch_stats(prefill="cached")["ttft_p50_ms"] \
+        is not None
+    assert decoder.slo_sketch_stats(prefill="cold")["ttft_p50_ms"] \
+        is not None
+
+
+def test_tenant_slo_rows_split_ttft_by_prefill():
+    import json
+
+    from aiko_services_tpu.observe.journey import tenant_slo_rows
+    from aiko_services_tpu.observe.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    cached = registry.sketch("serving_ttft_seconds", "",
+                             {"decoder": "d", "tenant": "acme",
+                              "prefill": "cached"})
+    cold = registry.sketch("serving_ttft_seconds", "",
+                           {"decoder": "d", "tenant": "acme",
+                            "prefill": "cold"})
+    for value in (0.010, 0.012):
+        cached.observe(value, exemplar="t1")
+    for value in (0.200, 0.240):
+        cold.observe(value, exemplar="t2")
+    snapshot = json.loads(json.dumps(registry.snapshot()))
+    row = tenant_slo_rows([snapshot])[0]
+    assert row["ttft_cached_p50_ms"] < 20 < row["ttft_cold_p50_ms"]
+    # the blended percentile still merges BOTH populations
+    assert row["ttft_cached_p50_ms"] <= row["ttft_p95_ms"]
+
+
+# -- the conversation acceptance bar --------------------------------------
+
+def test_conversation_cached_ttft_near_decode_floor(params):
+    """The ISSUE 13 acceptance shape at test scale: multi-turn
+    sessions re-submitting a deep history every turn.  Cached turns
+    must come in with TTFT p50 >= 3x lower than cold turns and the
+    block hit rate above 0.5 — cached-prefix TTFT rides the
+    decode-round floor instead of the history length.  (Token parity
+    of the warm path is proven by the tests above; this one scores the
+    latency shape, so it skips the per-length oracle compiles.)"""
+    config = dataclasses.replace(LLAMA_PRESETS["tiny"], max_seq_len=256)
+    cache = PrefixKVCache(block_tokens=8, max_bytes=64 << 20,
+                          name="conv")
+    decoder = ContinuousDecoder(params, config, max_slots=4,
+                                prefill_buckets=(16,), steps_per_sync=4,
+                                prefill_chunk=8, prefix_cache=cache)
+    rng = np.random.default_rng(5)
+    done = {}
+
+    def run_session(session, turns=3):
+        # a deep restored transcript: turn 1 re-prefills it COLD,
+        # turns 2+ longest-match everything but the new user tokens
+        history = rng.integers(1, config.vocab, size=150).tolist()
+        for turn in range(turns):
+            rid = f"s{session}.t{turn}"
+            prompt = history + rng.integers(1, config.vocab,
+                                            size=6).tolist()
+            decoder.submit(rid, prompt, 6,
+                           lambda rid, t: done.update({rid: t}))
+            for _ in range(400):
+                decoder.pump()
+                if rid in done:
+                    break
+            assert rid in done and len(done[rid]) == 6
+            history = prompt + done[rid]
+
+    # warmup session: every session follows the same turn schedule, so
+    # one full generation compiles the cold admit, the prefix-copy
+    # widths, and the cached extends — measured percentiles must not
+    # carry compile stalls (the bench rung's discipline)
+    run_session("warm")
+    decoder.clear_slo_sketches()
+    for session in range(3):
+        run_session(session)
+    cached = decoder.slo_sketch_stats(prefill="cached")["ttft_p50_ms"]
+    cold = decoder.slo_sketch_stats(prefill="cold")["ttft_p50_ms"]
+    assert cached is not None and cold is not None
+    assert cold >= 3.0 * cached, (cold, cached)
+    assert cache.hit_rate() > 0.5, cache.hit_rate()
