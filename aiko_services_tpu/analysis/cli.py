@@ -39,9 +39,13 @@ def _looks_like_pipeline(pathname: Path) -> bool:
 
 
 def self_check_findings() -> list:
-    """The repo's own gate: lint the whole package and contract-check
-    every bundled example pipeline definition."""
+    """The repo's own gate: lint the whole package, contract-check
+    every bundled example pipeline definition, and prove the declared
+    wire transfer schemas (KV transfer, ISSUE 14) agree with the
+    runtime tables that enforce them."""
+    from .graph_check import check_wire_schemas
     findings = lint_paths([_package_root()])
+    findings.extend(check_wire_schemas())
     examples = _package_root().parent / "examples"
     if examples.is_dir():
         for pathname in sorted(examples.rglob("*.json")):

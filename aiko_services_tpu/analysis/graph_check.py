@@ -34,7 +34,70 @@ from ..utils.graph import GraphError
 from .contracts import ContractError, compatible, parse_contract
 from .findings import ERROR, WARNING, Finding
 
-__all__ = ["check_definition", "check_pipeline_file"]
+__all__ = ["check_definition", "check_pipeline_file",
+           "check_wire_schemas"]
+
+# dtype-alias inverse map for the wire-schema check: contract alts
+# carry canonical numpy names; the wire runtime tables do too
+_WIRE_SCHEMA_PATH = "aiko_services_tpu/transport/wire.py"
+
+
+def check_wire_schemas(schema=None, dtypes=None, ranks=None) -> list:
+    """Prove the declared KV-transfer payload schema sound (ISSUE 14):
+    every field's contract string parses under the contract grammar,
+    and its declared dtypes/rank agree EXACTLY with the runtime
+    legality tables encode_kv_transfer/decode_kv_transfer enforce —
+    the same "declare dtype/shape" discipline the wire codecs follow
+    (WIRE_CODEC_DTYPES/WIRE_CODEC_RANK), applied to the disaggregated
+    KV transfer.  A drifted declaration is an ERROR: graft-check's
+    self-check is the gate that keeps the wire contract and the wire
+    code the same fact."""
+    schema = wire.KV_TRANSFER_SCHEMA if schema is None else schema
+    dtypes = wire.KV_TRANSFER_DTYPES if dtypes is None else dtypes
+    ranks = wire.KV_TRANSFER_RANK if ranks is None else ranks
+    findings = []
+
+    def fail(field, message):
+        findings.append(Finding(
+            rule="wire-kv-schema", severity=ERROR,
+            path=_WIRE_SCHEMA_PATH, line=0,
+            message=f"KV_TRANSFER field {field!r}: {message}"))
+
+    for field, text in schema.items():
+        try:
+            alts = parse_contract(text)
+        except ContractError as exc:
+            fail(field, f"contract {text!r} does not parse: {exc}")
+            continue
+        declared = []
+        for alt in alts:
+            if alt.codec:
+                fail(field, f"alternative {alt} names a lossy codec; "
+                            f"KV rows must cross bit-exact")
+            declared.append(alt.dtype)
+            rank = ranks.get(field)
+            if alt.shape is None or rank is None or \
+                    len(alt.shape) != rank:
+                fail(field,
+                     f"alternative {alt} rank "
+                     f"{len(alt.shape) if alt.shape else None} != "
+                     f"KV_TRANSFER_RANK {rank}")
+        runtime = dtypes.get(field)
+        if runtime is None:
+            fail(field, "missing from KV_TRANSFER_DTYPES (declared "
+                        "but never enforced)")
+        elif sorted(set(declared)) != sorted(set(runtime)):
+            fail(field, f"schema dtypes {sorted(set(declared))} != "
+                        f"runtime table {sorted(set(runtime))}")
+    for field in dtypes:
+        if field not in schema:
+            fail(field, "enforced at runtime but not declared in "
+                        "KV_TRANSFER_SCHEMA")
+    for field in ranks:
+        if field not in schema:
+            fail(field, "ranked at runtime but not declared in "
+                        "KV_TRANSFER_SCHEMA")
+    return findings
 
 
 def check_pipeline_file(pathname: str, element_classes=None,

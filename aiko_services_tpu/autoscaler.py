@@ -64,7 +64,8 @@ PROTOCOL_AUTOSCALER = ServiceProtocol("autoscaler")
 # retaining sketch payloads nobody reads would cost per-snapshot copies
 # scaling with fleet size)
 _SIGNAL_FAMILIES = ("event_mailbox_depth", "pipeline_hop_seconds",
-                    "batch_mean_wait_ms", "admission_queue_depth")
+                    "batch_mean_wait_ms", "admission_queue_depth",
+                    "prefill_queue_depth")
 
 
 @dataclass(frozen=True)
@@ -96,6 +97,17 @@ class ScalePolicy:
     # served.  None = signal off.
     ttft_p95_up: float | None = None
     ttft_p95_down: float = 0.05
+    # per-role pool signals (ISSUE 14, disaggregated prefill/decode):
+    # a PREFILL-pool autoscaler arms prefill_queue_up (worst
+    # prefill_queue_depth gauge — prompts waiting for KV compute, the
+    # TTFT backlog) and usually ttft_p95_up; a DECODE-pool autoscaler
+    # arms itl_p95_up (fleet-merged serving_itl_seconds sketch — the
+    # number a prefill burst dilates) beside its batch-wait signals.
+    # Both default OFF so existing single-pool policies are unchanged.
+    prefill_queue_up: float | None = None
+    prefill_queue_down: float = 1.0
+    itl_p95_up: float | None = None
+    itl_p95_down: float = 0.005
     # staleness/evidence window: a process silent longer than this
     # stops voting (replaces the old _SNAPSHOT_HORIZON), and the
     # underload veto considers the window's worst value
@@ -164,10 +176,19 @@ class Autoscaler(Actor):
                 "autoscaler_signal_ttft_p95_s",
                 "fleet-merged serving TTFT p95 seconds (sketch)",
                 labels),
+            "prefill_queue": registry.gauge(
+                "autoscaler_signal_prefill_queue",
+                "worst prefill-runtime queue depth", labels),
+            "itl_p95": registry.gauge(
+                "autoscaler_signal_itl_p95_s",
+                "fleet-merged serving ITL p95 seconds (sketch)",
+                labels),
         }
         self._families = set(_SIGNAL_FAMILIES)
         if self.policy.ttft_p95_up is not None:
             self._families.add("serving_ttft_seconds")
+        if self.policy.itl_p95_up is not None:
+            self._families.add("serving_itl_seconds")
         runtime.add_message_handler(self._metrics_handler, self._filter)
         self._timer = runtime.event.add_timer_handler(self.evaluate,
                                                       self.interval)
@@ -233,21 +254,31 @@ class Autoscaler(Actor):
             "queue_depth": self._worst(
                 "admission_queue_depth",
                 lambda r: r.latest(now, window)),
-            "ttft_p95": self._merged_ttft_p95(now, window),
+            "ttft_p95": self._merged_p95(
+                "serving_ttft_seconds", self.policy.ttft_p95_up,
+                now, window),
+            "prefill_queue": self._worst(
+                "prefill_queue_depth",
+                lambda r: r.latest(now, window)),
+            "itl_p95": self._merged_p95(
+                "serving_itl_seconds", self.policy.itl_p95_up,
+                now, window),
         }
 
-    def _merged_ttft_p95(self, now: float, window: float) -> float:
-        """Quantile of the CROSS-SOURCE merged windowed TTFT sketch —
-        fleet-true, not worst-of (ISSUE 12).  baseline_empty for the
-        same reason as hop_p95: one snapshot is still capacity
-        evidence.  Computed only when the policy USES the signal
-        (ttft_p95_up set) — reconstructing and merging every source's
-        delta sketch per evaluate tick is not free, and the default
-        policy ignores the result."""
-        if self.policy.ttft_p95_up is None:
+    def _merged_p95(self, family: str, armed: float | None,
+                    now: float, window: float) -> float:
+        """Quantile of a CROSS-SOURCE merged windowed sketch family —
+        fleet-true, not worst-of (ISSUE 12; ISSUE 14 adds the ITL
+        family for the decode pool).  baseline_empty for the same
+        reason as hop_p95: one snapshot is still capacity evidence.
+        Computed only when the policy USES the signal (`armed` set) —
+        reconstructing and merging every source's delta sketch per
+        evaluate tick is not free, and the default policy ignores the
+        result."""
+        if armed is None:
             return 0.0
         merged = self.store.merged_sketch(
-            "serving_ttft_seconds", now, window, baseline_empty=True)
+            family, now, window, baseline_empty=True)
         value = merged.quantile(0.95) if merged is not None else None
         return float(value) if value is not None else 0.0
 
@@ -264,12 +295,18 @@ class Autoscaler(Actor):
                                   lambda r: r.maximum(now, window))
         worst_queue = self._worst("admission_queue_depth",
                                   lambda r: r.maximum(now, window))
+        worst_prefill = self._worst("prefill_queue_depth",
+                                    lambda r: r.maximum(now, window))
         return (worst_mailbox <= policy.mailbox_depth_down
                 and signals["hop_p95"] <= policy.hop_p95_down
                 and worst_batch <= policy.batch_wait_down
                 and worst_queue <= policy.queue_depth_down
                 and (policy.ttft_p95_up is None
-                     or signals["ttft_p95"] <= policy.ttft_p95_down))
+                     or signals["ttft_p95"] <= policy.ttft_p95_down)
+                and (policy.prefill_queue_up is None
+                     or worst_prefill <= policy.prefill_queue_down)
+                and (policy.itl_p95_up is None
+                     or signals["itl_p95"] <= policy.itl_p95_down))
 
     # -- the scale loop -----------------------------------------------------
     def _count_decision(self, action: str, reason: str) -> None:
@@ -337,6 +374,9 @@ class Autoscaler(Actor):
             signals["mailbox_trend"])
         self._signal_gauges["queue_depth"].set(signals["queue_depth"])
         self._signal_gauges["ttft_p95"].set(signals["ttft_p95"])
+        self._signal_gauges["prefill_queue"].set(
+            signals["prefill_queue"])
+        self._signal_gauges["itl_p95"].set(signals["itl_p95"])
         total = len(self.manager.clients)
         self._clients_gauge.set(total)
 
@@ -358,7 +398,11 @@ class Autoscaler(Actor):
                 and signals["mailbox_trend"] >=
                 policy.mailbox_trend_up)
             or (policy.ttft_p95_up is not None
-                and signals["ttft_p95"] >= policy.ttft_p95_up))
+                and signals["ttft_p95"] >= policy.ttft_p95_up)
+            or (policy.prefill_queue_up is not None
+                and signals["prefill_queue"] >= policy.prefill_queue_up)
+            or (policy.itl_p95_up is not None
+                and signals["itl_p95"] >= policy.itl_p95_up))
         underload = not overload and self._windowed_quiet(signals, now)
         if overload:
             self._up_streak += 1

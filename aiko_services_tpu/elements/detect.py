@@ -283,6 +283,14 @@ class PE_LlamaAgent(PipelineElement):
             # ragged generation lengths no longer idle the MXU
             from ..serving import ContinuousDecoder, PrefixKVCache
             from ..utils import parse_bool
+            # serving role (ISSUE 14): tag the OWNING pipeline's
+            # discovery record so role-aware discovery/routing
+            # (serving_disagg, ops/admission.DeadlineRouter) can tell
+            # prefill, decode, and colocated pools apart
+            role, _ = self.get_parameter("role", "")
+            if role and self.pipeline is not None:
+                from ..serving_disagg import tag_role
+                tag_role(self.pipeline, str(role))
             steps_per_sync, _ = self.get_parameter("steps_per_sync", 4)
             eos_token, _ = self.get_parameter("eos_token", -1)
             # prefix/KV reuse (ISSUE 13): parameter `prefix_block` > 0
@@ -335,6 +343,30 @@ class PE_LlamaAgent(PipelineElement):
                     lease_time=float(session_lease),
                     on_expired=self.prefix_cache.release_sessions,
                     on_demoted=self.prefix_cache.release_sessions)
+            # disaggregated serving (ISSUE 14): parameter `disagg`
+            # routes prompts through a PrefillClient — a role=prefill
+            # runtime computes the prompt KV and ships it over the
+            # peer plane; this decoder only prefills the ragged
+            # suffix.  Needs the prefix cache (the shipped chain has
+            # to land somewhere) and the pipeline's services cache
+            # for role-tag discovery; falls back to local prefill
+            # whenever the pool is absent — never a dropped request.
+            self._prefill_client = None
+            disagg, _ = self.get_parameter("disagg", False)
+            if parse_bool(disagg, False) and \
+                    self.prefix_cache is not None:
+                from ..serving_disagg import PrefillClient
+                transfer_timeout, _ = self.get_parameter(
+                    "disagg_timeout", 5.0)
+                disagg_retries, _ = self.get_parameter(
+                    "disagg_retries", 1)
+                self._prefill_client = PrefillClient(
+                    self.runtime, self.decoder,
+                    services_cache=getattr(self.pipeline,
+                                           "_services_cache", None),
+                    name=self.definition.name,
+                    transfer_timeout=float(transfer_timeout),
+                    retries=int(disagg_retries))
             self._setup_done = True
             return
 
@@ -393,6 +425,9 @@ class PE_LlamaAgent(PipelineElement):
         if self._stats_timer is not None:
             self.runtime.event.remove_timer_handler(self._stats_timer)
             self._stats_timer = None
+        if getattr(self, "_prefill_client", None) is not None:
+            self._prefill_client.stop()
+            self._prefill_client = None
         if self._session_table is not None:
             self._session_table.stop()
         self.decoder.detach(self.runtime.event)
@@ -479,11 +514,29 @@ class PE_LlamaAgent(PipelineElement):
                     self.runtime.event.clock.now())
                 if remaining is not None:
                     deadline = _time.monotonic() + max(0.0, remaining)
-            accepted = self.decoder.submit(
-                f"{frame.stream_id}.{frame.frame_id}", tokens,
-                self.max_tokens, on_done, deadline=deadline,
-                tenant=tenant if self.prefix_cache is not None
-                else None)
+            request_id = f"{frame.stream_id}.{frame.frame_id}"
+            client = getattr(self, "_prefill_client", None)
+            if client is not None:
+                # disaggregated path (ISSUE 14): the transfer is
+                # async, so a decoder refusal AFTER the KV lands must
+                # fail the parked frame through resume_frame
+                def on_refused(_rid):
+                    self.pipeline.post(
+                        "resume_frame", frame, self.definition.name,
+                        RuntimeError(
+                            "decoder admission shed after prefill "
+                            "transfer: estimated admit wait outruns "
+                            "the remaining deadline budget"))
+                accepted = client.submit(
+                    request_id, tokens, self.max_tokens, on_done,
+                    deadline=deadline, tenant=tenant,
+                    on_refused=on_refused)
+            else:
+                accepted = self.decoder.submit(
+                    request_id, tokens, self.max_tokens, on_done,
+                    deadline=deadline,
+                    tenant=tenant if self.prefix_cache is not None
+                    else None)
             if not accepted:
                 return FrameOutput(False, diagnostic=(
                     "decoder admission shed: estimated admit wait "

@@ -96,7 +96,8 @@ class RequestJourney:
                  "first_token_t", "done_t", "admission_verdict",
                  "admission_wait_s", "slot", "waves", "token_ticks",
                  "tokens_total", "deadline", "deadline_margin_s",
-                 "outcome", "prompt_tokens", "prefix_hit_tokens")
+                 "outcome", "prompt_tokens", "prefix_hit_tokens",
+                 "prefill_label")
 
     def __init__(self, request_id: str, submit_t: float,
                  trace_id: str = "", parent_span_id: str = "",
@@ -131,6 +132,17 @@ class RequestJourney:
         # decoder stamps it at slot assignment, and the journey's
         # spans/outcome counters carry the cached-vs-cold tag
         self.prefix_hit_tokens = 0
+        # explicit population override (ISSUE 14): "" derives
+        # cached/cold from prefix_hit_tokens; the disaggregated
+        # serving client stamps "remote" so journeys whose prompt KV
+        # was computed by a prefill runtime form their own population
+        self.prefill_label = ""
+
+    def prefill(self) -> str:
+        """The journey's prefill population: the explicit label when
+        set (e.g. "remote"), else cached/cold from the prefix hit."""
+        return self.prefill_label or \
+            ("cached" if self.prefix_hit_tokens else "cold")
 
     # -- lifecycle hooks (decoder clock) -------------------------------------
     def admitted(self, t: float, slot: int, kind: str = "admit") -> None:
@@ -193,6 +205,7 @@ class RequestJourney:
             "tokens_total": self.tokens_total,
             "prompt_tokens": self.prompt_tokens,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefill": self.prefill(),
             "ttft_s": self.ttft_s(),
             "queue_wait_s": self.queue_wait_s(),
             "itl_s": self.itl_s(),
@@ -227,8 +240,7 @@ class RequestJourney:
                {"request_id": self.request_id, "tenant": self.tenant,
                 "outcome": self.outcome, "slot": self.slot,
                 "tokens": self.tokens_total,
-                "prefill": "cached" if self.prefix_hit_tokens
-                else "cold",
+                "prefill": self.prefill(),
                 "deadline_margin_s": self.deadline_margin_s},
                span_id=self.span_id, parent=self.parent_span_id)
         record("journey:admission", self.submit_t,
@@ -287,8 +299,7 @@ class JourneyLog:
                outcome: str = "") -> None:
         journey.finish(t, outcome)
         self.completed.append(journey)
-        self._count(journey.tenant, journey.outcome,
-                    "cached" if journey.prefix_hit_tokens else "cold")
+        self._count(journey.tenant, journey.outcome, journey.prefill())
         journey.emit_spans(proc=self.proc)
 
     def journey_for(self, trace_id: str) -> RequestJourney | None:

@@ -34,7 +34,7 @@ from typing import Callable
 from ..observe.metrics import MetricsRegistry, default_registry
 
 __all__ = ["TenantPolicy", "TenantFairQueue", "AdmissionGate",
-           "DEFAULT_TENANT"]
+           "DeadlineRouter", "DEFAULT_TENANT"]
 
 DEFAULT_TENANT = "default"
 
@@ -272,6 +272,58 @@ class TenantFairQueue:
             state.depth_gauge.set(0)
             state.deficit = 0.0
         return count
+
+
+class DeadlineRouter:
+    """Deadline-aware routing across role-tagged serving candidates
+    (ISSUE 14, the disaggregated prefill/decode split).
+
+    A prompt whose remaining deadline budget is SHORT goes to the
+    LEAST-LOADED candidate — time-to-first-token is its binding
+    constraint, and queueing behind a loaded prefill runtime is
+    exactly the wait shed-early would later punish.  Prompts with
+    ample (or no) budget round-robin so the pool shares work evenly
+    and the load signal stays meaningful.
+
+    Transport-free like the gate: callers hand in a {candidate: load}
+    snapshot (e.g. a PrefillClient's per-runtime outstanding-transfer
+    counts, or pipeline placeholder candidates filtered by role) and
+    the remaining budget in seconds.  Verdicts mirror into
+    admission_routes_total{router, verdict}."""
+
+    def __init__(self, urgent_budget_s: float = 1.0,
+                 name: str = "router",
+                 registry: MetricsRegistry | None = None):
+        self.urgent_budget_s = float(urgent_budget_s)
+        self.name = str(name)
+        self._rr = 0
+        self._registry = registry or default_registry()
+        self._counters: dict = {}
+
+    def _count(self, verdict: str) -> None:
+        counter = self._counters.get(verdict)
+        if counter is None:
+            counter = self._registry.counter(
+                "admission_routes_total",
+                "deadline-router verdicts by kind",
+                labels={"router": self.name, "verdict": verdict})
+            self._counters[verdict] = counter
+        counter.inc()
+
+    def route(self, loads: dict, remaining: float | None) -> str | None:
+        """Pick one candidate from {candidate: load}; None when the
+        pool is empty (the caller's fallback ladder takes over)."""
+        if not loads:
+            self._count("no-candidates")
+            return None
+        order = sorted(loads)           # deterministic tie-break
+        if remaining is not None and remaining <= self.urgent_budget_s:
+            self._count("urgent-least-loaded")
+            return min(order, key=lambda c: (float(loads[c] or 0.0), c))
+        self._count("round-robin")
+        choice = order[self._rr % len(order)]
+        self._rr += 1
+        return choice
 
 
 class AdmissionGate:

@@ -489,6 +489,13 @@ class _RemoteElementPlaceholder:
         self.topic_path = None
         # topic_path -> peer endpoint tag value | None
         self.candidates: dict[str, str | None] = {}
+        # topic_path -> advertised serving role ("prefill" / "decode" /
+        # "colocated" / "" when untagged) — ISSUE 14: the registrar
+        # record's role tag, consumed by role-aware candidate rotation
+        # (Pipeline._rotate_candidate: a service filter loose enough
+        # to match several roles must not fail a decode hop over onto
+        # a prefill runtime)
+        self.roles: dict[str, str] = {}
         self.buffer: list = []          # (entry, one_way) pending sends
         self.outstanding = 0            # request/response hops in flight
         self.flush_scheduled = False
@@ -821,9 +828,13 @@ class Pipeline(PipelineElement):
             placeholder = self._remote[node_name]
             if command == "add":
                 # candidates map topic_path → advertised peer endpoint
-                # tag (None when the service has no peer data plane)
-                endpoint = ServiceTags.to_dict(fields.tags).get("peer")
+                # tag (None when the service has no peer data plane);
+                # the role tag (ISSUE 14) rides the same record
+                tags = ServiceTags.to_dict(fields.tags)
+                endpoint = tags.get("peer")
                 placeholder.candidates[fields.topic_path] = endpoint
+                placeholder.roles[fields.topic_path] = \
+                    tags.get("role", "")
                 if not placeholder.found:
                     self._activate_remote(node_name, fields.topic_path)
                 elif placeholder.topic_path == fields.topic_path:
@@ -833,6 +844,7 @@ class Pipeline(PipelineElement):
                     self._negotiate_peer(fields.topic_path)
             elif command == "remove":
                 placeholder.candidates.pop(fields.topic_path, None)
+                placeholder.roles.pop(fields.topic_path, None)
                 if self._peer_host is not None:
                     # the service left: its channel (if any) is a
                     # corpse — unpin so traffic rides the broker to
@@ -1536,11 +1548,20 @@ class Pipeline(PipelineElement):
 
     def _rotate_candidate(self, node_name: str) -> None:
         """Advance a remote node to its next discovered candidate (no-op
-        with fewer than two)."""
+        with fewer than two).  Role-aware (ISSUE 14): when the active
+        candidate advertises a role tag and SAME-role alternatives
+        exist, rotation stays within them — a filter loose enough to
+        match a mixed prefill/decode fleet must not fail a decode hop
+        over onto a prefill runtime."""
         placeholder = self._remote.get(node_name)
         if placeholder is None or len(placeholder.candidates) < 2:
             return
         order = list(placeholder.candidates)
+        role = placeholder.roles.get(placeholder.topic_path, "")
+        same_role = [t for t in order
+                     if placeholder.roles.get(t, "") == role]
+        if placeholder.topic_path in same_role and len(same_role) > 1:
+            order = same_role
         try:
             index = order.index(placeholder.topic_path)
         except ValueError:

@@ -233,6 +233,20 @@ class DecodeRequest:
     prefix_nodes: list = dataclasses.field(default_factory=list)
     prefix_probed: bool = False
     tenant: str = ""
+    # prefill population label override (ISSUE 14): "" derives
+    # cached/cold from prefix_hit; the disaggregated client stamps
+    # "remote" so the TTFT sketches and journeys split out requests
+    # whose prompt KV was computed by a prefill runtime
+    prefill_label: str = ""
+    # in-flight prefix dedup window (ISSUE 14 satellite, PR 13 residue
+    # d): dedup_wait holds the leading-block key this request is
+    # waiting on (a same-batch duplicate defers until the leader's
+    # prompt blocks land); dedup_hot marks a leader some follower is
+    # waiting on (its prompt harvests EARLY, at first token, instead
+    # of at retire); inflight_key is the leader's registration key
+    dedup_wait: str = ""
+    dedup_hot: bool = False
+    inflight_key: str = ""
 
 
 def prefix_chain_keys(tenant: str, tokens, block_tokens: int) -> list:
@@ -360,6 +374,97 @@ class PrefixKVCache:
             raise ValueError(
                 f"prefix cache {self.name!r} already bound to layout "
                 f"{self._layout}, decoder wants {tuple(layout)}")
+
+    @property
+    def layout(self) -> tuple | None:
+        """The bound storage layout — the geometry handshake the
+        disaggregated KV transfer carries (ISSUE 14): a prefill
+        runtime's transfer declares its donor layout and the decode
+        side refuses a mismatch before any row lands."""
+        return self._layout
+
+    def wire_layout(self) -> tuple:
+        """The layout as wire-safe string fields (what
+        transport.wire.encode_kv_transfer ships)."""
+        return tuple(str(f) for f in (self._layout or ()))
+
+    def layout_compatible(self, fields) -> bool:
+        """True when a transfer's declared layout fields match this
+        cache's bound layout (string-compared: the fields crossed a
+        text-semantics wire)."""
+        return self._layout is not None and \
+            tuple(str(f) for f in fields) == self.wire_layout()
+
+    # -- disaggregated KV admit (ISSUE 14) ----------------------------------
+    def install_chain(self, tenant: str, tokens, start_block: int,
+                      blocks) -> int:
+        """Install shipped chain blocks [start_block, start_block +
+        len(blocks)) of `tokens` into this cache — the decode-side KV
+        admit path of the disaggregated split.  Keys are recomputed
+        locally from the tokens (content-addressed: the hash chain IS
+        the handle, nothing but indices crosses for blocks the decode
+        side already holds).  Rows must be in this cache's storage
+        layout; host ndarrays are fine — a hit's copy-in concat
+        device-puts the admitted chain as one transfer per layer
+        (serving_disagg installs owned host copies, deliberately NOT
+        per-leaf device_puts on the event loop).  Returns
+        the number of blocks newly resident (already-cached keys count
+        — the transfer confirmed them); stops early when the byte
+        budget refuses an insert, so children never dangle."""
+        tokens = [int(t) for t in tokens]
+        count = min(len(tokens) // self.block_tokens,
+                    start_block + len(blocks))
+        if count <= start_block:
+            return 0
+        keys = self.keys_for(tenant,
+                             tokens[:count * self.block_tokens])
+        parent = keys[start_block - 1] if start_block else ""
+        installed = 0
+        for j in range(start_block, count):
+            entry = blocks[j - start_block]
+            self._check_block_geometry(entry)
+            if not self.insert(tenant, parent, keys[j],
+                               entry["k"], entry["v"]):
+                break
+            installed += 1
+            parent = keys[j]
+        return installed
+
+    def _check_block_geometry(self, entry) -> None:
+        """Refuse a shipped block whose ARRAYS do not match the bound
+        layout — the wire schema proves dtype/rank, but a schema-legal
+        payload with the wrong layer count or head/head-dim extents
+        would poison the slot cache and wedge the pump at the next hit
+        (review finding).  Raises ValueError; the disaggregated client
+        rides its corrupt-transfer rung."""
+        if self._layout is None:
+            raise ValueError("install into an unbound prefix cache")
+        layers, heads, head_dim = (int(self._layout[0]),
+                                   int(self._layout[1]),
+                                   int(self._layout[2]))
+        int8 = str(self._layout[4]) not in ("False", "0", "")
+        for side in ("k", "v"):
+            rows = entry[side]
+            if len(rows) != layers:
+                raise ValueError(
+                    f"block ships {len(rows)} layers, cache layout "
+                    f"has {layers}")
+            want = (heads, self.block_tokens, head_dim)
+            for leaf in rows:
+                if isinstance(leaf, dict) != int8:
+                    raise ValueError(
+                        f"block {side} storage form does not match "
+                        f"the cache's int8={int8} layout")
+                values = leaf["q"] if isinstance(leaf, dict) else leaf
+                if tuple(values.shape) != want:
+                    raise ValueError(
+                        f"block {side} rows shape "
+                        f"{tuple(values.shape)} != layout {want}")
+                if isinstance(leaf, dict) and \
+                        tuple(leaf["s"].shape) != want[:2]:
+                    raise ValueError(
+                        f"block {side} scale shape "
+                        f"{tuple(leaf['s'].shape)} != {want[:2]}")
 
     # -- lookup ------------------------------------------------------------
     def keys_for(self, tenant: str, tokens) -> list:
@@ -1346,6 +1451,11 @@ class ContinuousDecoder:
                                     self.speculate_ngram, KV_WRITE) \
             if self.speculate_k else _step_for(config, KV_WRITE,
                                                ATTENTION_IMPL)
+        # in-flight prefix dedup window (ISSUE 14 satellite): leading
+        # block key -> the request currently prefilling that chain.
+        # Bounded by the slot pool: entries unregister at early
+        # harvest or retire, and only admitted requests register.
+        self._inflight_chains: dict[str, DecodeRequest] = {}
         self._prefill_fns: dict = {}
         self._slots: list[DecodeRequest | None] = [None] * max_slots
         self._pending: list[DecodeRequest] = []
@@ -1419,7 +1529,8 @@ class ContinuousDecoder:
              "bytes_moved": 0, "prefill_chunks": 0,
              "chunk_admits": 0, "prefix_admits": 0,
              "round_prefill_tokens_max": 0,
-             "admission_shed": 0},
+             "admission_shed": 0,
+             "dedup_deferred": 0, "dedup_shared": 0},
             metric="serving_decoder_total",
             help="continuous-decoder events by kind",
             # levels and time-sums stay dict-only: a high-water mark or
@@ -1521,7 +1632,8 @@ class ContinuousDecoder:
 
     def submit(self, request_id: str, prompt, max_new_tokens: int,
                callback, deadline: float | None = None,
-               tenant: str | None = None) -> bool:
+               tenant: str | None = None,
+               prefill_label: str | None = None) -> bool:
         """Enqueue one request; returns False when deadline-aware
         admission rejected it instead (the callback is NOT invoked —
         the caller owns the refusal).  `deadline` (absolute,
@@ -1584,10 +1696,16 @@ class ContinuousDecoder:
                 self.journeys.finish(journey, time.monotonic(),
                                      outcome="shed")
                 return False
+        if prefill_label:
+            # population override (ISSUE 14): a remote-prefilled
+            # request is "cached" mechanically (the shipped chain
+            # hits) but belongs to its own TTFT/journey population
+            journey.prefill_label = str(prefill_label)
         self._pending.append(DecodeRequest(
             request_id, prompt, int(max_new_tokens), callback,
             submit_time=now, journey=journey, deadline=deadline,
-            tenant=journey.tenant))
+            tenant=journey.tenant,
+            prefill_label=str(prefill_label or "")))
         return True
 
     def attach(self, engine, period: float = 0.002) -> int:
@@ -1872,12 +1990,72 @@ class ContinuousDecoder:
         groups: dict[int, list[DecodeRequest]] = {}
         chunked: list[DecodeRequest] = []
         cached: list[DecodeRequest] = []
+        deferred: list[DecodeRequest] = []      # in-flight dedup waits
         taken = 0
-        for request in self._pending:
+        index = 0
+        pending = self._pending
+        while index < len(pending):
+            request = pending[index]
             if taken >= len(free):
                 break
+            if self.prefix_cache is not None and request.dedup_wait:
+                # in-flight prefix dedup window (ISSUE 14 satellite,
+                # PR 13 residue d): this request deferred behind a
+                # same-batch duplicate whose prompt is prefilling NOW.
+                # Its leader's prompt blocks land at the leader's
+                # FIRST TOKEN (early harvest below), so the wait is a
+                # couple of rounds, not a generation; a leader that
+                # left without inserting (budget refusal, failure)
+                # releases the follower to prefill cold.
+                if self.prefix_cache.has(request.dedup_wait) or \
+                        request.dedup_wait not in self._inflight_chains:
+                    if self.prefix_cache.has(request.dedup_wait):
+                        self.stats["dedup_shared"] += 1
+                    request.dedup_wait = ""     # probe sees the truth
+                else:
+                    deferred.append(request)    # keeps its FIFO rank,
+                    index += 1                  # consumes no slot
+                    continue
             if self.prefix_cache is not None and \
                     not request.prefix_probed:
+                block = self.prefix_cache.block_tokens
+                if len(request.prompt) > block:
+                    lead = self.prefix_cache.keys_for(
+                        request.tenant, request.prompt[:block])[0]
+                    leader = self._inflight_chains.get(lead)
+                    if leader is not None and leader is not request \
+                            and not self.prefix_cache.has(lead):
+                        if leader.generated and leader.slot >= 0 and \
+                                self._slots[leader.slot] is leader:
+                            # the leader is PAST its first token: its
+                            # prompt rows are device-written, so
+                            # harvest NOW and let this request probe a
+                            # hit this very round — a follower that
+                            # arrives mid-generation must not wait out
+                            # the leader's whole generation (review
+                            # finding: dedup_hot is only consulted at
+                            # the leader's first token)
+                            try:
+                                self._prefix_harvest_prompt(
+                                    leader.slot, leader)
+                            except Exception:
+                                self.logger.exception(
+                                    "late prompt harvest failed for "
+                                    "%s", leader.request_id)
+                        if not self.prefix_cache.has(lead):
+                            # duplicate of an in-flight prompt: wait
+                            # for the leader's early prompt harvest
+                            # (at its first token) instead of missing
+                            # the cache and prefilling it twice — the
+                            # probe (and its hit/miss metrics) runs
+                            # once, at the real admit
+                            leader.dedup_hot = True
+                            request.dedup_wait = lead
+                            self.stats["dedup_deferred"] += 1
+                            deferred.append(request)
+                            index += 1
+                            continue
+                        self.stats["dedup_shared"] += 1
                 request.prefix_probed = True
                 keys, hit = self.prefix_cache.acquire(
                     request.tenant, request.prompt,
@@ -1899,8 +2077,19 @@ class ContinuousDecoder:
                     break        # FIFO: defer, don't reorder past it
                 self._round_prefill_tokens += bucket
                 groups.setdefault(bucket, []).append(request)
+            if self.prefix_cache is not None and \
+                    not request.prefix_hit and \
+                    len(request.prompt) >= self.prefix_cache.block_tokens:
+                # cold prompt with >= 1 complete block: register as a
+                # potential dedup leader until its blocks are cached
+                # (early harvest) or it retires
+                request.inflight_key = self.prefix_cache.keys_for(
+                    request.tenant,
+                    request.prompt[:self.prefix_cache.block_tokens])[0]
+                self._inflight_chains[request.inflight_key] = request
             taken += 1
-        del self._pending[:taken]
+            index += 1
+        self._pending = deferred + pending[index:]
         admit_t = time.monotonic() if (chunked or groups or cached) \
             else 0.0
         if cached:
@@ -2013,14 +2202,33 @@ class ContinuousDecoder:
         blocks are skipped by key — no device work; the chain extends
         the request's own hit, so a conversation's next turn
         longest-matches its entire history (ISSUE 13)."""
+        self._harvest_rows(slot, request.tenant,
+                           list(request.prompt) +
+                           [int(t) for t in request.generated[:-1]])
+
+    def _prefix_harvest_prompt(self, slot: int,
+                               request: DecodeRequest) -> None:
+        """Early prompt harvest (ISSUE 14 satellite, PR 13 residue d):
+        the moment a dedup-hot leader's first token resolves, its
+        prompt rows are device-written — insert the prompt blocks NOW
+        so same-batch duplicates share the prefill instead of waiting
+        for the whole generation to retire.  The generated tokens
+        still harvest at retire, as before."""
+        self._harvest_rows(slot, request.tenant, list(request.prompt))
+        request.dedup_hot = False
+        if request.inflight_key and \
+                self._inflight_chains.get(request.inflight_key) \
+                is request:
+            self._inflight_chains.pop(request.inflight_key, None)
+            request.inflight_key = ""
+
+    def _harvest_rows(self, slot: int, tenant: str, tokens) -> None:
         cache = self.prefix_cache
         block = cache.block_tokens
-        tokens = list(request.prompt) + \
-            [int(t) for t in request.generated[:-1]]
         count = len(tokens) // block
         if count == 0:
             return
-        keys = cache.keys_for(request.tenant, tokens[:count * block])
+        keys = cache.keys_for(tenant, tokens[:count * block])
         start = 0
         while start < count and cache.has(keys[start]):
             start += 1
@@ -2037,7 +2245,7 @@ class ContinuousDecoder:
         parent = keys[start - 1] if start else ""
         for j in range(start, count):
             inserted = cache.insert(
-                request.tenant, parent, keys[j],
+                tenant, parent, keys[j],
                 [k_splits[i][j - start] for i in range(layers)],
                 [v_splits[i][j - start] for i in range(layers)])
             if not inserted:
@@ -2104,6 +2312,13 @@ class ContinuousDecoder:
     def _retire(self, slot: int) -> None:
         request = self._slots[slot]
         journey = request.journey
+        if request.inflight_key and \
+                self._inflight_chains.get(request.inflight_key) \
+                is request:
+            # dedup-leader registration ends with the request; a
+            # follower still waiting re-probes and goes cold if the
+            # harvest below is refused by the byte budget
+            self._inflight_chains.pop(request.inflight_key, None)
         if self.prefix_cache is not None:
             # harvest BEFORE releasing the request's own pins: the hit
             # chain must stay resident while the new blocks link to it
@@ -2390,15 +2605,30 @@ class ContinuousDecoder:
             self.ttft_samples.append(ttft)
             # mergeable SLO surface (ISSUE 12): the same number the
             # deque keeps, but fleet-mergeable and carrying the worst
-            # requests' trace ids as exemplars.  Split cached/cold by
-            # the prefill label (ISSUE 13) so attainment can be quoted
-            # per population — a cache that only helps the warm half
-            # must not hide behind a blended percentile.
+            # requests' trace ids as exemplars.  Split the population
+            # by the prefill label (ISSUE 13/14): cached/cold from the
+            # prefix probe, or an explicit override ("remote" for
+            # disaggregated prefill) so each serving mode's attainment
+            # is quotable on its own — a cache or a prefill pool that
+            # only helps one population must not hide behind a blended
+            # percentile.
             self._slo_sketch(
                 "ttft", journey.tenant if journey else "",
-                "cached" if request.prefix_hit else "cold").observe(
+                request.prefill_label or
+                ("cached" if request.prefix_hit else "cold")).observe(
                 ttft, exemplar=(journey.trace_id or request.request_id)
                 if journey else None)
+            if request.dedup_hot and self.prefix_cache is not None:
+                # a same-batch duplicate is waiting on this prompt:
+                # its rows are device-written now (the first token
+                # resolved), so harvest them early instead of at
+                # retire (ISSUE 14 satellite)
+                try:
+                    self._prefix_harvest_prompt(slot, request)
+                except Exception:
+                    self.logger.exception(
+                        "early prompt harvest failed for %s",
+                        request.request_id)
         elif now > request.last_time:
             request.max_gap = max(request.max_gap,
                                   now - request.last_time)
@@ -2427,22 +2657,27 @@ class ContinuousDecoder:
             "itl_count": len(self.itl_samples),
         }
 
-    def slo_sketch_stats(self, prefill: str | None = None) -> dict:
+    def slo_sketch_stats(self, prefill: str | None = None,
+                         tenant: str | None = None) -> dict:
         """The SAME latency SLOs as slo_stats, but read from the
         mergeable sketches (ISSUE 12): p50/p95/p99 per kind merged
         across this decoder's tenants, plus the worst exemplar ids.
         This is the form the bench artifact quotes (lat_llama_ttft_*)
         — fleet-aggregatable, with per-request attribution behind
-        every percentile.  `prefill` ("cached"/"cold") restricts the
-        TTFT merge to one population (ISSUE 13 — the conversation
-        rung's A/B surface); ITL has no prefill split."""
+        every percentile.  `prefill` ("cached"/"cold"/"remote")
+        restricts the TTFT merge to one population (ISSUE 13/14 — the
+        conversation and disagg rungs' A/B surfaces); ITL has no
+        prefill split.  `tenant` restricts BOTH kinds to one tenant's
+        sketches (the disagg rung isolates its decode-stream ITL from
+        the burst population this way)."""
         from .observe.sketch import merge_sketches
         out: dict = {}
         for kind in ("ttft", "itl"):
             merged = merge_sketches(
-                sketch for (sketch_kind, _tenant, sketch_prefill),
+                sketch for (sketch_kind, sketch_tenant, sketch_prefill),
                 sketch in self._slo_sketches.items()
                 if sketch_kind == kind and
+                (tenant is None or sketch_tenant == tenant) and
                 (prefill is None or kind != "ttft" or
                  sketch_prefill == prefill))
             for q, suffix in ((0.5, "p50"), (0.95, "p95"),

@@ -437,6 +437,15 @@ class PeerHost:
         # duplicated/retried peer_open replays the SAME accept instead
         # of building a second channel (bounded ring)
         self._answered_opens: dict[str, list] = {}
+        # reply-pin attachment over a SHARED channel (ISSUE 14
+        # satellite, the PR 6 named seam): a second pipeline whose
+        # requests already ride an existing channel asks the serving
+        # side to pin ITS reply topic too, instead of silently taking
+        # broker replies forever.  (channel_id, topic) -> "pending" |
+        # "acked"; dropped with the channel, re-sent when a pending
+        # ask expires unanswered.
+        self._attached: dict[tuple, str] = {}
+        self._attach_pending: dict[str, dict] = {}
         # service_topic_path → negotiation record (for re-dialing)
         self._negotiations: dict[str, dict] = {}
         self._listeners: list = []      # (kind, sock, addr)
@@ -451,7 +460,8 @@ class PeerHost:
             {"sent": 0, "received": 0, "fallback": 0, "handshakes": 0,
              "accepted": 0, "refused": 0, "rejected_stale": 0,
              "dup_accepts": 0, "closed": 0, "renegotiations": 0,
-             "expired_handshakes": 0, "rx_shed": 0, "tx_shed": 0},
+             "expired_handshakes": 0, "rx_shed": 0, "tx_shed": 0,
+             "attach_requests": 0, "attach_pins": 0, "attach_acks": 0},
             metric="peer_events_total",
             help="peer data-plane events by kind, all hosts")
         self._open_gauge = default_registry().gauge(
@@ -555,9 +565,20 @@ class PeerHost:
             record = self._negotiations.setdefault(
                 service_topic_path,
                 {"service": service_topic_path, "attempts": 0})
-            record.update({"tag": tag_value,
-                           "pin_topics": list(pin_topics),
-                           "reply_topics": list(reply_topics)})
+            # topics ACCUMULATE across negotiators: two pipelines
+            # sharing one service each contribute their reply topic,
+            # and a redial after a channel death must re-pin BOTH —
+            # overwriting with the latest caller's list silently
+            # stranded the earlier pipeline's replies on the broker
+            # after every redial (review finding)
+            record.update({
+                "tag": tag_value,
+                "pin_topics": sorted(
+                    set(record.get("pin_topics", ())) |
+                    set(pin_topics)),
+                "reply_topics": sorted(
+                    set(record.get("reply_topics", ())) |
+                    set(reply_topics))})
             if not _redial:
                 # fresh EXTERNAL discovery facts earn a fresh retry/
                 # redial budget (a service that once exhausted its
@@ -567,11 +588,28 @@ class PeerHost:
                 # inside its own loop
                 record["attempts"] = 0
                 record["redials"] = 0
-            if any(t in self._pins for t in pin_topics):
+            pinned = next((self._pins[t] for t in pin_topics
+                           if t in self._pins), None)
+            missing: list = []
+            if pinned is not None:
+                # requests already ride a live channel: a SECOND
+                # pipeline negotiating the same service only needs its
+                # reply topics pinned on the serving side — attach
+                # them over the existing channel instead of silently
+                # leaving its replies on the broker (PR 6 named seam).
+                # The send happens OUTSIDE the lock (it publishes).
+                missing = [t for t in reply_topics if pinned.alive and
+                           (pinned.channel_id, t) not in self._attached]
+                for topic in missing:
+                    self._attached[(pinned.channel_id, topic)] = \
+                        "pending"
+            elif any(p["service"] == service_topic_path
+                     for p in self._pending.values()):
                 return False
-            if any(p["service"] == service_topic_path
-                   for p in self._pending.values()):
-                return False
+        if pinned is not None:
+            if missing:
+                self._send_attach(service_topic_path, pinned, missing)
+            return False
         return self._dial(record)
 
     def _choose_endpoint(self, tag_value: str):
@@ -653,6 +691,10 @@ class PeerHost:
             self._on_peer_accept(params)
         elif command == "peer_refuse" and len(params) >= 2:
             self._on_peer_refuse(params)
+        elif command == "peer_attach" and len(params) >= 5:
+            self._on_peer_attach(params)
+        elif command == "peer_attached" and len(params) >= 2:
+            self._on_peer_attached(params)
 
     def _refuse(self, reply_topic, handshake_id, reason) -> None:
         from ..utils import generate
@@ -765,6 +807,8 @@ class PeerHost:
             channel.initiated = True
             channel.service_topic_path = state["service"]
             self._register(channel, state["pin_topics"])
+            self._note_attached(channel.channel_id,
+                                state["reply_topics"])
             record = self._negotiations.get(state["service"])
             if record is not None:      # a live channel earns a clean
                 record["attempts"] = 0  # retry/redial budget back
@@ -802,16 +846,114 @@ class PeerHost:
         channel.initiated = True
         channel.service_topic_path = state["service"]
         self._register(channel, state["pin_topics"])
+        self._note_attached(channel_id, state["reply_topics"])
         channel.start_reader()
         record = self._negotiations.get(state["service"])
         if record is not None:
             record["attempts"] = 0
             record["redials"] = 0
 
+    # -- reply-pin attachment over a shared channel (ISSUE 14 satellite) ----
+    def _send_attach(self, service_topic_path: str, channel,
+                     topics) -> None:
+        """Ask the serving side of an existing channel to pin `topics`
+        (our reply topics) to it.  Rides the broker like the handshake;
+        an unanswered ask expires and a later negotiate retries."""
+        attach_id = uuid.uuid4().hex[:12]
+        state = {"channel_id": channel.channel_id,
+                 "topics": list(topics)}
+        with self._lock:
+            self._attach_pending[attach_id] = state
+            while len(self._attach_pending) > _EXPECTED_HELLO_CAP:
+                self._expire_attach_locked(
+                    next(iter(self._attach_pending)))
+        state["timer"] = self.runtime.event.add_oneshot_handler(
+            lambda: self._attach_expired(attach_id),
+            self.handshake_timeout)
+        self.stats["attach_requests"] += 1
+        from ..utils import generate
+        from ..service import ServiceTopicPath
+        parsed = ServiceTopicPath.parse(service_topic_path)
+        process_path = parsed.process_path if parsed \
+            else service_topic_path
+        self.runtime.publish(
+            f"{process_path}/0/peer",
+            generate("peer_attach",
+                     [attach_id, self.topic_peer, channel.channel_id,
+                      self.client_id, list(topics)]))
+
+    def _expire_attach_locked(self, attach_id: str) -> None:
+        state = self._attach_pending.pop(attach_id, None)
+        if state is None:
+            return
+        for topic in state["topics"]:
+            key = (state["channel_id"], topic)
+            if self._attached.get(key) == "pending":
+                del self._attached[key]     # a later negotiate retries
+
+    def _attach_expired(self, attach_id: str) -> None:
+        with self._lock:
+            self._expire_attach_locked(attach_id)
+
+    def _on_peer_attach(self, params) -> None:
+        """Serving side: pin the caller's reply topics to an ALREADY
+        open channel it shares with another pipeline of the same
+        process — no new handshake, no second channel."""
+        attach_id, reply_topic, channel_id, _caller = \
+            [str(p) for p in params[:4]]
+        topics = [str(t) for t in (params[4] or [])] \
+            if isinstance(params[4], (list, tuple)) else [str(params[4])]
+        if self.closed:
+            return
+        with self._lock:
+            channel = self._channels.get(channel_id)
+            if channel is not None and channel.alive:
+                for topic in topics:
+                    self._pins[topic] = channel
+            else:
+                channel = None
+        if channel is None:
+            self._refuse(reply_topic, attach_id, "no-channel")
+            return
+        self.stats["attach_pins"] += len(topics)
+        logger.info("peer %s: attached %r to channel %s",
+                    self.client_id, topics, channel_id)
+        from ..utils import generate
+        self.runtime.publish(reply_topic,
+                             generate("peer_attached",
+                                      [attach_id, channel_id]))
+
+    def _on_peer_attached(self, params) -> None:
+        attach_id = str(params[0])
+        with self._lock:
+            state = self._attach_pending.pop(attach_id, None)
+            if state is not None:
+                for topic in state["topics"]:
+                    key = (state["channel_id"], topic)
+                    if key in self._attached:
+                        self._attached[key] = "acked"
+        if state is None:
+            return
+        timer = state.get("timer")
+        if timer is not None:
+            self.runtime.event.remove_timer_handler(timer)
+        self.stats["attach_acks"] += 1
+
     def _on_peer_refuse(self, params) -> None:
         handshake_id, reason = str(params[0]), str(params[1])
+        attach_timer = None
         with self._lock:
             state = self._pending.pop(handshake_id, None)
+            if state is None and handshake_id in self._attach_pending:
+                # a refused ATTACH (channel died serving-side): clear
+                # the pending marks so a later negotiate retries or
+                # re-dials with current facts
+                attach_timer = \
+                    self._attach_pending[handshake_id].get("timer")
+                self._expire_attach_locked(handshake_id)
+        if attach_timer is not None:
+            self.runtime.event.remove_timer_handler(attach_timer)
+            return
         if state is None:
             return
         self._cancel_handshake_timer(state)
@@ -889,6 +1031,14 @@ class PeerHost:
                     self.client_id, channel.kind, channel.channel_id,
                     channel.peer_name, list(topics))
 
+    def _note_attached(self, channel_id: str, topics) -> None:
+        """Record reply topics the serving side pinned as part of the
+        ORIGINAL negotiation, so a later negotiate over the shared
+        channel only attaches genuinely new ones."""
+        with self._lock:
+            for topic in topics or ():
+                self._attached[(channel_id, topic)] = "acked"
+
     def _channel_closed(self, channel: PeerChannel, reason: str) -> None:
         with self._lock:
             registered = self._channels.pop(channel.channel_id, None)
@@ -898,6 +1048,9 @@ class PeerHost:
                            if c.channel_id == channel.channel_id]
             for topic in dead_topics:
                 del self._pins[topic]
+            for key in [k for k in self._attached
+                        if k[0] == channel.channel_id]:
+                del self._attached[key]
         self.stats["closed"] += 1
         self._open_gauge.dec()
         service = self._channel_service(channel) or \
@@ -971,6 +1124,23 @@ class PeerHost:
         self.negotiate(service_topic_path, record.get("tag", ""),
                        record.get("pin_topics", ()),
                        record.get("reply_topics", ()), _redial=True)
+
+    def unregister_reply_topic(self, topic: str) -> None:
+        """Remove `topic` from every negotiation record's accumulated
+        reply list (and its attach marks): a per-instance reply topic
+        (e.g. a disagg client's uuid-suffixed one) whose owner is gone
+        must not be re-pinned forever on every redial — the
+        accumulation fix would otherwise leak one dead topic per
+        client incarnation (review finding).  Serving-side pins of the
+        dead topic die with the channel."""
+        with self._lock:
+            for record in self._negotiations.values():
+                topics = record.get("reply_topics")
+                if topics and topic in topics:
+                    record["reply_topics"] = [t for t in topics
+                                              if t != topic]
+            for key in [k for k in self._attached if k[1] == topic]:
+                del self._attached[key]
 
     def release(self, topic: str, close_channel: bool = True) -> None:
         """Drop the pin for `topic` (service left, pipeline stopped).

@@ -53,7 +53,9 @@ __all__ = [
     "encode_envelope", "decode_envelope", "encode_rpc", "supports_binary",
     "WIRE_CODECS", "WIRE_CODEC_DTYPES", "WIRE_CODEC_RANK", "codec_legal",
     "pop_trace", "TENANT_MARKER", "tenant_fields", "is_tenant_fields",
-    "parse_tenant", "pop_tenant",
+    "parse_tenant", "pop_tenant", "KV_TRANSFER_COMMAND",
+    "KV_TRANSFER_SCHEMA", "KV_TRANSFER_DTYPES", "KV_TRANSFER_RANK",
+    "kv_leaf_legal", "encode_kv_transfer", "decode_kv_transfer",
 ]
 
 MAGIC = b"AIKW"
@@ -444,6 +446,204 @@ def decode_envelope(payload, with_trace: bool = False,
     if with_trace:
         return expr[0], params, trace
     return expr[0], params
+
+
+# -- KV-transfer envelope kind (ISSUE 14) ------------------------------------
+# The disaggregated prefill/decode split ships computed prompt KV from a
+# prefill runtime to a decode runtime over the peer data plane.  The
+# payload is an ordinary binary envelope whose command is
+# KV_TRANSFER_COMMAND — it rides peer channels, the broker fallback,
+# chaos seams, and tracing exactly like every other data-plane envelope
+# — but its tensor fields are DECLARED here, like the codec legality
+# tables above, so graft-check proves the transfer schema sound without
+# importing serving and the decoder rejects a malformed transfer loudly
+# instead of scattering garbage rows into a live KV cache.
+#
+# Wire layout (envelope params):
+#   [transfer_id, tenant, start_block, block_tokens, first_token,
+#    [layout fields...], {"tokens": i32[*]},
+#    [ per-block [ per-layer {"k": leaf, "v": leaf} ] ]]
+# where a leaf is either a native rows array ([H, B, D], the decoder's
+# compute dtype) or the int8 serving form
+# {"q": i8[H, B, D], "s": f32[H, B]} (layers.quantize_kv_cache) —
+# carried bit-exact: the decode side installs the very bytes the donor
+# decoder would have read, so greedy parity is preserved by
+# construction and an int8 chain never double-rounds.
+
+KV_TRANSFER_COMMAND = "kv_transfer"
+# contract-grammar declaration (analysis/contracts.py syntax) — the
+# graft-check wire-schema check parses these and verifies they agree
+# with the runtime tables below, so the declaration cannot drift from
+# what encode/decode actually enforce
+KV_TRANSFER_SCHEMA = {
+    "tokens": "i32[*]",
+    "kv": "bf16[*,*,*] | f32[*,*,*] | f16[*,*,*]",
+    "kv_q": "i8[*,*,*]",
+    "kv_s": "f32[*,*]",
+}
+# runtime legality tables (the enforcement twin of the schema above)
+KV_TRANSFER_DTYPES = {
+    "tokens": ("int32",),
+    "kv": ("bfloat16", "float32", "float16"),
+    "kv_q": ("int8",),
+    "kv_s": ("float32",),
+}
+KV_TRANSFER_RANK = {"tokens": 1, "kv": 3, "kv_q": 3, "kv_s": 2}
+
+
+def kv_leaf_legal(field: str, dtype, ndim: int) -> bool:
+    """True when `field` may legally carry an array of `dtype`/rank
+    (the KV-transfer analogue of codec_legal)."""
+    allowed = KV_TRANSFER_DTYPES.get(field)
+    return allowed is not None and str(dtype) in allowed and \
+        ndim == KV_TRANSFER_RANK[field]
+
+
+def _check_kv_leaf(leaf, what: str):
+    """Validate one K or V rows leaf against the declared schema;
+    returns it unchanged.  Shared by encode (fail before bytes move)
+    and decode (fail before rows could reach a cache).  Non-array
+    values (a corrupt or version-drifted payload whose leaf decoded
+    as a string) are a WireError too — the caller's recovery ladder
+    catches WireError, not AttributeError."""
+    if isinstance(leaf, dict):
+        if set(leaf) != {"q", "s"}:
+            raise WireError(
+                f"kv_transfer {what}: int8 leaf must be {{'q','s'}}, "
+                f"got keys {sorted(leaf)}")
+        q, s = leaf["q"], leaf["s"]
+        if not _is_nd_value(q) or not _is_nd_value(s):
+            raise WireError(
+                f"kv_transfer {what}: q/s must be arrays, got "
+                f"{type(q).__name__}/{type(s).__name__}")
+        if not kv_leaf_legal("kv_q", q.dtype, q.ndim):
+            raise WireError(
+                f"kv_transfer {what}: q must be "
+                f"{KV_TRANSFER_SCHEMA['kv_q']}, got {q.dtype} "
+                f"rank {q.ndim}")
+        if not kv_leaf_legal("kv_s", s.dtype, s.ndim):
+            raise WireError(
+                f"kv_transfer {what}: s must be "
+                f"{KV_TRANSFER_SCHEMA['kv_s']}, got {s.dtype} "
+                f"rank {s.ndim}")
+        if q.shape[:2] != s.shape:
+            raise WireError(
+                f"kv_transfer {what}: scale shape {s.shape} does not "
+                f"match values {q.shape}")
+        return leaf
+    if not _is_nd_value(leaf) or \
+            not kv_leaf_legal("kv", leaf.dtype, leaf.ndim):
+        raise WireError(
+            f"kv_transfer {what}: rows must be "
+            f"{KV_TRANSFER_SCHEMA['kv']}, got "
+            f"{getattr(leaf, 'dtype', type(leaf).__name__)} "
+            f"rank {getattr(leaf, 'ndim', '?')}")
+    return leaf
+
+
+def _is_nd_value(value) -> bool:
+    return hasattr(value, "dtype") and hasattr(value, "ndim") and \
+        hasattr(value, "shape")
+
+
+def encode_kv_transfer(transfer_id: str, tenant: str, tokens,
+                       start_block: int, block_tokens: int,
+                       layout, blocks, first_token: int | None = None,
+                       trace=None) -> bytes:
+    """One KV-transfer envelope: `blocks` is [per block [per layer
+    {"k": leaf, "v": leaf}]] covering chain blocks
+    [start_block, start_block + len(blocks)) of `tokens`; blocks below
+    start_block are HANDLES — the decode side already holds them (its
+    chain keys are content-addressed from the tokens), so only their
+    indices cross, never their bytes (ROADMAP item 3 residue b).
+    `layout` is the donor decoder's storage-layout tuple
+    (PrefixKVCache.layout) — the receiver refuses a geometry mismatch
+    before any row lands."""
+    block_tokens = int(block_tokens)
+    payload_blocks = []
+    for b, per_layer in enumerate(blocks):
+        layers = []
+        for i, entry in enumerate(per_layer):
+            what = f"block {b} layer {i}"
+            layers.append({
+                "k": _check_kv_leaf(entry["k"], what + " k"),
+                "v": _check_kv_leaf(entry["v"], what + " v")})
+        payload_blocks.append(layers)
+    tokens = np.asarray(tokens, np.int32)
+    if tokens.ndim != 1:
+        raise WireError(
+            f"kv_transfer tokens must be rank 1, got {tokens.ndim}")
+    return encode_envelope(
+        KV_TRANSFER_COMMAND,
+        [str(transfer_id), str(tenant), str(int(start_block)),
+         str(block_tokens),
+         "" if first_token is None else str(int(first_token)),
+         [str(f) for f in layout], {"tokens": tokens}, payload_blocks],
+        trace=trace)
+
+
+def decode_kv_transfer(payload):
+    """Decode + validate one KV-transfer envelope.  Returns a dict
+    {transfer_id, tenant, start_block, block_tokens, first_token,
+    layout, tokens, blocks} with every leaf schema-checked (dtype,
+    rank, scale/value agreement, uniform block length) — a truncated or
+    foreign payload raises WireError instead of reaching a cache."""
+    command, params = decode_envelope(payload)
+    if command != KV_TRANSFER_COMMAND:
+        raise WireError(f"not a kv_transfer envelope: {command!r}")
+    if len(params) < 8:
+        raise WireError(f"kv_transfer envelope short: {len(params)} "
+                        f"params")
+    (transfer_id, tenant, start_block, block_tokens, first_token,
+     layout, token_box, blocks) = params[:8]
+    try:
+        start_block = int(str(start_block))
+        block_tokens = int(str(block_tokens))
+        first_token = None if str(first_token) == "" \
+            else int(str(first_token))
+    except (TypeError, ValueError) as exc:
+        raise WireError(f"kv_transfer header fields malformed: "
+                        f"{exc}") from exc
+    if block_tokens < 1 or start_block < 0:
+        raise WireError(
+            f"kv_transfer header out of range: start_block "
+            f"{start_block}, block_tokens {block_tokens}")
+    tokens = (token_box or {}).get("tokens") \
+        if isinstance(token_box, dict) else None
+    if tokens is None or not _is_nd_value(tokens) or \
+            not kv_leaf_legal("tokens", tokens.dtype, tokens.ndim):
+        raise WireError("kv_transfer tokens missing or not i32[*]")
+    if not isinstance(blocks, list):
+        raise WireError("kv_transfer blocks must be a list")
+    checked = []
+    for b, per_layer in enumerate(blocks):
+        if not isinstance(per_layer, list) or not per_layer:
+            raise WireError(f"kv_transfer block {b} empty")
+        layers = []
+        for i, entry in enumerate(per_layer):
+            if not isinstance(entry, dict) or \
+                    set(entry) != {"k", "v"}:
+                raise WireError(
+                    f"kv_transfer block {b} layer {i}: want "
+                    f"{{'k','v'}}")
+            what = f"block {b} layer {i}"
+            k = _check_kv_leaf(entry["k"], what + " k")
+            v = _check_kv_leaf(entry["v"], what + " v")
+            for name, leaf in (("k", k), ("v", v)):
+                rows = leaf["q"] if isinstance(leaf, dict) else leaf
+                if rows.shape[1] != block_tokens:
+                    raise WireError(
+                        f"kv_transfer {what} {name}: {rows.shape[1]} "
+                        f"rows, want block_tokens={block_tokens}")
+            layers.append({"k": k, "v": v})
+        checked.append(layers)
+    return {
+        "transfer_id": str(transfer_id), "tenant": str(tenant),
+        "start_block": start_block, "block_tokens": block_tokens,
+        "first_token": first_token,
+        "layout": tuple(str(f) for f in (layout or [])),
+        "tokens": tokens, "blocks": checked,
+    }
 
 
 def encode_rpc(command: str, parameters=(), transport=None,

@@ -1622,6 +1622,100 @@ def bench_llama_conversation(window: float = 10.0):
     return fields
 
 
+# disaggregated prefill/decode serving rung (ISSUE 14): "off" skips,
+# anything else runs the two-pool plane plus a colocated A/B under the
+# identical workload.
+LLAMA_DISAGG = os.environ.get("AIKO_BENCH_LLAMA_DISAGG", "1")
+
+
+def bench_llama_disagg(window: float = 8.0):
+    """Two-pool serving rung (ISSUE 14): a role-tagged prefill runtime
+    computes prompt KV and ships it over the peer data plane to the
+    decode decoder (serving_disagg.DisaggHarness), while closed-loop
+    decode streams measure inter-token latency with and without a
+    concurrent cold-prefill burst.  The colocated A/B runs the SAME
+    seeded workload on one decoder — the burst's chunk extends ride
+    its decode rounds, which is exactly the ITL dilation the split
+    removes.  Greedy parity is asserted inside the rung: a probe
+    prompt's tokens must be BIT-IDENTICAL disaggregated vs colocated
+    (the KV-transfer carries the donor decoder's exact bytes)."""
+    import dataclasses as _dc
+
+    from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+    from aiko_services_tpu.serving_disagg import DisaggHarness
+
+    if LLAMA_DISAGG.lower() in ("off", "0", "false", ""):
+        return {}
+    base = LLAMA_PRESETS[LLAMA_PRESET]
+    config = _dc.replace(base, dtype=jnp.bfloat16, max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    block, slots, prefill_slots = 32, 16, 4
+    # transfer timeout generous: a CPU-smoke jit compile inside a
+    # transfer's wall must not trip the fallback ladder mid-rung (the
+    # ladder has its own chaos tests; the rung wants 0 fallbacks)
+    kwargs = dict(block_tokens=block, max_slots=slots,
+                  prefill_slots=prefill_slots, steps_per_sync=4,
+                  prefill_buckets=(64,), prefill_chunk=64,
+                  transfer_timeout=60.0,
+                  decoder_opts=_llama_decoder_opts())
+    probe = np.random.default_rng(7).integers(
+        1, config.vocab, size=200).tolist()
+
+    def probe_tokens(harness):
+        done = {}
+        harness.submit("probe", probe, 16,
+                       lambda rid, t: done.update({rid: t}))
+        harness.run_until(lambda: "probe" in done, timeout=300.0)
+        return done.get("probe")
+
+    coloc = DisaggHarness(params, config, disagg=False, **kwargs)
+    coloc_probe = probe_tokens(coloc)
+    coloc_out = coloc.measure(window=window, burst_every=0.4)
+    coloc.stop()
+
+    disagg = DisaggHarness(params, config, disagg=True, **kwargs)
+    if not disagg.wait_discovered(30.0):
+        disagg.stop()
+        return {"lat_llama_disagg_error": "prefill pool never "
+                                          "discovered"}
+    disagg_probe = probe_tokens(disagg)
+    disagg_out = disagg.measure(window=window, burst_every=0.4)
+    transfers = dict(disagg.prefill.stats)
+    disagg.stop()
+
+    parity = disagg_probe == coloc_probe and disagg_probe is not None
+    fields = {
+        "lat_llama_disagg_config":
+            f"{LLAMA_PRESET} bf16, decode {slots} slots / prefill "
+            f"{prefill_slots} slots, block {block}, chunk 64, "
+            f"peer-shipped int8-layout KV, colocated A/B same seed",
+        "lat_llama_disagg_parity": bool(parity),
+        "lat_llama_disagg_transfers": disagg_out.get("transfers", 0),
+        "lat_llama_disagg_transfer_bytes":
+            disagg_out.get("transfer_bytes", 0),
+        "lat_llama_disagg_handle_hit_rate":
+            disagg_out.get("handle_hit_rate", 0.0),
+        "lat_llama_disagg_local_fallbacks":
+            disagg_out.get("local_fallbacks", 0),
+        "lat_llama_disagg_lost": disagg_out["lost"],
+        "lat_llama_coloc_lost": coloc_out["lost"],
+        "lat_llama_disagg_prefill_blocks_shipped":
+            transfers.get("blocks_shipped", 0),
+    }
+    for key, label in (("transfer_p50_ms", "transfer_p50_ms"),
+                       ("transfer_p95_ms", "transfer_p95_ms")):
+        if disagg_out.get(key) is not None:
+            fields[f"lat_llama_disagg_{label}"] = disagg_out[key]
+    for mode, out in (("disagg", disagg_out), ("coloc", coloc_out)):
+        for key in ("itl_p50_baseline_ms", "itl_p95_baseline_ms",
+                    "itl_p50_burst_ms", "itl_p95_burst_ms",
+                    "stall_p95_baseline_ms", "stall_p95_burst_ms"):
+            value = out.get(key)
+            if value is not None:
+                fields[f"lat_llama_{mode}_{key}"] = round(value, 3)
+    return fields
+
+
 # -- low-latency operating point ---------------------------------------------
 # The <150 ms p50 budget is ARCHITECTURALLY unreachable at 5 s chunks
 # (a full chunk must exist before it can be posted).  This section runs
@@ -2141,6 +2235,13 @@ def main() -> None:
     except Exception as exc:
         print(f"llama conversation bench failed: {exc!r}",
               file=sys.stderr)
+    try:
+        llama |= bench_llama_disagg()
+        print(f"llama disaggregated two-pool: "
+              f"{ {k: v for k, v in llama.items() if 'disagg' in k or '_coloc_' in k} }",
+              file=sys.stderr)
+    except Exception as exc:
+        print(f"llama disagg bench failed: {exc!r}", file=sys.stderr)
     import gc
     gc.collect()
     jax.clear_caches()

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+# CPU smoke for the disaggregated prefill/decode serving plane
+# (ISSUE 14): the SAME two-pool harness as the lat_llama_disagg_* bench
+# rung (serving_disagg.DisaggHarness), run as a colocated-vs-
+# disaggregated A/B under one seeded workload — the peer_smoke.py
+# pattern applied one layer up the stack:
+#
+#   colocated : one ContinuousDecoder takes decode streams AND cold
+#               prompt bursts; the bursts' chunk extends ride its
+#               decode rounds (the ITL dilation BENCH_r05 measured);
+#   disagg    : a role-tagged PrefillRuntime computes the bursts'
+#               prompt KV and ships it over the peer data plane as
+#               KV-transfer envelopes; the decode decoder installs the
+#               chain and prefills only the ragged suffix.
+#
+# The JSON report carries, per mode, the decode streams' ITL p50/p95
+# with and without the concurrent burst, plus the disagg side's
+# per-transfer cost (ms and bytes), handle-hit rate (chain blocks that
+# crossed as indices because the decode side already held them), and
+# fallback counters.  A greedy-parity probe runs first: the
+# disaggregated tokens must be BIT-IDENTICAL to colocated.
+#
+# Acceptance (exit 0): parity holds, both modes lose ZERO requests,
+# every transfer either lands or is counted into the local-prefill
+# fallback ladder, and at least one transfer actually moved KV.
+# Latency comparisons are REPORTED, not gated — containerized CPU
+# hosts are too noisy to gate on integer-factor wall-clock ratios
+# (peer_smoke.py's lesson).
+#
+# Usage:  python scripts/disagg_smoke.py [--window 6] [--preset tiny]
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="A/B the two-pool serving split: colocated vs "
+                    "disaggregated prefill under one seeded workload")
+    parser.add_argument("--preset", default="tiny",
+                        help="llama preset (default tiny: CPU smoke)")
+    parser.add_argument("--window", type=float, default=6.0,
+                        help="measured seconds per mode (split "
+                             "baseline/burst halves)")
+    parser.add_argument("--block", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--kv", default="",
+                        help="kv_cache_dtype ('int8' ships the "
+                             "quantized layout; default native)")
+    args = parser.parse_args(argv)
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+    from aiko_services_tpu.serving_disagg import DisaggHarness
+
+    config = dataclasses.replace(LLAMA_PRESETS[args.preset],
+                                 max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    opts = {"kv_cache_dtype": args.kv} if args.kv else {}
+    kwargs = dict(block_tokens=args.block, max_slots=16,
+                  prefill_slots=4, steps_per_sync=4,
+                  prefill_buckets=(64,), prefill_chunk=64,
+                  transfer_timeout=60.0, decoder_opts=opts)
+    probe = np.random.default_rng(7).integers(
+        1, config.vocab, size=200).tolist()
+
+    def run_mode(disagg: bool) -> dict:
+        harness = DisaggHarness(params, config, disagg=disagg,
+                                **kwargs)
+        if disagg and not harness.wait_discovered(30.0):
+            harness.stop()
+            raise RuntimeError("prefill pool never discovered")
+        done = {}
+        harness.submit("probe", probe, 16,
+                       lambda rid, t: done.update({rid: t}))
+        harness.run_until(lambda: "probe" in done, timeout=300.0)
+        out = harness.measure(window=args.window, seed=args.seed,
+                              burst_every=0.4)
+        out["probe_tokens"] = done.get("probe")
+        if disagg:
+            out["prefill_runtime"] = dict(harness.prefill.stats)
+        harness.stop()
+        return out
+
+    coloc = run_mode(False)
+    disagg = run_mode(True)
+    parity = coloc["probe_tokens"] == disagg["probe_tokens"] and \
+        coloc["probe_tokens"] is not None
+    transfers = disagg.get("transfers", 0)
+    report = {
+        "preset": args.preset,
+        "parity_bit_identical": parity,
+        "colocated": {k: v for k, v in coloc.items()
+                      if k != "probe_tokens"},
+        "disaggregated": {k: v for k, v in disagg.items()
+                          if k != "probe_tokens"},
+        "per_transfer": {
+            "count": transfers,
+            "bytes_total": disagg.get("transfer_bytes", 0),
+            "bytes_mean": round(
+                disagg.get("transfer_bytes", 0) / transfers, 1)
+            if transfers else None,
+            "p50_ms": disagg.get("transfer_p50_ms"),
+            "p95_ms": disagg.get("transfer_p95_ms"),
+            "handle_hit_rate": disagg.get("handle_hit_rate", 0.0),
+        },
+        "itl_under_burst": {
+            "coloc_p95_ms": coloc.get("itl_p95_burst_ms"),
+            "coloc_baseline_p95_ms": coloc.get("itl_p95_baseline_ms"),
+            "disagg_p95_ms": disagg.get("itl_p95_burst_ms"),
+            "disagg_baseline_p95_ms":
+                disagg.get("itl_p95_baseline_ms"),
+        },
+    }
+    print(json.dumps(report, indent=2))
+    ok = (parity
+          and coloc["lost"] == 0 and disagg["lost"] == 0
+          and coloc["drained"] and disagg["drained"]
+          and transfers > 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

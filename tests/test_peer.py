@@ -437,3 +437,98 @@ def test_peer_host_closes_with_runtime(engine, system_factory):
     # the serving side saw the close and unpinned the reply topic
     assert not system.serve_rt.peer.pinned(
         f"{system.caller.topic_path}/in")
+
+
+def test_second_pipeline_attaches_its_own_reply_pin(engine,
+                                                    system_factory):
+    """The PR 6 named seam (ISSUE 14 satellite): a SECOND pipeline in
+    the same runtime negotiating an already-pinned peer service gets
+    its reply topic pinned on the serving side via peer_attach — its
+    replies ride the channel instead of silently falling back to the
+    broker forever."""
+    system = system_factory()
+    assert system.caller.remote_elements_ready()
+    assert system.call_rt.peer.pinned(system.serving_in())
+
+    second = Pipeline(
+        system.call_rt, calling_definition(),
+        name="call2",
+        element_classes={"PE_Src": PE_Src},
+        services_cache=ServicesCache(system.call_rt),
+        stream_lease_time=0, remote_timeout=5.0)
+    settle(engine, 120)
+    try:
+        assert second.remote_elements_ready()
+        # the attach pinned the SECOND pipeline's reply topic to the
+        # EXISTING channel — no new channel, no broker-only replies
+        assert system.serve_rt.peer.pinned(f"{second.topic_path}/in")
+        assert system.call_rt.peer.stats["attach_requests"] == 1
+        assert system.call_rt.peer.stats["attach_acks"] == 1
+        assert system.serve_rt.peer.stats["attach_pins"] == 1
+        assert len(system.call_rt.peer._channels) == 1
+
+        done = []
+        second.add_frame_handler(done.append)
+        second.create_stream("s2", lease_time=0)
+        routed_before = system.broker.stats["routed"]
+        for _ in range(3):
+            second.post("process_frame", "s2", {})
+            settle(engine, 60)
+        assert len(done) == 3
+        assert np.allclose(done[0].swag["out"],
+                           np.arange(8, dtype=np.float32) * 2.0)
+        # steady state: both pipelines' data planes ride the channel
+        assert system.broker.stats["routed"] == routed_before
+    finally:
+        second.stop()
+
+
+def test_attach_to_dead_channel_is_refused_and_retried(
+        engine, system_factory):
+    """An attach racing a channel death is refused (no-channel); the
+    pending marks clear so a later negotiation retries cleanly."""
+    system = system_factory()
+    assert system.caller.remote_elements_ready()
+    host = system.call_rt.peer
+    channel = host._pins[system.serving_in()]
+    # sever serving-side bookkeeping for the channel id, then attach
+    # (marking pending exactly as negotiate() does)
+    system.serve_rt.peer._channels.pop(channel.channel_id)
+    key = (channel.channel_id, f"{system.caller.topic_path}/ghost")
+    host._attached[key] = "pending"
+    host._send_attach(system.serving.topic_path, channel,
+                      [f"{system.caller.topic_path}/ghost"])
+    settle(engine, 60)
+    assert host.stats["attach_acks"] == 0
+    assert key not in host._attached          # pending mark cleared
+    assert host._attach_pending == {}
+
+
+def test_redial_repins_every_negotiators_reply_topics(
+        engine, system_factory):
+    """A channel death + redial must re-pin BOTH pipelines' reply
+    topics: the negotiation record accumulates reply topics across
+    negotiators instead of keeping only the latest caller's list."""
+    system = system_factory()
+    assert system.caller.remote_elements_ready()
+    second = Pipeline(
+        system.call_rt, calling_definition(), name="call2b",
+        element_classes={"PE_Src": PE_Src},
+        services_cache=ServicesCache(system.call_rt),
+        stream_lease_time=0, remote_timeout=5.0)
+    settle(engine, 120)
+    try:
+        assert system.serve_rt.peer.pinned(f"{second.topic_path}/in")
+        # kill the channel; the initiating side redials after backoff
+        system.call_rt.peer.kill_channels()
+        settle(engine, 30)
+        settle_virtual(engine, 5.0)
+        assert system.call_rt.peer.pinned(system.serving_in())
+        # the redialed channel pins BOTH reply topics serving-side
+        assert system.serve_rt.peer.pinned(
+            f"{system.caller.topic_path}/in")
+        assert system.serve_rt.peer.pinned(
+            f"{second.topic_path}/in"), \
+            "the earlier attach's reply pin must survive the redial"
+    finally:
+        second.stop()
