@@ -93,6 +93,18 @@
 #                         whose bound lives elsewhere (a drain method,
 #                         a lease) carry per-line waivers so the audit
 #                         trail stays in the diff
+#   lint-paged-free       block-pool alloc/free imbalance in event or
+#                         `graft: hot-path` contexts: a call to
+#                         .alloc_blocks()/.alloc_block() whose result
+#                         is DISCARDED (a bare expression statement) —
+#                         the returned ids are the ONLY handle to the
+#                         allocated blocks' refcounts, so dropping
+#                         them leaks pool blocks forever (the paged KV
+#                         pool's sibling of the unbounded-queue rule:
+#                         serving's drain audit asserts zero live
+#                         blocks, and a discarded alloc can never be
+#                         released).  Capture the ids and release them
+#                         at retire, or waive the audited site
 #   lint-unbounded-cache  dict/OrderedDict CACHES mutated from
 #                         event-handler or `graft: hot-path` contexts
 #                         with no eviction on the same receiver: a
@@ -133,7 +145,12 @@ LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
               "lint-print", "lint-unbounded-queue",
               "lint-unbounded-cache", "lint-linear-timer",
-              "lint-metric-label", "lint-wall-clock")
+              "lint-metric-label", "lint-wall-clock",
+              "lint-paged-free")
+
+# block-pool allocator call tails (lint-paged-free): the returned ids
+# are the only refcount handle — a discarded result is a leak
+_POOL_ALLOC_TAILS = {"alloc_blocks", "alloc_block"}
 
 # wall-epoch clock reads (lint-wall-clock): canonical spellings; call
 # targets are CANONICALIZED through the module's actual time/datetime
@@ -370,6 +387,25 @@ class _ContextScanner(ast.NodeVisitor):
                 f"__init__/_setup and refill in place (per-round host "
                 f"allocations are the pump loop's death by a thousand "
                 f"cuts)")
+        self.generic_visit(node)
+
+    def visit_Expr(self, node):
+        # lint-paged-free: a bare-statement pool alloc drops the ONLY
+        # handle to the allocated blocks' refcounts — nothing can ever
+        # release them, so the pool leaks one block set per pass
+        if (self.event or self.hot) and \
+                isinstance(node.value, ast.Call) and \
+                _func_tail(node.value.func) in _POOL_ALLOC_TAILS and \
+                isinstance(node.value.func, ast.Attribute):
+            receiver = ast.unparse(node.value.func.value)
+            self.lint.report(
+                "lint-paged-free", node,
+                f"{receiver}.{_func_tail(node.value.func)}() result "
+                f"discarded in context {self.context!r}: the returned "
+                f"block ids are the only refcount handle — capture "
+                f"them and release at retire, or the pool leaks one "
+                f"allocation per pass (waive an audited site with "
+                f"`graft: disable=lint-paged-free`)")
         self.generic_visit(node)
 
     def visit_Assign(self, node):

@@ -314,6 +314,13 @@ class PE_LlamaAgent(PipelineElement):
                     name=self.definition.name)
                 prefill_chunk = int(prefill_chunk) or \
                     int(self.prompt_length)
+            # paged KV (ISSUE 15): parameter `paged` rebuilds the slot
+            # cache as a block pool + per-slot tables — prefix hits
+            # alias instead of copying, and the disagg path can land
+            # shipped KV by direct slot-table install even WITHOUT a
+            # prefix cache bound (see below)
+            paged, _ = self.get_parameter("paged", False)
+            paged = parse_bool(paged, False)
             self.decoder = ContinuousDecoder(
                 self.params, config, max_slots=int(max_batch),
                 prefill_buckets=(int(self.prompt_length),),
@@ -321,7 +328,8 @@ class PE_LlamaAgent(PipelineElement):
                 prefill_chunk=int(prefill_chunk) or None,
                 eos_token=int(eos_token) if int(eos_token) >= 0 else None,
                 name=self.definition.name,
-                prefix_cache=self.prefix_cache)
+                prefix_cache=self.prefix_cache,
+                paged_kv=paged, kv_block=int(prefix_block) or 32)
             # session-resident conversation KV (ISSUE 13 / PR 10
             # residue c): parameter `sessions` persists per-(tenant,
             # session) history in a SessionTable; each turn re-submits
@@ -347,14 +355,16 @@ class PE_LlamaAgent(PipelineElement):
             # routes prompts through a PrefillClient — a role=prefill
             # runtime computes the prompt KV and ships it over the
             # peer plane; this decoder only prefills the ragged
-            # suffix.  Needs the prefix cache (the shipped chain has
-            # to land somewhere) and the pipeline's services cache
-            # for role-tag discovery; falls back to local prefill
-            # whenever the pool is absent — never a dropped request.
+            # suffix.  The shipped chain needs somewhere to land: a
+            # bound prefix cache, or (ISSUE 15) a paged decoder whose
+            # pool takes the blocks by direct slot-table install —
+            # so a cacheless decode pool engages too.  Falls back to
+            # local prefill whenever the pool is absent — never a
+            # dropped request.
             self._prefill_client = None
             disagg, _ = self.get_parameter("disagg", False)
             if parse_bool(disagg, False) and \
-                    self.prefix_cache is not None:
+                    (self.prefix_cache is not None or paged):
                 from ..serving_disagg import PrefillClient
                 transfer_timeout, _ = self.get_parameter(
                     "disagg_timeout", 5.0)

@@ -30,6 +30,8 @@ __all__ = [
     "quantize_kv_cache", "dequantize_kv_cache",
     "slice_kv_rows", "split_kv_blocks", "concat_kv_rows",
     "kv_rows_nbytes",
+    "gather_paged_kv", "scatter_paged_rows", "write_paged_blocks",
+    "slice_paged_block",
     "linear_logits",
     "sinusoid_position_encoding", "gelu", "rope_frequencies", "apply_rope",
 ]
@@ -414,6 +416,92 @@ def kv_rows_nbytes(rows) -> int:
     dict) — the prefix cache's budget currency."""
     return int(sum(leaf.size * jnp.dtype(leaf.dtype).itemsize
                    for leaf in jax.tree_util.tree_leaves(rows)))
+
+
+# -- paged KV block pool primitives (ISSUE 15) -------------------------------
+# The paged serving cache (serving_paged.BlockPool) stores KV in one
+# [N, H, B, D] pool of fixed B-token blocks per layer (int8 pools carry
+# the {"q" i8 [N, H, B, D], "s" f32 [N, H, B]} serving form), addressed
+# by per-slot int32 block tables.  These four primitives are the whole
+# device-side vocabulary of the paged path: a gather that materializes
+# a slot-major [S, H, T, D] view for the attention einsums (the one
+# place paged and dense numerics must agree BIT-for-bit — the gathered
+# view is value-identical to the dense slot cache, so every attention
+# body downstream is shared, not forked), a per-position scatter for
+# the decode round's side-buffer merge, a whole-block scatter for the
+# admit prefill, and a block slice read for harvest-free wire shipping.
+# Out-of-range destination ids drop (mode="drop") — the paged analogue
+# of the dense path's _POS_INVALID discipline.
+
+def gather_paged_kv(pool, tables):
+    """Assemble a slot-major KV view from a block pool: `tables` is
+    [S, nb] int32 block ids; returns [S, H, nb*B, D] (or the int8 dict
+    with s [S, H, nb*B]).  Position p of slot s reads
+    pool[tables[s, p // B], :, p % B] — the block-table indirection of
+    vLLM's PagedAttention, expressed as an XLA gather.  The gather
+    materializes once per compiled program (hoisted out of the decode
+    scan: the main cache is read-only through a round), so the scan's
+    per-step HBM traffic is identical to the dense cache's."""
+    if isinstance(pool, dict):
+        return {"q": gather_paged_kv(pool["q"], tables),
+                "s": gather_paged_kv(pool["s"], tables)}
+    g = jnp.take(pool, tables, axis=0)     # [S, nb, H, B, ...]
+    if g.ndim == 5:                        # values [S, nb, H, B, D]
+        s, nb, h, b, d = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(s, h, nb * b, d)
+    s, nb, h, b = g.shape                  # scales [S, nb, H, B]
+    return g.transpose(0, 2, 1, 3).reshape(s, h, nb * b)
+
+
+def scatter_paged_rows(pool, dest_blocks, offsets, rows):
+    """Scatter per-position rows into pool blocks: rows is
+    [S, H, W, D] (or the scale form [S, H, W]); dest_blocks/offsets are
+    [S, W] — row (s, w) lands at pool[dest_blocks[s, w], :,
+    offsets[s, w]].  Out-of-range dest ids DROP (inactive slots,
+    rejected speculative drafts, positions past the table) instead of
+    clamping into a live block."""
+    if isinstance(pool, dict):
+        return {"q": scatter_paged_rows(pool["q"], dest_blocks,
+                                        offsets, rows["q"]),
+                "s": scatter_paged_rows(pool["s"], dest_blocks,
+                                        offsets, rows["s"])}
+    if rows.ndim == 4:                     # values [S, H, W, D]
+        vals = rows.transpose(0, 2, 1, 3)  # [S, W, H, D]
+    else:                                  # scales [S, H, W]
+        vals = rows.transpose(0, 2, 1)     # [S, W, H]
+    return pool.at[dest_blocks, :, offsets].set(vals, mode="drop")
+
+
+def write_paged_blocks(pool, block_ids, rows):
+    """Whole-block scatter for the admit prefill: rows is
+    [A, H, nb*B, D] (or scales [A, H, nb*B]) covering nb =
+    block_ids.shape[1] complete blocks per admit row; each block lands
+    at pool[block_ids[a, j]].  Invalid rows carry out-of-range ids and
+    drop."""
+    if isinstance(pool, dict):
+        return {"q": write_paged_blocks(pool["q"], block_ids,
+                                        rows["q"]),
+                "s": write_paged_blocks(pool["s"], block_ids,
+                                        rows["s"])}
+    nb = block_ids.shape[1]
+    if rows.ndim == 4:
+        a, h, t, d = rows.shape
+        vals = rows.reshape(a, h, nb, t // nb, d).transpose(0, 2, 1, 3,
+                                                            4)
+    else:
+        a, h, t = rows.shape
+        vals = rows.reshape(a, h, nb, t // nb).transpose(0, 2, 1, 3)
+    return pool.at[block_ids].set(vals, mode="drop")
+
+
+def slice_paged_block(pool, block_id: int):
+    """One block's rows [H, B, D] (or the int8 dict) from the pool —
+    the read behind shipping a pool-resident cache block over the
+    disaggregated wire.  A device-side slice view; np.asarray at the
+    call site makes the host copy."""
+    if isinstance(pool, dict):
+        return {"q": pool["q"][block_id], "s": pool["s"][block_id]}
+    return pool[block_id]
 
 
 def mha(params, x, kv_input=None, mask=None, cache=None,

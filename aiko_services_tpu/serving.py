@@ -61,7 +61,8 @@ from .models.llama import LlamaConfig, llama_ffn
 from .utils import get_logger
 
 __all__ = ["ContinuousDecoder", "DecodeRequest", "PrefixKVCache",
-           "prefix_chain_keys", "measure_device_step"]
+           "prefix_chain_keys", "check_block_geometry",
+           "measure_device_step"]
 
 
 def measure_device_step(decoder, steps_per_sync: int = 64,
@@ -77,20 +78,46 @@ def measure_device_step(decoder, steps_per_sync: int = 64,
     1 + speculate_k tokens."""
     config = decoder.config
     slots = decoder.max_slots
-    k_probe = decoder._zero_caches()
-    v_probe = decoder._zero_caches()
     tokens = jnp.ones((slots,), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
     active = jnp.ones((slots,), bool)
     budgets = jnp.full((slots,), 1 << 30, jnp.int32)
     context = jnp.zeros((slots, decoder.max_seq), jnp.int32) \
         if decoder.speculate_k else None
+    if decoder.paged:
+        # paged probe: fresh zero pools at the pool's CURRENT capacity
+        # (shape-identical to the serving pool, so the compiled
+        # executable is the one serving runs) and round-robin distinct
+        # tables at the serving gather width
+        nb = -(-decoder._cache_t // decoder.kv_block)
+        k_probe = decoder.pool._zero_pools(decoder.pool.num_blocks)
+        v_probe = decoder.pool._zero_pools(decoder.pool.num_blocks)
+        ids = 1 + (np.arange(slots * nb) %
+                   max(1, decoder.pool.num_blocks - 1))
+        tables = jnp.asarray(ids.reshape(slots, nb).astype(np.int32))
+    else:
+        k_probe = decoder._zero_caches()
+        v_probe = decoder._zero_caches()
 
     def chain(rounds):
         nonlocal k_probe, v_probe, tokens, lengths, context
         out = None
         for _ in range(rounds):
-            if decoder.speculate_k:
+            if decoder.paged and decoder.speculate_k:
+                out = decoder._step(decoder.params, tokens, lengths,
+                                    active, budgets, context, k_probe,
+                                    v_probe, tables,
+                                    num_steps=steps_per_sync, eos=-1,
+                                    t_cap=decoder._cache_t)
+                (_, _, tokens, lengths, context, k_probe,
+                 v_probe) = out
+            elif decoder.paged:
+                out = decoder._step(decoder.params, tokens, lengths,
+                                    active, budgets, k_probe, v_probe,
+                                    tables, num_steps=steps_per_sync,
+                                    eos=-1, t_cap=decoder._cache_t)
+                _, _, tokens, lengths, k_probe, v_probe = out
+            elif decoder.speculate_k:
                 out = decoder._step(decoder.params, tokens, lengths,
                                     active, budgets, context, k_probe,
                                     v_probe, num_steps=steps_per_sync,
@@ -247,6 +274,11 @@ class DecodeRequest:
     dedup_wait: str = ""
     dedup_hot: bool = False
     inflight_key: str = ""
+    # direct slot-table install (ISSUE 15 satellite): pool block ids a
+    # disaggregated client pre-installed for this request on a paged
+    # CACHELESS decoder — admit aliases them into the slot's table
+    # (ownership transfers to the slot) and prefills only the suffix
+    kv_block_ids: list = dataclasses.field(default_factory=list)
 
 
 def prefix_chain_keys(tenant: str, tokens, block_tokens: int) -> list:
@@ -271,16 +303,67 @@ def prefix_chain_keys(tenant: str, tokens, block_tokens: int) -> list:
     return keys
 
 
+def check_block_geometry(layout, block_tokens: int, entry) -> None:
+    """Refuse a shipped block whose ARRAYS do not match a bound
+    storage layout — the wire schema proves dtype/rank, but a
+    schema-legal payload with the wrong layer count or head/head-dim
+    extents would poison the slot cache and wedge the pump at the next
+    hit (PR 14 review finding).  Shared by the prefix cache's
+    install_chain and the paged direct slot-table install (ISSUE 15).
+    Raises ValueError; the disaggregated client rides its
+    corrupt-transfer rung."""
+    layers, heads, head_dim = (int(layout[0]), int(layout[1]),
+                               int(layout[2]))
+    int8 = str(layout[4]) not in ("False", "0", "")
+    for side in ("k", "v"):
+        rows = entry[side]
+        if len(rows) != layers:
+            raise ValueError(
+                f"block ships {len(rows)} layers, cache layout "
+                f"has {layers}")
+        want = (heads, int(block_tokens), head_dim)
+        for leaf in rows:
+            if isinstance(leaf, dict) != int8:
+                raise ValueError(
+                    f"block {side} storage form does not match "
+                    f"the cache's int8={int8} layout")
+            values = leaf["q"] if isinstance(leaf, dict) else leaf
+            if tuple(values.shape) != want:
+                raise ValueError(
+                    f"block {side} rows shape "
+                    f"{tuple(values.shape)} != layout {want}")
+            if isinstance(leaf, dict) and \
+                    tuple(leaf["s"].shape) != want[:2]:
+                raise ValueError(
+                    f"block {side} scale shape "
+                    f"{tuple(leaf['s'].shape)} != {want[:2]}")
+
+
+def _stack_block_leaves(leaves):
+    """Stack per-block host leaves into one [M, H, B, D] layer stack
+    (int8 dicts leaf-wise) — the one-transfer-per-layer form the pool's
+    write_blocks scatter consumes."""
+    if isinstance(leaves[0], dict):
+        return {"q": np.stack([leaf["q"] for leaf in leaves]),
+                "s": np.stack([leaf["s"] for leaf in leaves])}
+    return np.stack(leaves)
+
+
 class _PrefixBlock:
     """One cached block: per-layer K/V rows in the DECODER's storage
     layout ([H, B, D] arrays, or {"q", "s"} int8 dicts — a hit on an
     int8 cache is a bytes win too), plus the tree bookkeeping eviction
-    needs (parent/children for leaf-first order, refs for pinning)."""
+    needs (parent/children for leaf-first order, refs for pinning).
+    In PAGED mode (ISSUE 15) the rows live in the decoder's block pool
+    instead: `pool_id` names the pool block (the cache holds one pool
+    ref on it) and k_rows/v_rows are None — a hit aliases the pool
+    block into the slot's table, no rows move at all."""
 
     __slots__ = ("key", "parent", "tenant", "k_rows", "v_rows",
-                 "refs", "children", "nbytes")
+                 "refs", "children", "nbytes", "pool_id")
 
-    def __init__(self, key, parent, tenant, k_rows, v_rows, nbytes):
+    def __init__(self, key, parent, tenant, k_rows, v_rows, nbytes,
+                 pool_id=None):
         self.key = key
         self.parent = parent
         self.tenant = tenant
@@ -289,6 +372,7 @@ class _PrefixBlock:
         self.refs = 0
         self.children: set = set()
         self.nbytes = int(nbytes)
+        self.pool_id = pool_id
 
 
 class PrefixKVCache:
@@ -343,6 +427,13 @@ class PrefixKVCache:
         self._sessions: dict = {}       # (tenant, sid) -> [keys]
         self.bytes_used = 0
         self._layout = None
+        # paged mode (ISSUE 15): when a paged decoder binds this cache
+        # it attaches its BlockPool — nodes then hold pool block ids
+        # instead of row arrays, insert/evict move refcounts instead of
+        # bytes, and install_chain writes shipped rows straight into
+        # pool blocks
+        self._pool = None
+        self._dense_bound = False
         from .observe.metrics import MirroredStats, default_registry
         self._registry = registry or default_registry()
         self.stats = MirroredStats(
@@ -363,17 +454,22 @@ class PrefixKVCache:
         self._token_counters: dict = {}
 
     # -- binding -----------------------------------------------------------
-    def bind(self, layout: tuple) -> None:
+    def bind(self, layout: tuple, paged: bool = False) -> None:
         """Record (and enforce) the storage layout this cache holds:
         decoders sharing a cache must agree on (layers, kv heads, head
         dim, dtype, int8-ness, block size) or a hit would scatter rows
-        of the wrong shape into a live slot."""
+        of the wrong shape into a live slot.  `paged` records the
+        binder's storage mode so dense and paged decoders can never
+        mix on one cache regardless of construction order (a dense
+        node's rows and a paged node's pool id are mutually
+        unreadable)."""
         if self._layout is None:
             self._layout = tuple(layout)
         elif self._layout != tuple(layout):
             raise ValueError(
                 f"prefix cache {self.name!r} already bound to layout "
                 f"{self._layout}, decoder wants {tuple(layout)}")
+        self._dense_bound = self._dense_bound or not paged
 
     @property
     def layout(self) -> tuple | None:
@@ -382,6 +478,81 @@ class PrefixKVCache:
         runtime's transfer declares its donor layout and the decode
         side refuses a mismatch before any row lands."""
         return self._layout
+
+    # -- paged storage (ISSUE 15) ------------------------------------------
+    @property
+    def paged(self) -> bool:
+        return self._pool is not None
+
+    @property
+    def pool(self):
+        """The attached BlockPool, or None — what a second paged
+        decoder sharing this cache adopts at construction."""
+        return self._pool
+
+    def attach_pool(self, pool) -> None:
+        """Bind this cache to a paged decoder's BlockPool: cached
+        blocks become refcounted pool residents.  One pool per cache —
+        decoders sharing a paged cache must share the pool (they
+        already must share a geometry via bind())."""
+        if self._pool is not None and self._pool is not pool:
+            raise ValueError(
+                f"prefix cache {self.name!r} is already attached to "
+                f"pool {self._pool.name!r}")
+        if self._dense_bound:
+            # order-independent twin of the dense-decoder-refuses-
+            # paged-cache check: a dense decoder bound FIRST would
+            # later insert() rowful nodes a paged hit cannot alias
+            # (pool_id None), crashing the pump instead of failing
+            # loudly here at construction
+            raise ValueError(
+                f"prefix cache {self.name!r} is bound by a dense "
+                f"decoder; dense and paged decoders cannot share a "
+                f"cache")
+        if self._pool is None and self._nodes:
+            raise ValueError(
+                f"prefix cache {self.name!r} holds dense blocks; "
+                f"cannot switch to paged storage mid-flight")
+        self._pool = pool
+
+    def insert_block(self, tenant: str, parent: str, key: str,
+                     pool_id: int) -> bool:
+        """Paged insert: the harvest path's zero-copy registration —
+        retain one pool ref on `pool_id` and record the key.  The
+        slot's own block BECOMES the cache entry; no rows move.
+        Same budget/refusal semantics as insert()."""
+        tenant = str(tenant or "default")
+        if key in self._nodes:
+            self._nodes.move_to_end(key)
+            return True
+        self._pool.retain([pool_id])
+        node = _PrefixBlock(key, parent, tenant, None, None,
+                            self._pool.block_nbytes,
+                            pool_id=int(pool_id))
+        self._nodes[key] = node
+        parent_node = self._nodes.get(parent)
+        if parent_node is not None:
+            parent_node.children.add(key)
+        self.bytes_used += node.nbytes
+        self._tenant_bytes[tenant] = \
+            self._tenant_bytes.get(tenant, 0) + node.nbytes
+        self.stats["inserts"] += 1
+        self._evict_to_budget(tenant)
+        if key not in self._nodes:      # budget evicted the newcomer
+            self.stats["insert_refused"] += 1
+            self._publish_gauges()
+            return False
+        self._publish_gauges()
+        return True
+
+    def block_rows(self, node) -> tuple:
+        """(per-layer K leaves, per-layer V leaves) of a cached block
+        in the storage layout — dense nodes carry their own rows,
+        paged nodes read the pool (device-side slice views; the wire
+        shipper host-copies them)."""
+        if node.pool_id is not None:
+            return self._pool.block_rows(node.pool_id)
+        return node.k_rows, node.v_rows
 
     def wire_layout(self) -> tuple:
         """The layout as wire-safe string fields (what
@@ -418,11 +589,39 @@ class PrefixKVCache:
             return 0
         keys = self.keys_for(tenant,
                              tokens[:count * self.block_tokens])
+        for entry in blocks[:count - start_block]:
+            self._check_block_geometry(entry)
         parent = keys[start_block - 1] if start_block else ""
         installed = 0
+        if self.paged:
+            # paged landing (ISSUE 15): the wire rows write STRAIGHT
+            # into freshly allocated pool blocks — one scatter per
+            # layer for the whole chain — and the cache records the
+            # ids.  The later prefix-admit is then a pure table edit:
+            # the transferred bytes land exactly once.  The alloc refs
+            # are ours; insert_block retains its own, so releasing at
+            # the end leaves cache-held blocks at refs 1 and refused
+            # ones free.
+            entries = blocks[:count - start_block]
+            ids = self._pool.alloc_blocks(len(entries))
+            layers = int(self._layout[0])
+            k_layers = [_stack_block_leaves(
+                [entry["k"][i] for entry in entries])
+                for i in range(layers)]
+            v_layers = [_stack_block_leaves(
+                [entry["v"][i] for entry in entries])
+                for i in range(layers)]
+            self._pool.write_blocks(ids, k_layers, v_layers)
+            for j in range(start_block, count):
+                if not self.insert_block(tenant, parent, keys[j],
+                                         ids[j - start_block]):
+                    break
+                installed += 1
+                parent = keys[j]
+            self._pool.release_blocks(ids)
+            return installed
         for j in range(start_block, count):
             entry = blocks[j - start_block]
-            self._check_block_geometry(entry)
             if not self.insert(tenant, parent, keys[j],
                                entry["k"], entry["v"]):
                 break
@@ -431,40 +630,9 @@ class PrefixKVCache:
         return installed
 
     def _check_block_geometry(self, entry) -> None:
-        """Refuse a shipped block whose ARRAYS do not match the bound
-        layout — the wire schema proves dtype/rank, but a schema-legal
-        payload with the wrong layer count or head/head-dim extents
-        would poison the slot cache and wedge the pump at the next hit
-        (review finding).  Raises ValueError; the disaggregated client
-        rides its corrupt-transfer rung."""
         if self._layout is None:
             raise ValueError("install into an unbound prefix cache")
-        layers, heads, head_dim = (int(self._layout[0]),
-                                   int(self._layout[1]),
-                                   int(self._layout[2]))
-        int8 = str(self._layout[4]) not in ("False", "0", "")
-        for side in ("k", "v"):
-            rows = entry[side]
-            if len(rows) != layers:
-                raise ValueError(
-                    f"block ships {len(rows)} layers, cache layout "
-                    f"has {layers}")
-            want = (heads, self.block_tokens, head_dim)
-            for leaf in rows:
-                if isinstance(leaf, dict) != int8:
-                    raise ValueError(
-                        f"block {side} storage form does not match "
-                        f"the cache's int8={int8} layout")
-                values = leaf["q"] if isinstance(leaf, dict) else leaf
-                if tuple(values.shape) != want:
-                    raise ValueError(
-                        f"block {side} rows shape "
-                        f"{tuple(values.shape)} != layout {want}")
-                if isinstance(leaf, dict) and \
-                        tuple(leaf["s"].shape) != want[:2]:
-                    raise ValueError(
-                        f"block {side} scale shape "
-                        f"{tuple(leaf['s'].shape)} != {want[:2]}")
+        check_block_geometry(self._layout, self.block_tokens, entry)
 
     # -- lookup ------------------------------------------------------------
     def keys_for(self, tenant: str, tokens) -> list:
@@ -601,6 +769,10 @@ class PrefixKVCache:
 
     def _evict(self, node: _PrefixBlock) -> None:
         del self._nodes[node.key]
+        if node.pool_id is not None:
+            # paged: the cache's ref goes; the pool block frees when
+            # no slot table still aliases it
+            self._pool.release_blocks([node.pool_id])
         parent = self._nodes.get(node.parent)
         if parent is not None:
             parent.children.discard(node.key)
@@ -1111,6 +1283,119 @@ def _step_for(config: LlamaConfig, kv_write: str, attention_impl: str):
 _POS_INVALID = 1 << 30
 
 
+def _spec_scan_body(config: LlamaConfig, cos, sin, k_spec: int,
+                    ngram: int, params, eos, k_caches, v_caches,
+                    entry_lengths):
+    """The speculative drafting/verify/acceptance scan body, shared
+    VERBATIM by the dense (_build_spec_step) and paged
+    (serving_paged._build_paged_spec_step) builders — like the
+    attention bodies, ONE copy is what keeps the paged/dense
+    bit-parity invariant safe from a fix landing on only one side.
+    The builders differ only in how k_caches/v_caches are obtained
+    (dense slot caches vs per-round pool gathers) and how the
+    consumed side entries merge back at scan exit."""
+    width = k_spec + 1
+    slots_n = entry_lengths.shape[0]
+    col = jnp.arange(width)[None]                        # [1, w]
+    row = jnp.arange(slots_n)[:, None]                   # [S, 1]
+
+    def draft(context, tokens, lengths):
+        """Prompt-lookup drafts [S, k_spec]: match the last `ngram`
+        tokens (the pending token + ngram-1 history tokens) at every
+        history position, take the LATEST hit, and propose the tokens
+        that followed it.  A miss proposes zeros — certain rejection,
+        which costs nothing extra: the verify block runs at width
+        1 + k_spec regardless, and acceptance never affects WHICH
+        tokens are emitted, only how many per iteration."""
+        ctx_len = context.shape[1]
+        pos = jnp.arange(ctx_len)[None]                  # [1, C]
+        hit = (pos >= ngram - 1) & (pos < lengths[:, None]) & \
+            (context == tokens[:, None])
+        for i in range(1, ngram):
+            prev = jnp.take_along_axis(
+                context, jnp.maximum(lengths[:, None] - i, 0), axis=1)
+            # roll never wraps into the valid region: hit requires
+            # pos >= ngram-1 >= i
+            hit = hit & (jnp.roll(context, i, axis=1) == prev)
+        # prefer the latest hit whose continuation is FULLY written
+        # history (k real tokens follow it); fall back to the latest
+        # with at least one — a frontier hit would draft unwritten
+        # garbage and waste the verify width on certain rejections
+        full = hit & (pos <= lengths[:, None] - 1 - k_spec)
+        some = hit & (pos < lengths[:, None] - 1)
+        best_full = jnp.max(jnp.where(full, pos, -1), axis=1)
+        best_some = jnp.max(jnp.where(some, pos, -1), axis=1)
+        best = jnp.where(best_full >= 0, best_full, best_some)  # [S]
+        take = jnp.clip(best[:, None] + 1 + jnp.arange(k_spec)[None],
+                        0, ctx_len - 1)
+        drafts = jnp.take_along_axis(context, take, axis=1)
+        return jnp.where(best[:, None] >= 0, drafts, 0)
+
+    def body(carry, step_index):
+        (tokens, lengths, active, budgets, context, k_sides,
+         v_sides, pos_side) = carry
+        drafts = draft(context, tokens, lengths)
+        seq = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        base = step_index * width
+        q_pos = lengths[:, None] + col                   # [S, w]
+        # provisional: the whole block is live while it attends to
+        # itself; rejected entries are invalidated after acceptance
+        pos_side = jax.lax.dynamic_update_slice(pos_side, q_pos,
+                                                (0, base))
+        new_k, new_v = [], []
+
+        def attend(i, layer, normed):
+            attn_out, k_s, v_s = _slot_attention_spec(
+                layer, config, normed, cos, sin, k_caches[i],
+                v_caches[i], k_sides[i], v_sides[i], pos_side,
+                entry_lengths, lengths, base)
+            new_k.append(k_s)
+            new_v.append(v_s)
+            return attn_out
+
+        block_argmax = _token_block_argmax(params, config, seq,
+                                           attend)      # [S, w]
+        k_sides, v_sides = new_k, new_v
+        # greedy acceptance: argmax after consuming seq[:j] must
+        # reproduce draft j; the first miss takes the model's own
+        # token (always emitted — that is the non-speculative step)
+        match = (drafts == block_argmax[:, :-1])
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                       axis=1), axis=1)  # [S]
+        can = (col <= accepted[:, None]) & \
+            (col < budgets[:, None]) & active[:, None]
+        stop = (block_argmax == eos) & can
+        keep = jnp.cumprod(1 - stop.astype(jnp.int32), axis=1)
+        keep_excl = jnp.concatenate(
+            [jnp.ones((slots_n, 1), jnp.int32), keep[:, :-1]],
+            axis=1)
+        emit = can & (keep_excl > 0)
+        emitted_n = jnp.sum(emit, axis=1).astype(jnp.int32)
+        last = jnp.take_along_axis(
+            block_argmax, jnp.maximum(emitted_n - 1, 0)[:, None],
+            axis=1)[:, 0]
+        tokens = jnp.where(emitted_n > 0, last, tokens)
+        # context gets the whole block for active slots: entries
+        # past the consumed run are garbage BEYOND the new length,
+        # overwritten by the next iteration before the drafter
+        # (masked to pos < length) could ever read them
+        ctx_pos = jnp.where(active[:, None], q_pos, _POS_INVALID)
+        context = context.at[row, ctx_pos].set(seq, mode="drop")
+        lengths = lengths + emitted_n
+        budgets = budgets - emitted_n
+        active = active & (budgets > 0) & \
+            ~jnp.any(stop & emit, axis=1)
+        final_pos = jnp.where(col < emitted_n[:, None], q_pos,
+                              _POS_INVALID)
+        pos_side = jax.lax.dynamic_update_slice(pos_side, final_pos,
+                                                (0, base))
+        return ((tokens, lengths, active, budgets, context,
+                 k_sides, v_sides, pos_side),
+                (block_argmax, emit))
+
+    return body
+
+
 def _build_spec_step(config: LlamaConfig, k_spec: int, ngram: int):
     """Self-speculative decode scan (speculate_k): each iteration
     drafts `k_spec` tokens per slot by prompt lookup — an n-gram match
@@ -1134,38 +1419,6 @@ def _build_spec_step(config: LlamaConfig, k_spec: int, ngram: int):
                                   config.rope_theta)
     width = k_spec + 1
 
-    def draft(context, tokens, lengths):
-        """Prompt-lookup drafts [S, k_spec]: match the last `ngram`
-        tokens (the pending token + ngram-1 history tokens) at every
-        history position, take the LATEST hit, and propose the tokens
-        that followed it.  A miss proposes zeros — certain rejection,
-        which costs nothing extra: the verify block runs at width
-        1 + k_spec regardless, and acceptance never affects WHICH
-        tokens are emitted, only how many per iteration."""
-        ctx_len = context.shape[1]
-        pos = jnp.arange(ctx_len)[None]                      # [1, C]
-        hit = (pos >= ngram - 1) & (pos < lengths[:, None]) & \
-            (context == tokens[:, None])
-        for i in range(1, ngram):
-            prev = jnp.take_along_axis(
-                context, jnp.maximum(lengths[:, None] - i, 0), axis=1)
-            # roll never wraps into the valid region: hit requires
-            # pos >= ngram-1 >= i
-            hit = hit & (jnp.roll(context, i, axis=1) == prev)
-        # prefer the latest hit whose continuation is FULLY written
-        # history (k real tokens follow it); fall back to the latest
-        # with at least one — a frontier hit would draft unwritten
-        # garbage and waste the verify width on certain rejections
-        full = hit & (pos <= lengths[:, None] - 1 - k_spec)
-        some = hit & (pos < lengths[:, None] - 1)
-        best_full = jnp.max(jnp.where(full, pos, -1), axis=1)
-        best_some = jnp.max(jnp.where(some, pos, -1), axis=1)
-        best = jnp.where(best_full >= 0, best_full, best_some)  # [S]
-        take = jnp.clip(best[:, None] + 1 + jnp.arange(k_spec)[None],
-                        0, ctx_len - 1)
-        drafts = jnp.take_along_axis(context, take, axis=1)
-        return jnp.where(best[:, None] >= 0, drafts, 0)
-
     def spec_step(params, tokens, lengths, active, budgets, context,
                   k_caches, v_caches, num_steps, eos):
         entry_lengths = lengths
@@ -1179,70 +1432,9 @@ def _build_spec_step(config: LlamaConfig, k_spec: int, ngram: int):
                    for _ in range(config.num_layers)]
         pos_side = jnp.full((slots_n, side_len), _POS_INVALID,
                             jnp.int32)
-        col = jnp.arange(width)[None]                        # [1, w]
-        row = jnp.arange(slots_n)[:, None]                   # [S, 1]
-
-        def body(carry, step_index):
-            (tokens, lengths, active, budgets, context, k_sides,
-             v_sides, pos_side) = carry
-            drafts = draft(context, tokens, lengths)
-            seq = jnp.concatenate([tokens[:, None], drafts], axis=1)
-            base = step_index * width
-            q_pos = lengths[:, None] + col                   # [S, w]
-            # provisional: the whole block is live while it attends to
-            # itself; rejected entries are invalidated after acceptance
-            pos_side = jax.lax.dynamic_update_slice(pos_side, q_pos,
-                                                    (0, base))
-            new_k, new_v = [], []
-
-            def attend(i, layer, normed):
-                attn_out, k_s, v_s = _slot_attention_spec(
-                    layer, config, normed, cos, sin, k_caches[i],
-                    v_caches[i], k_sides[i], v_sides[i], pos_side,
-                    entry_lengths, lengths, base)
-                new_k.append(k_s)
-                new_v.append(v_s)
-                return attn_out
-
-            block_argmax = _token_block_argmax(params, config, seq,
-                                               attend)      # [S, w]
-            k_sides, v_sides = new_k, new_v
-            # greedy acceptance: argmax after consuming seq[:j] must
-            # reproduce draft j; the first miss takes the model's own
-            # token (always emitted — that is the non-speculative step)
-            match = (drafts == block_argmax[:, :-1])
-            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
-                                           axis=1), axis=1)  # [S]
-            can = (col <= accepted[:, None]) & \
-                (col < budgets[:, None]) & active[:, None]
-            stop = (block_argmax == eos) & can
-            keep = jnp.cumprod(1 - stop.astype(jnp.int32), axis=1)
-            keep_excl = jnp.concatenate(
-                [jnp.ones((slots_n, 1), jnp.int32), keep[:, :-1]],
-                axis=1)
-            emit = can & (keep_excl > 0)
-            emitted_n = jnp.sum(emit, axis=1).astype(jnp.int32)
-            last = jnp.take_along_axis(
-                block_argmax, jnp.maximum(emitted_n - 1, 0)[:, None],
-                axis=1)[:, 0]
-            tokens = jnp.where(emitted_n > 0, last, tokens)
-            # context gets the whole block for active slots: entries
-            # past the consumed run are garbage BEYOND the new length,
-            # overwritten by the next iteration before the drafter
-            # (masked to pos < length) could ever read them
-            ctx_pos = jnp.where(active[:, None], q_pos, _POS_INVALID)
-            context = context.at[row, ctx_pos].set(seq, mode="drop")
-            lengths = lengths + emitted_n
-            budgets = budgets - emitted_n
-            active = active & (budgets > 0) & \
-                ~jnp.any(stop & emit, axis=1)
-            final_pos = jnp.where(col < emitted_n[:, None], q_pos,
-                                  _POS_INVALID)
-            pos_side = jax.lax.dynamic_update_slice(pos_side, final_pos,
-                                                    (0, base))
-            return ((tokens, lengths, active, budgets, context,
-                     k_sides, v_sides, pos_side),
-                    (block_argmax, emit))
+        body = _spec_scan_body(config, cos, sin, k_spec, ngram,
+                               params, eos, k_caches, v_caches,
+                               entry_lengths)
 
         (tokens, lengths, active, budgets, context, k_sides, v_sides,
          pos_side), (emitted, emit_mask) = jax.lax.scan(
@@ -1311,7 +1503,8 @@ class ContinuousDecoder:
                  kv_cache_dtype: str | None = None,
                  speculate_k: int = 0, speculate_ngram: int = 2,
                  name: str = "decoder", registry=None,
-                 prefix_cache: PrefixKVCache | None = None):
+                 prefix_cache: PrefixKVCache | None = None,
+                 paged_kv: bool = False, kv_block: int = 32):
         self.config = config
         # int8 KV cache (ISSUE 7): the slot caches store int8 values
         # with per-(slot, head, position) f32 scales
@@ -1406,6 +1599,29 @@ class ContinuousDecoder:
         self.on_idle = None          # hook: fires when the last slot
                                      # retires and nothing is pending
 
+        # paged KV (ISSUE 15): the slot caches become ONE refcounted
+        # block pool plus per-slot int32 block tables — a prefix hit
+        # aliases cached blocks into the table (zero copy), harvest is
+        # a refcount bump, the disaggregated install lands once.  The
+        # compiled step gathers a slot-major view from the pool and
+        # runs the SAME attention bodies at the same shapes, so greedy
+        # output is bit-identical to the dense cache (the parity
+        # matrix in tests/test_paged_kv.py asserts it across int8 /
+        # chunked / spec / mid-stream / disagg).  Dense stays the A/B
+        # behind AIKO_BENCH_LLAMA_PAGED=off.
+        self.paged = bool(paged_kv)
+        if self.paged and KV_WRITE != "block":
+            raise ValueError(
+                "paged_kv requires the block KV write mode "
+                "(AIKO_DECODE_KV=block): the select mode rewrites the "
+                "whole cache inside the scan, which a block pool "
+                "cannot express")
+        self.kv_block = int(prefix_cache.block_tokens) \
+            if prefix_cache is not None else int(kv_block)
+        if self.paged and self.kv_block < 1:
+            raise ValueError(
+                f"kv_block must be >= 1, got {kv_block}")
+
         # the cache TIME axis is allocated at the workload, not at
         # max_seq: it grows/shrinks in t_block steps to cover the
         # longest active context (_fit_caches).  HBM capacity AND
@@ -1414,8 +1630,79 @@ class ContinuousDecoder:
         # worth of cache (an in-program slice doesn't help: it
         # materializes, measured 3× attention bytes).
         self._cache_t = min(self.t_block, self.max_seq)
-        self._k = self._zero_caches()
-        self._v = self._zero_caches()
+
+        # prefix/KV reuse cache (ISSUE 13): hash-addressed block
+        # sharing across requests and sessions.  The cache stores rows
+        # in THIS decoder's storage layout (int8 dicts when kv_int8 —
+        # a hit is a bytes win too); bind() enforces layout agreement
+        # when several decoders share one cache.  Harvest at retire,
+        # longest-match at admit, copy-in via _prefix_copy_fn_for.
+        self.prefix_cache = prefix_cache
+        item = jnp.dtype(config.dtype).itemsize
+        # the layout tuple is the geometry handshake for binding AND
+        # for the disaggregated wire — a cacheless paged decoder still
+        # needs it for the direct slot-table install (ISSUE 15)
+        self._kv_layout = (config.num_layers, config.num_kv_heads,
+                           config.head_dim, str(config.dtype),
+                           self.kv_int8, self.kv_block, item)
+        if prefix_cache is not None:
+            prefix_cache.bind(self._kv_layout, paged=self.paged)
+            if not self.paged and prefix_cache.paged:
+                raise ValueError(
+                    "prefix cache holds paged (pool-resident) blocks; "
+                    "a dense decoder cannot bind it")
+
+        if self.paged:
+            from .serving_paged import BlockPool
+            block = self.kv_block
+            # table width covers the worst-case extent _fit_caches can
+            # reach (max_seq + block-mode merge headroom)
+            headroom = 0 if self.speculate_k else steps_per_sync
+            self._table_blocks = -(-(self.max_seq + headroom) // block)
+            initial = max_slots * (-(-self._cache_t // block))
+            if prefix_cache is not None and prefix_cache.paged:
+                # a decoder sharing an already-attached cache ADOPTS
+                # its pool (attach_pool's one-pool-per-cache contract;
+                # bind() above proved the geometry agrees) and reserves
+                # its own slot coverage on top of what's resident.
+                self.pool = prefix_cache.pool
+                self.pool.reserve(self.pool.num_blocks - 1 + initial)
+            else:
+                self.pool = BlockPool(
+                    self.config, block, self.kv_int8,
+                    initial_blocks=initial,
+                    grow_blocks=max(
+                        1, max_slots * self.t_block // block),
+                    name=name, registry=registry)
+                if prefix_cache is not None:
+                    prefix_cache.attach_pool(self.pool)
+                    if prefix_cache.max_bytes:
+                        # anticipate the cache's pool residency up
+                        # front: a pool capacity change retraces every
+                        # compiled program that touches it, so steady
+                        # state should be reachable without mid-serving
+                        # growth.  Bounded by one full-max_seq slot
+                        # population — the same worst case the dense
+                        # cache could reach.
+                        anticipated = min(
+                            prefix_cache.max_bytes
+                            // self.pool.block_nbytes,
+                            max_slots * (-(-self.max_seq // block)))
+                        self.pool.reserve(initial + anticipated)
+            self._tables_np = np.zeros(
+                (max_slots, self._table_blocks), np.int32)
+            self._tables_dirty = True
+            self._tables_dev = None
+            self._tables_dev_nb = -1
+            # per-slot owned/aliased pool block ids, in table order
+            self._slot_blocks: list[list] = \
+                [[] for _ in range(max_slots)]
+            self._k = None
+            self._v = None
+        else:
+            self.pool = None
+            self._k = self._zero_caches()
+            self._v = self._zero_caches()
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._lengths = jnp.zeros((max_slots,), jnp.int32)
         # device-side token history per slot, written by admits /
@@ -1428,29 +1715,24 @@ class ContinuousDecoder:
             jnp.int32)
         self._resize_fns: dict = {}
 
-        # prefix/KV reuse cache (ISSUE 13): hash-addressed block
-        # sharing across requests and sessions.  The cache stores rows
-        # in THIS decoder's storage layout (int8 dicts when kv_int8 —
-        # a hit is a bytes win too); bind() enforces layout agreement
-        # when several decoders share one cache.  Harvest at retire,
-        # longest-match at admit, copy-in via _prefix_copy_fn_for.
-        self.prefix_cache = prefix_cache
-        if prefix_cache is not None:
-            item = jnp.dtype(config.dtype).itemsize
-            prefix_cache.bind((config.num_layers, config.num_kv_heads,
-                               config.head_dim, str(config.dtype),
-                               self.kv_int8,
-                               prefix_cache.block_tokens, item))
         self._prefix_pad = None         # lazy zero pad block (copy-in)
         # measured host dispatch seconds per prefill token (EWMA): the
         # prompt-cost term of estimated_admit_wait, which prefix hits
         # credit away (ISSUE 13 satellite)
         self._prefill_token_ewma: float | None = None
 
-        self._step = _spec_step_for(config, self.speculate_k,
-                                    self.speculate_ngram, KV_WRITE) \
-            if self.speculate_k else _step_for(config, KV_WRITE,
-                                               ATTENTION_IMPL)
+        if self.paged:
+            from .serving_paged import (_paged_spec_step_for,
+                                        _paged_step_for)
+            self._step = _paged_spec_step_for(
+                config, self.speculate_k, self.speculate_ngram) \
+                if self.speculate_k else _paged_step_for(config)
+        else:
+            self._step = _spec_step_for(config, self.speculate_k,
+                                        self.speculate_ngram,
+                                        KV_WRITE) \
+                if self.speculate_k else _step_for(config, KV_WRITE,
+                                                   ATTENTION_IMPL)
         # in-flight prefix dedup window (ISSUE 14 satellite): leading
         # block key -> the request currently prefilling that chain.
         # Bounded by the slot pool: entries unregister at early
@@ -1530,7 +1812,15 @@ class ContinuousDecoder:
              "chunk_admits": 0, "prefix_admits": 0,
              "round_prefill_tokens_max": 0,
              "admission_shed": 0,
-             "dedup_deferred": 0, "dedup_shared": 0},
+             "dedup_deferred": 0, "dedup_shared": 0,
+             # paged A/B surfaces (ISSUE 15): bytes a prefix hit
+             # copied into the slot (paged: 0 — aliasing), bytes
+             # harvest copied out at retire (paged: 0 — refcount
+             # bump), and copy-on-extend events (paged only: a write
+             # into a SHARED block copies it first)
+             "prefix_copy_bytes": 0, "harvest_copy_bytes": 0,
+             "cow_copies": 0, "cow_copy_bytes": 0,
+             "install_misaligned": 0},
             metric="serving_decoder_total",
             help="continuous-decoder events by kind",
             # levels and time-sums stay dict-only: a high-water mark or
@@ -1538,7 +1828,9 @@ class ContinuousDecoder:
             # family would make rate()/sum() over the family meaningless
             registry=self._registry,
             skip=("occupancy_sum", "prefill_s", "decode_s",
-                  "accepted_per_step", "round_prefill_tokens_max"))
+                  "accepted_per_step", "round_prefill_tokens_max",
+                  "prefix_copy_bytes", "harvest_copy_bytes",
+                  "cow_copy_bytes"))
         # SLO samples (seconds): TTFT per request, mean inter-token
         # latency per retired request, and each request's worst
         # inter-sync stall — the number chunked prefill bounds
@@ -1633,7 +1925,8 @@ class ContinuousDecoder:
     def submit(self, request_id: str, prompt, max_new_tokens: int,
                callback, deadline: float | None = None,
                tenant: str | None = None,
-               prefill_label: str | None = None) -> bool:
+               prefill_label: str | None = None,
+               kv_blocks: tuple | None = None) -> bool:
         """Enqueue one request; returns False when deadline-aware
         admission rejected it instead (the callback is NOT invoked —
         the caller owns the refusal).  `deadline` (absolute,
@@ -1687,7 +1980,9 @@ class ContinuousDecoder:
             limit = min(self.max_seq - 1, self.prefill_buckets[-1])
         # empty prompts would seed generation from a pad position —
         # normalize to a single pad token at position 0
-        prompt = ([int(t) for t in prompt] or [0])[-limit:]
+        prompt = [int(t) for t in prompt] or [0]
+        truncated = len(prompt) > limit
+        prompt = prompt[-limit:]
         if deadline is not None:
             wait = self.estimated_admit_wait(prompt=prompt,
                                              tenant=journey.tenant)
@@ -1701,11 +1996,50 @@ class ContinuousDecoder:
             # request is "cached" mechanically (the shipped chain
             # hits) but belongs to its own TTFT/journey population
             journey.prefill_label = str(prefill_label)
-        self._pending.append(DecodeRequest(
+        request = DecodeRequest(
             request_id, prompt, int(max_new_tokens), callback,
             submit_time=now, journey=journey, deadline=deadline,
             tenant=journey.tenant,
-            prefill_label=str(prefill_label or "")))
+            prefill_label=str(prefill_label or ""))
+        if kv_blocks:
+            # direct slot-table install (ISSUE 15 satellite): the
+            # caller pre-installed pool blocks covering the prompt's
+            # leading tokens (install_shipped_blocks); admit aliases
+            # them into the slot's table and prefills only the suffix.
+            # At least one suffix token must remain to produce the
+            # first output, so a whole-prompt cover drops its final
+            # block back to the pool here.  Ownership transfers on
+            # acceptance only — a shed above returned False with the
+            # ids untouched, so the caller's release stays balanced.
+            if not self.paged:
+                raise ValueError(
+                    "kv_blocks install needs a paged decoder")
+            covered, ids = int(kv_blocks[0]), list(kv_blocks[1])
+            if truncated:
+                # the ids cover the ORIGINAL prompt's head — exactly
+                # the tokens the tail-truncation above removed — so
+                # aliasing them would attend to KV for a different
+                # prompt and silently emit wrong tokens.  In-repo
+                # callers cap with serving_disagg._prompt_cap BEFORE
+                # installing (this never fires on that path); a direct
+                # API caller pays a cold prefill instead.
+                self.logger.warning(
+                    "kv_blocks install for %s dropped: prompt "
+                    "exceeds the admit cap %d (%d-token cover); "
+                    "cold prefill", request_id, limit, covered)
+                self.stats["install_misaligned"] += 1
+                self.pool.release_blocks(ids)
+            else:
+                block = self.kv_block
+                usable = min(covered, len(ids) * block,
+                             ((len(prompt) - 1) // block) * block)
+                keep = max(0, usable // block)
+                if len(ids) > keep:
+                    self.pool.release_blocks(ids[keep:])
+                request.kv_block_ids = ids[:keep]
+                request.prefix_hit = keep * block
+                request.prefix_probed = True
+        self._pending.append(request)
         return True
 
     def attach(self, engine, period: float = 0.002) -> int:
@@ -1750,9 +2084,15 @@ class ContinuousDecoder:
         Shared process-wide via _admit_fn_for, like the decode step."""
         key = (bucket, width)
         if key not in self._prefill_fns:
-            self._prefill_fns[key] = _admit_fn_for(
-                self.config, bucket, width, self.kv_int8,
-                bool(self.speculate_k))
+            if self.paged:
+                from .serving_paged import _paged_admit_fn_for
+                self._prefill_fns[key] = _paged_admit_fn_for(
+                    self.config, bucket, width, self.kv_int8,
+                    bool(self.speculate_k))
+            else:
+                self._prefill_fns[key] = _admit_fn_for(
+                    self.config, bucket, width, self.kv_int8,
+                    bool(self.speculate_k))
         return self._prefill_fns[key]
 
     def _extend_fn(self, chunk: int, width: int):
@@ -1764,9 +2104,15 @@ class ContinuousDecoder:
         own (bounded compile variants)."""
         key = ("extend", chunk, width)
         if key not in self._prefill_fns:
-            self._prefill_fns[key] = _extend_fn_for(
-                self.config, chunk, width, self.kv_int8,
-                bool(self.speculate_k))
+            if self.paged:
+                from .serving_paged import _paged_extend_fn_for
+                self._prefill_fns[key] = _paged_extend_fn_for(
+                    self.config, chunk, width, self.kv_int8,
+                    bool(self.speculate_k))
+            else:
+                self._prefill_fns[key] = _extend_fn_for(
+                    self.config, chunk, width, self.kv_int8,
+                    bool(self.speculate_k))
         return self._prefill_fns[key]
 
     def _advance_prefills(self) -> None:
@@ -1868,14 +2214,47 @@ class ContinuousDecoder:
                 else 0
             valid[j] = True
             finish_arr[j] = finish
-        (firsts, self._k, self._v, self._tokens, self._lengths,
-         self._context) = self._extend_fn(chunk, width)(
-            self.params, self._k, self._v, self._tokens,
-            self._lengths, self._context, jnp.asarray(chunk_tokens),
-            jnp.asarray(offsets),
-            jnp.asarray(slots + pad_slots, jnp.int32),
-            jnp.asarray(valid), jnp.asarray(finish_arr),
-            jnp.asarray(final_idx))
+        if self.paged:
+            # copy-on-extend (ISSUE 15): the chunk writes positions
+            # [offset, offset+chunk) — any SHARED block there (the
+            # near-seq-cap slide-back into a cached region) copies to
+            # a fresh block first, so aliased readers keep their rows.
+            # The recompute that follows is idempotent, so parity
+            # holds either way; the copy preserves the ALIASED chain.
+            pairs = []
+            for slot, request, offset, finish in batch:
+                self._ensure_coverage(slot, offset + chunk)
+                pairs.extend(self._copy_on_write(slot, offset,
+                                                 offset + chunk))
+            if pairs:
+                copied = self.pool.copy_blocks(
+                    [src for src, _ in pairs],
+                    [dst for _, dst in pairs])
+                self.stats["cow_copies"] += len(pairs)
+                self.stats["cow_copy_bytes"] += copied
+            nbt = -(-self._cache_t // self.kv_block)
+            tables_rows = np.zeros((width, nbt), np.int32)
+            for j, slot in enumerate(slots):
+                tables_rows[j] = self._tables_np[slot, :nbt]
+            (firsts, k_pools, v_pools, self._tokens, self._lengths,
+             self._context) = self._extend_fn(chunk, width)(
+                self.params, self.pool.k_pools, self.pool.v_pools,
+                self._tokens, self._lengths, self._context,
+                jnp.asarray(chunk_tokens), jnp.asarray(offsets),
+                jnp.asarray(slots + pad_slots, jnp.int32),
+                jnp.asarray(valid), jnp.asarray(finish_arr),
+                jnp.asarray(final_idx), jnp.asarray(tables_rows),
+                t_cap=self._cache_t)
+            self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
+        else:
+            (firsts, self._k, self._v, self._tokens, self._lengths,
+             self._context) = self._extend_fn(chunk, width)(
+                self.params, self._k, self._v, self._tokens,
+                self._lengths, self._context, jnp.asarray(chunk_tokens),
+                jnp.asarray(offsets),
+                jnp.asarray(slots + pad_slots, jnp.int32),
+                jnp.asarray(valid), jnp.asarray(finish_arr),
+                jnp.asarray(final_idx))
         # HBM model for the extend program: weight stream + per-row
         # prefix read (dequantize up to offset) + chunk write
         row_bytes = self._kv_bytes_per_t // self.max_slots
@@ -1907,6 +2286,131 @@ class ContinuousDecoder:
     def _next_pow2(n: int) -> int:
         return 1 << max(0, (n - 1).bit_length())
 
+    # -- paged block tables (ISSUE 15) -------------------------------------
+    def _ensure_coverage(self, slot: int, upto: int) -> None:
+        """Extend `slot`'s block table to cover positions [0, upto):
+        allocate fresh pool blocks for the uncovered tail.  A no-op
+        when already covered — the common decode round allocates one
+        block only when the context crosses a block boundary."""
+        block = self.kv_block
+        need = min(-(-max(0, upto) // block), self._table_blocks)
+        owned = self._slot_blocks[slot]
+        if len(owned) >= need:
+            return
+        fresh = self.pool.alloc_blocks(need - len(owned))
+        row = self._tables_np[slot]
+        for j, block_id in enumerate(fresh, start=len(owned)):
+            row[j] = block_id
+        owned.extend(fresh)
+        self._tables_dirty = True
+
+    def _copy_on_write(self, slot: int, start: int, stop: int) -> list:
+        """Make every block covering positions [start, stop) of `slot`
+        exclusively owned before a write lands there: a SHARED block
+        (refs > 1 — aliased by the prefix cache or another slot) is
+        copied to a fresh block and the table repointed, so aliased
+        readers never observe the mutation.  Returns (src, dst) pairs
+        for the batched device copy.  The near-seq-cap final-chunk
+        slide-back into a cached region is the one live writer of
+        shared blocks; the common extend writes only owned tail
+        blocks and copies nothing."""
+        block = self.kv_block
+        owned = self._slot_blocks[slot]
+        row = self._tables_np[slot]
+        pairs = []
+        for j in range(start // block,
+                       min(-(-stop // block), len(owned))):
+            old = owned[j]
+            if self.pool.refs(old) <= 1:
+                continue
+            new = self.pool.alloc_blocks(1)[0]
+            pairs.append((old, new))
+            owned[j] = new
+            row[j] = new
+            self.pool.release_blocks([old])
+            self._tables_dirty = True
+        return pairs
+
+    def _prepare_round_tables(self, occupied, num_steps: int):
+        """Round prologue for the paged scan: extend every scanned
+        slot's table to cover the positions this round's merge can
+        write (entry length + num_steps tokens — per verify-block
+        width in speculative mode), then hand back the device table
+        slice at the current gather width."""
+        per_step = 1 + self.speculate_k
+        cap = self.max_seq if self.speculate_k \
+            else self.max_seq + self.steps_per_sync
+        for slot in occupied:
+            request = self._slots[slot]
+            owed = 0 if request.generated else 1
+            current = len(request.prompt) + len(request.generated) \
+                + owed
+            self._ensure_coverage(
+                slot, min(current + num_steps * per_step, cap))
+        return self._tables_device(-(-self._cache_t // self.kv_block))
+
+    def _tables_device(self, nb: int):
+        """The device block-table slice [S, nb] the compiled programs
+        gather through; rebuilt only when the host tables changed or
+        the gather width moved (one small int32 transfer)."""
+        if self._tables_dirty or nb != self._tables_dev_nb:
+            self._tables_dev = jnp.asarray(self._tables_np[:, :nb])
+            self._tables_dev_nb = nb
+            self._tables_dirty = False
+        return self._tables_dev
+
+    def _release_slot_blocks(self, slot: int) -> None:
+        """Drop the slot's refs on every table block at retire.
+        Blocks the harvest registered stay alive through the cache's
+        own refs; purely-owned blocks return to the free list."""
+        owned = self._slot_blocks[slot]
+        if owned:
+            self.pool.release_blocks(owned)
+            self._slot_blocks[slot] = []
+            self._tables_np[slot, :len(owned)] = 0
+            self._tables_dirty = True
+
+    def kv_wire_layout(self) -> tuple:
+        """The storage layout as wire-safe string fields — what a
+        cacheless paged decoder matches a KV transfer's declared donor
+        layout against (PrefixKVCache.wire_layout's twin)."""
+        return tuple(str(f) for f in self._kv_layout)
+
+    def install_shipped_blocks(self, tokens, start_block: int,
+                               blocks) -> tuple:
+        """Direct slot-table install (ISSUE 15 satellite): write
+        shipped chain blocks straight into fresh pool blocks and hand
+        the ids to the caller for submit(..) via DecodeRequest
+        aliasing — the cacheless decode pool's KV landing (no
+        PrefixKVCache required).  Returns (covered_tokens, ids);
+        ownership of the ids transfers to the caller (release on a
+        refused submit).  Raises ValueError on geometry mismatch,
+        before any row lands."""
+        if not self.paged:
+            raise ValueError(
+                "install_shipped_blocks needs a paged decoder")
+        if int(start_block) != 0:
+            raise ValueError(
+                "direct slot-table install cannot start mid-chain "
+                f"(start_block={start_block}): without a prefix cache "
+                "the decode side holds no earlier blocks")
+        block = self.kv_block
+        count = min(len(blocks), len(tokens) // block)
+        entries = blocks[:count]
+        for entry in entries:
+            check_block_geometry(self._kv_layout, block, entry)
+        if not entries:
+            return 0, []
+        ids = self.pool.alloc_blocks(len(entries))
+        layers = self.config.num_layers
+        self.pool.write_blocks(
+            ids,
+            [_stack_block_leaves([entry["k"][i] for entry in entries])
+             for i in range(layers)],
+            [_stack_block_leaves([entry["v"][i] for entry in entries])
+             for i in range(layers)])
+        return count * block, ids
+
     def _zero_caches(self, t: int | None = None) -> list:
         """Fresh per-layer slot caches at time extent `t` (default: the
         current serving extent) in the decoder's storage layout — plain
@@ -1924,7 +2428,12 @@ class ContinuousDecoder:
 
     def kv_cache_bytes(self) -> int:
         """Bytes currently allocated to the slot KV caches (values +
-        scales) — the number kv_cache_dtype='int8' halves."""
+        scales) — the number kv_cache_dtype='int8' halves.  In paged
+        mode this models the POOL: block arrays plus the int32
+        tables (ISSUE 15) — shared prefixes are counted once, which is
+        the capacity win block aliasing buys."""
+        if self.paged:
+            return self.pool.nbytes() + int(self._tables_np.nbytes)
         return int(sum(
             leaf.size * jnp.dtype(leaf.dtype).itemsize
             for cache in self._k + self._v
@@ -1946,6 +2455,12 @@ class ContinuousDecoder:
             cap = self.max_seq + self.steps_per_sync
         new_t = min(cap, -(-required_t // self.t_block) * self.t_block)
         if new_t == self._cache_t:
+            return
+        if self.paged:
+            # the pool allocates per block on demand; only the gather
+            # width (and with it the step's streamed bytes) tracks the
+            # workload here — no device copy at all
+            self._cache_t = new_t
             return
         key = (self._cache_t, new_t)
         if key not in self._resize_fns:
@@ -1998,6 +2513,14 @@ class ContinuousDecoder:
             request = pending[index]
             if taken >= len(free):
                 break
+            if request.kv_block_ids:
+                # direct slot-table install (ISSUE 15): the blocks are
+                # already pool-resident — admit is a table edit plus
+                # the suffix prefill, no cache probe involved
+                cached.append(request)
+                taken += 1
+                index += 1
+                continue
             if self.prefix_cache is not None and request.dedup_wait:
                 # in-flight prefix dedup window (ISSUE 14 satellite,
                 # PR 13 residue d): this request deferred behind a
@@ -2130,9 +2653,11 @@ class ContinuousDecoder:
     def _prefix_write_len(self, request: DecodeRequest) -> int:
         """Copy-in write extent for a hit: the chain's tokens padded up
         to a pow2 block count (bounded compile variants), capped at
-        max_seq — near the cap the exact length compiles instead."""
-        blocks = request.prefix_hit // self.prefix_cache.block_tokens
-        padded = self._next_pow2(blocks) * self.prefix_cache.block_tokens
+        max_seq — near the cap the exact length compiles instead.
+        (Paged admits move no KV rows at all; this extent then sizes
+        only the speculative-context seed.)"""
+        blocks = request.prefix_hit // self.kv_block
+        padded = self._next_pow2(blocks) * self.kv_block
         return padded if padded <= self.max_seq else request.prefix_hit
 
     def _prefix_zero_block(self):
@@ -2157,7 +2682,16 @@ class ContinuousDecoder:
         speculative context with the cached prompt tokens, and leave
         the slot mid-prefill at the hit boundary — _advance_prefills
         runs the uncached suffix, and the finish extend produces the
-        first token exactly like a chunked admit."""
+        first token exactly like a chunked admit.
+
+        PAGED (ISSUE 15): no rows move at all — the chain's pool
+        blocks alias into the slot's table (retain refs, host-side
+        edit), the one device write left being the speculative-context
+        seed.  prefix_copy_bytes stays 0; that delta vs the dense copy
+        is the A/B the bench quotes."""
+        if self.paged:
+            self._prefix_admit_paged(slot, request, admit_t)
+            return
         cache = self.prefix_cache
         config = self.config
         t_write = self._prefix_write_len(request)
@@ -2182,9 +2716,50 @@ class ContinuousDecoder:
             jnp.asarray(slot, jnp.int32), jnp.asarray(ctx))
         # the copy writes t_write rows of K+V per layer — bytes, the
         # whole point: no weight stream, no FLOPs
-        self.profiler.add_bytes(
-            "admit_dispatch",
-            t_write * self._kv_bytes_per_t // self.max_slots)
+        copy_bytes = t_write * self._kv_bytes_per_t // self.max_slots
+        self.profiler.add_bytes("admit_dispatch", copy_bytes)
+        self.stats["prefix_copy_bytes"] += copy_bytes
+        request.slot = slot
+        request.prefilling = True
+        request.prefill_pos = request.prefix_hit
+        self._slots[slot] = request
+        self.stats["prefix_admits"] += 1
+        if request.journey is not None:
+            request.journey.prefix_hit_tokens = request.prefix_hit
+            request.journey.admitted(admit_t, slot, "prefix-admit")
+
+    def _prefix_admit_paged(self, slot: int, request: DecodeRequest,
+                            admit_t: float) -> None:
+        """Paged hit admit: alias the chain's pool blocks into the
+        slot's block table.  Cache hits retain one pool ref per block
+        for the slot; direct installs (kv_block_ids) transfer the
+        caller's refs outright.  Zero KV bytes move — only the
+        speculative drafter's context buffer still needs the cached
+        prompt tokens written."""
+        block = self.kv_block
+        count = request.prefix_hit // block
+        if request.kv_block_ids:
+            ids = request.kv_block_ids[:count]
+            request.kv_block_ids = []
+        else:
+            chain = self.prefix_cache.nodes(request.prefix_nodes)
+            ids = [node.pool_id for node in chain[:count]]
+            self.pool.retain(ids)
+        row = self._tables_np[slot]
+        for j, block_id in enumerate(ids):
+            row[j] = block_id
+        self._slot_blocks[slot] = list(ids)
+        self._tables_dirty = True
+        if self.speculate_k:
+            t_write = self._prefix_write_len(request)
+            ctx = np.zeros((t_write,), np.int32)
+            ctx[:request.prefix_hit] = \
+                request.prompt[:request.prefix_hit]
+            from .serving_paged import _paged_ctx_fn_for
+            self._context = _paged_ctx_fn_for(t_write)(
+                self._context, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(ctx))
+            self.profiler.add_bytes("admit_dispatch", t_write * 4)
         request.slot = slot
         request.prefilling = True
         request.prefill_pos = request.prefix_hit
@@ -2234,6 +2809,20 @@ class ContinuousDecoder:
             start += 1
         if start >= count:
             return
+        if self.paged:
+            # zero-copy harvest (ISSUE 15): the slot's own pool blocks
+            # BECOME the cache entries — retain + record key, no row
+            # movement (the dense path's slice-out copy AND the hit's
+            # later copy-in are both gone; the double write was
+            # ROADMAP item 3 residue c)
+            owned = self._slot_blocks[slot]
+            parent = keys[start - 1] if start else ""
+            for j in range(start, min(count, len(owned))):
+                if not cache.insert_block(tenant, parent, keys[j],
+                                          owned[j]):
+                    break    # budget refused: stop, or children dangle
+                parent = keys[j]
+            return
         base, end = start * block, count * block
         layers = self.config.num_layers
         k_splits = [L.split_kv_blocks(
@@ -2242,6 +2831,8 @@ class ContinuousDecoder:
         v_splits = [L.split_kv_blocks(
             L.slice_kv_rows(self._v[i], slot, base, end), block)
             for i in range(layers)]
+        self.stats["harvest_copy_bytes"] += \
+            (end - base) * self._kv_bytes_per_t // self.max_slots
         parent = keys[start - 1] if start else ""
         for j in range(start, count):
             inserted = cache.insert(
@@ -2269,13 +2860,32 @@ class ContinuousDecoder:
             prompts[j, :len(request.prompt)] = request.prompt
             true_lens[j] = len(request.prompt)
             valid[j] = True
-        (firsts, self._k, self._v, self._tokens, self._lengths,
-         self._context) = self._admit_fn(bucket, width)(
-            self.params, self._k, self._v, self._tokens,
-            self._lengths, self._context, jnp.asarray(prompts),
-            jnp.asarray(true_lens),
-            jnp.asarray(slots + pad_slots, jnp.int32),
-            jnp.asarray(valid))
+        if self.paged:
+            # each admitted slot gets fresh pool blocks padded to the
+            # block boundary (dead cells past the prompt, same
+            # invariant as the dense scatter's padding); pad rows stay
+            # all-null and their writes drop inside the program
+            nbb = -(-bucket // self.kv_block)
+            tables_rows = np.zeros((width, nbb), np.int32)
+            for j, slot in enumerate(slots):
+                self._ensure_coverage(slot, nbb * self.kv_block)
+                tables_rows[j] = self._tables_np[slot, :nbb]
+            (firsts, k_pools, v_pools, self._tokens, self._lengths,
+             self._context) = self._admit_fn(bucket, width)(
+                self.params, self.pool.k_pools, self.pool.v_pools,
+                self._tokens, self._lengths, self._context,
+                jnp.asarray(prompts), jnp.asarray(true_lens),
+                jnp.asarray(slots + pad_slots, jnp.int32),
+                jnp.asarray(valid), jnp.asarray(tables_rows))
+            self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
+        else:
+            (firsts, self._k, self._v, self._tokens, self._lengths,
+             self._context) = self._admit_fn(bucket, width)(
+                self.params, self._k, self._v, self._tokens,
+                self._lengths, self._context, jnp.asarray(prompts),
+                jnp.asarray(true_lens),
+                jnp.asarray(slots + pad_slots, jnp.int32),
+                jnp.asarray(valid))
         # NO host sync here: the dispatch is async and queued BEHIND
         # this round's decode scan — fetching `firsts` now would stall
         # the host on prefill.  The request is live (slot assigned)
@@ -2330,6 +2940,12 @@ class ContinuousDecoder:
             if request.prefix_nodes:
                 self.prefix_cache.release(request.prefix_nodes)
                 request.prefix_nodes = []
+        if self.paged:
+            # after the harvest retained what it keeps: drop the
+            # slot's refs — cache-held blocks live on, purely-owned
+            # ones return to the free list (the drain leak audit
+            # asserts this reaches zero live blocks)
+            self._release_slot_blocks(slot)
         self._slots[slot] = None
         self.stats["completed"] += 1
         count = len(request.generated)
@@ -2447,7 +3063,32 @@ class ContinuousDecoder:
             self.stats["occupancy_sum"] += float(active.mean())
             decode_start = time.perf_counter()
             eos = -1 if self.eos_token is None else int(self.eos_token)
-            if self.speculate_k:
+            if self.paged:
+                # every scanned slot's table must own the blocks this
+                # round's merge will write (the common round allocates
+                # only at block-boundary crossings); then one small
+                # int32 transfer refreshes the device tables if dirty
+                tables = self._prepare_round_tables(occupied,
+                                                    num_steps)
+                if self.speculate_k:
+                    (emitted, emit_mask, self._tokens, self._lengths,
+                     self._context, k_pools, v_pools) = self._step(
+                        self.params, self._tokens, self._lengths,
+                        jnp.array(scan_active), jnp.array(budgets),
+                        self._context, self.pool.k_pools,
+                        self.pool.v_pools, tables,
+                        num_steps=num_steps, eos=eos,
+                        t_cap=self._cache_t)
+                else:
+                    (emitted, emitted_active, self._tokens,
+                     self._lengths, k_pools, v_pools) = self._step(
+                        self.params, self._tokens, self._lengths,
+                        jnp.array(scan_active), jnp.array(budgets),
+                        self.pool.k_pools, self.pool.v_pools, tables,
+                        num_steps=num_steps, eos=eos,
+                        t_cap=self._cache_t)
+                self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
+            elif self.speculate_k:
                 (emitted, emit_mask, self._tokens, self._lengths,
                  self._context, self._k, self._v) = self._step(
                     self.params, self._tokens, self._lengths,

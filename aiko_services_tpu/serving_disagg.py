@@ -108,7 +108,8 @@ class PrefillRuntime(Actor):
                  steps_per_sync: int = 1,
                  prefill_chunk: int | None = None,
                  decoder_opts: dict | None = None,
-                 pump_period: float = 0.002, registry=None):
+                 pump_period: float = 0.002,
+                 batch_window: float = 0.0, registry=None):
         super().__init__(runtime, name, PROTOCOL_PREFILL,
                          tags=[role_tag(ROLE_PREFILL)])
         from .serving import ContinuousDecoder, PrefixKVCache
@@ -153,10 +154,22 @@ class PrefillRuntime(Actor):
         self.stats = MirroredStats(
             {"requests": 0, "computed": 0, "blocks_shipped": 0,
              "bytes_shipped": 0, "handle_blocks": 0, "refused": 0,
-             "empty_ships": 0},
+             "empty_ships": 0, "envelopes": 0, "batched_envelopes": 0},
             metric="prefill_runtime_events_total",
             help="prefill-runtime events by kind",
             registry=self._registry, skip=("bytes_shipped",),
+            labels={"runtime": name})
+        # prefill-side transfer batching (ISSUE 15 satellite, PR 14
+        # residue b): finished transfers to the SAME destination within
+        # `batch_window` seconds coalesce into one kv_transfer_batch
+        # envelope — a prompt burst's per-envelope wire cost amortizes.
+        # 0 disables (ship-on-finish, the PR 14 behavior).
+        self.batch_window = max(0.0, float(batch_window))
+        self._ship_queue: dict[str, list] = {}
+        self._ship_timers: dict[str, int] = {}
+        self._batched_counter = self._registry.counter(
+            "disagg_transfer_batched_total",
+            "KV transfers that rode a coalesced batch envelope",
             labels={"runtime": name})
         # the prefill pool's OWN scale signal (ISSUE 14): prompts
         # waiting for KV compute — what the prefill-pool autoscaler
@@ -222,8 +235,13 @@ class PrefillRuntime(Actor):
         nodes = cache.nodes(keys[start_block:hit // block])
         blocks = []
         for node in nodes:
+            # block_rows reads the node's storage home — its own rows
+            # in dense mode, the block POOL in paged mode (ISSUE 15:
+            # harvest left the rows in pool blocks, so shipping is the
+            # first and only host copy they ever pay)
+            k_rows, v_rows = cache.block_rows(node)
             layers = []
-            for k_leaf, v_leaf in zip(node.k_rows, node.v_rows):
+            for k_leaf, v_leaf in zip(k_rows, v_rows):
                 layers.append({"k": _to_host(k_leaf),
                                "v": _to_host(v_leaf)})
             blocks.append(layers)
@@ -236,12 +254,45 @@ class PrefillRuntime(Actor):
         self.stats["blocks_shipped"] += len(blocks)
         self.stats["handle_blocks"] += start_block
         self.stats["bytes_shipped"] += len(payload)
-        # binary envelope: rides the peer channel when the caller's
-        # reply topic is pinned, the broker otherwise — the PR 6
-        # fallback ladder carries the transfer either way
-        self.runtime.publish(reply_topic, payload)
+        self._post(reply_topic, payload)
+
+    def _post(self, reply_topic: str, payload: bytes) -> None:
+        """Ship one finished transfer: immediately, or coalesced with
+        other same-destination transfers inside the batch window
+        (ISSUE 15 satellite).  Either way the envelope rides the peer
+        channel when the caller's reply topic is pinned, the broker
+        otherwise — the PR 6 fallback ladder carries it."""
+        if self.batch_window <= 0:
+            self.stats["envelopes"] += 1
+            self.runtime.publish(reply_topic, payload)
+            return
+        queue = self._ship_queue.setdefault(reply_topic, [])
+        queue.append(payload)
+        if reply_topic not in self._ship_timers:
+            self._ship_timers[reply_topic] = \
+                self.runtime.event.add_oneshot_handler(
+                    lambda: self._flush_ships(reply_topic),
+                    self.batch_window)
+
+    def _flush_ships(self, reply_topic: str) -> None:
+        self._ship_timers.pop(reply_topic, None)
+        payloads = self._ship_queue.pop(reply_topic, None)
+        if not payloads:
+            return
+        self.stats["envelopes"] += 1
+        if len(payloads) == 1:
+            self.runtime.publish(reply_topic, payloads[0])
+            return
+        self.stats["batched_envelopes"] += 1
+        self._batched_counter.inc(len(payloads))
+        self.runtime.publish(reply_topic,
+                             wire.encode_kv_batch(payloads))
 
     def stop(self) -> None:
+        for reply_topic, timer in list(self._ship_timers.items()):
+            self.runtime.event.remove_timer_handler(timer)
+            self._ship_timers.pop(reply_topic, None)
+            self._flush_ships(reply_topic)   # owed transfers ship now
         if self._flatout:
             self.runtime.event.remove_flatout_handler(self.decoder.pump)
         else:
@@ -306,13 +357,22 @@ class PrefillClient:
                  urgent_budget_s: float = 1.0,
                  min_remote_tokens: int | None = None,
                  registry=None):
-        if decoder.prefix_cache is None:
+        if decoder.prefix_cache is None and \
+                not getattr(decoder, "paged", False):
             raise ValueError(
                 "PrefillClient needs a decoder with a bound "
-                "PrefixKVCache (the shipped KV has to land somewhere)")
+                "PrefixKVCache, or a paged decoder (the shipped KV "
+                "has to land somewhere: cache chain or direct "
+                "slot-table install)")
         self.runtime = runtime
         self.decoder = decoder
+        # cache may be None on a paged decoder (ISSUE 15 satellite):
+        # shipped KV then lands via install_shipped_blocks — pool
+        # blocks aliased straight into the request's slot table, no
+        # prefix cache in the loop
         self.cache = decoder.prefix_cache
+        self.block_tokens = self.cache.block_tokens \
+            if self.cache is not None else decoder.kv_block
         self.name = str(name)
         self.logger = get_logger(f"disagg.client.{name}")
         self.transfer_timeout = float(transfer_timeout)
@@ -321,7 +381,7 @@ class PrefillClient:
         # remote would pay a transfer RTT for zero cached tokens
         self.min_remote_tokens = int(min_remote_tokens) \
             if min_remote_tokens is not None \
-            else self.cache.block_tokens
+            else self.block_tokens
         self._registry = registry or default_registry()
         self.router = DeadlineRouter(urgent_budget_s=urgent_budget_s,
                                      name=name,
@@ -340,7 +400,8 @@ class PrefillClient:
              "transfer_corrupt": 0, "layout_mismatch": 0,
              "local_fallbacks": 0, "local_short": 0,
              "local_no_pool": 0, "local_cached": 0,
-             "install_shed": 0},
+             "install_shed": 0, "direct_installs": 0,
+             "batched_replies": 0},
             metric="disagg_client_events_total",
             help="disaggregated serving client events by kind",
             registry=self._registry, skip=("transfer_bytes",),
@@ -422,17 +483,22 @@ class PrefillClient:
             return self._local(request_id, prompt, max_new_tokens,
                                callback, deadline, tenant, on_refused,
                                notify=False)
-        _, have = self.cache.match(tenant_key, prompt)
-        complete = (len(prompt) // self.cache.block_tokens) * \
-            self.cache.block_tokens
-        if complete and have >= complete:
-            # the decode side already holds the ENTIRE chain (session
-            # KV, a repeated prompt): a remote hop would ship zero
-            # bytes — prefix-admit locally, the cached population
-            self.stats["local_cached"] += 1
-            return self._local(request_id, prompt, max_new_tokens,
-                               callback, deadline, tenant, on_refused,
-                               notify=False)
+        have = 0
+        if self.cache is not None:
+            _, have = self.cache.match(tenant_key, prompt)
+            complete = (len(prompt) // self.block_tokens) * \
+                self.block_tokens
+            if complete and have >= complete:
+                # the decode side already holds the ENTIRE chain
+                # (session KV, a repeated prompt): a remote hop would
+                # ship zero bytes — prefix-admit locally, the cached
+                # population.  A cacheless pool holds nothing between
+                # requests, so have stays 0 there and every prompt
+                # ships whole.
+                self.stats["local_cached"] += 1
+                return self._local(request_id, prompt, max_new_tokens,
+                                   callback, deadline, tenant,
+                                   on_refused, notify=False)
         remaining = None
         if deadline is not None:
             remaining = float(deadline) - time.monotonic()
@@ -509,8 +575,10 @@ class PrefillClient:
                                              remaining)
             if retry_target is not None:
                 self.stats["retries"] += 1
-                _, have = self.cache.match(
-                    str(entry["tenant"] or ""), entry["prompt"])
+                have = 0
+                if self.cache is not None:
+                    _, have = self.cache.match(
+                        str(entry["tenant"] or ""), entry["prompt"])
                 self._send(transfer_id, entry, retry_target, have)
                 return
         # rung 2: local prefill — counted, never dropped
@@ -544,10 +612,47 @@ class PrefillClient:
     # -- KV admit (the reply path) -----------------------------------------
     def _on_reply(self, _topic, payload) -> None:
         try:
-            out = wire.decode_kv_transfer(payload)
+            command, params = wire.decode_envelope(payload)
         except wire.WireError as exc:
             # chaos truncation / foreign payload: drop it — the
             # transfer timer retries, then the ladder prefills locally
+            self.stats["transfer_corrupt"] += 1
+            self.logger.warning("disagg %s: corrupt KV transfer "
+                                "dropped: %s", self.name, exc)
+            return
+        if command == wire.KV_BATCH_COMMAND:
+            # coalesced same-destination transfers (ISSUE 15
+            # satellite): unwrap and run each member through the SAME
+            # validation + install path as a lone envelope — a corrupt
+            # member fails alone, its siblings still land
+            try:
+                members = wire.kv_batch_members(command, params)
+            except wire.WireError as exc:
+                self.stats["transfer_corrupt"] += 1
+                self.logger.warning(
+                    "disagg %s: corrupt KV transfer batch dropped: %s",
+                    self.name, exc)
+                return
+            self.stats["batched_replies"] += 1
+            for member in members:
+                try:
+                    inner_command, inner_params = \
+                        wire.decode_envelope(member)
+                except wire.WireError as exc:
+                    self.stats["transfer_corrupt"] += 1
+                    self.logger.warning(
+                        "disagg %s: corrupt batch member dropped: %s",
+                        self.name, exc)
+                    continue
+                self._handle_transfer(member, inner_command,
+                                      inner_params)
+            return
+        self._handle_transfer(payload, command, params)
+
+    def _handle_transfer(self, payload, command, params) -> None:
+        try:
+            out = wire.validate_kv_transfer_params(command, params)
+        except wire.WireError as exc:
             self.stats["transfer_corrupt"] += 1
             self.logger.warning("disagg %s: corrupt KV transfer "
                                 "dropped: %s", self.name, exc)
@@ -565,26 +670,50 @@ class PrefillClient:
         # audited: deque(maxlen=4096)  # graft: disable=lint-unbounded-queue
         self.transfer_samples.append(elapsed)
         tenant_key = str(entry["tenant"] or "")
-        if out["blocks"] and not self.cache.layout_compatible(
-                out["layout"]):
+        local_layout = self.cache.wire_layout() \
+            if self.cache is not None else self.decoder.kv_wire_layout()
+        if out["blocks"] and \
+                tuple(str(f) for f in out["layout"]) != local_layout:
             self.stats["layout_mismatch"] += 1
             self.stats["local_fallbacks"] += 1
             self.logger.warning(
                 "disagg %s: transfer %s layout %r does not match the "
                 "decode cache %r; prefilling locally", self.name,
-                out["transfer_id"], out["layout"],
-                self.cache.wire_layout())
+                out["transfer_id"], out["layout"], local_layout)
             self._local(entry["request_id"], entry["prompt"],
                         entry["max_new"], entry["callback"],
                         entry["deadline"], entry["tenant"],
                         entry["on_refused"])
             return
-        blocks = [{"k": [_copy_host(layer["k"]) for layer in block],
-                   "v": [_copy_host(layer["v"]) for layer in block]}
-                  for block in out["blocks"]]
+        if self.cache is not None and not self.cache.paged:
+            # dense cache: owned host copies (per-leaf device_puts on
+            # the event loop stalled decode rounds — PR 14 finding);
+            # the admit-time concat ships one transfer per layer
+            blocks = [{"k": [_copy_host(layer["k"]) for layer in block],
+                       "v": [_copy_host(layer["v"]) for layer in block]}
+                      for block in out["blocks"]]
+        else:
+            # paged landings (ISSUE 15) write the wire views straight
+            # into pool blocks — ONE device scatter per layer, no host
+            # copy in between: the transferred bytes land exactly once
+            blocks = [{"k": [layer["k"] for layer in block],
+                       "v": [layer["v"] for layer in block]}
+                      for block in out["blocks"]]
+        direct_ids: list = []
         try:
-            installed = self.cache.install_chain(
-                tenant_key, out["tokens"], out["start_block"], blocks)
+            if self.cache is not None:
+                installed = self.cache.install_chain(
+                    tenant_key, out["tokens"], out["start_block"],
+                    blocks)
+            else:
+                # direct slot-table install (ISSUE 15 satellite): the
+                # cacheless decode pool lands the chain in pool blocks
+                # and hands the ids to submit() for slot aliasing
+                covered, direct_ids = \
+                    self.decoder.install_shipped_blocks(
+                        out["tokens"], out["start_block"], blocks)
+                installed = len(direct_ids)
+                self.stats["direct_installs"] += 1
         except (ValueError, TypeError, IndexError) as exc:
             # schema-legal but geometry-wrong blocks (wrong layer
             # count / head extents) are refused BEFORE any row lands —
@@ -616,19 +745,32 @@ class PrefillClient:
                              "handle_blocks": out["start_block"],
                              "installed": installed})
         # the decode-side submit: the prefix probe longest-matches the
-        # just-installed chain, prefix-admit copies it into the slot,
-        # and only the ragged suffix prefills here.  Label "remote" so
+        # just-installed chain (paged: ALIASES its pool blocks —
+        # zero-copy), and only the ragged suffix prefills here.  A
+        # cacheless pool instead hands the installed ids to the
+        # request for direct slot-table aliasing.  Label "remote" so
         # TTFT sketches and journeys carry the population (ISSUE 14).
         with tracing.activate(entry.get("trace")):
-            self._submit_installed(entry)
+            if self.cache is None:
+                covered = installed * self.decoder.kv_block
+                self._submit_installed(entry,
+                                       kv_blocks=(covered, direct_ids))
+            else:
+                self._submit_installed(entry)
 
-    def _submit_installed(self, entry: dict) -> None:
+    def _submit_installed(self, entry: dict,
+                          kv_blocks: tuple | None = None) -> None:
         accepted = self.decoder.submit(
             entry["request_id"], entry["prompt"], entry["max_new"],
             entry["callback"], deadline=entry["deadline"],
-            tenant=entry["tenant"], prefill_label="remote")
+            tenant=entry["tenant"], prefill_label="remote",
+            kv_blocks=kv_blocks)
         if not accepted:
             self.stats["install_shed"] += 1
+            if kv_blocks is not None and kv_blocks[1]:
+                # ownership never transferred: the shed request must
+                # not leak its pre-installed pool blocks
+                self.decoder.pool.release_blocks(kv_blocks[1])
             if entry["on_refused"] is not None:
                 entry["on_refused"](entry["request_id"])
 
@@ -712,7 +854,8 @@ class DisaggHarness:
                  prefill_buckets=(64,), prefill_chunk: int | None = None,
                  cache_mb: int = 512, decoder_opts: dict | None = None,
                  fault_plan=None, transfer_timeout: float = 5.0,
-                 retries: int = 1, registry=None):
+                 retries: int = 1, batch_window: float = 0.0,
+                 registry=None):
         from .event import EventEngine
         from .registrar import Registrar
         from .serving import ContinuousDecoder, PrefixKVCache
@@ -774,7 +917,8 @@ class DisaggHarness:
                 max_slots=int(prefill_slots),
                 prefill_buckets=tuple(prefill_buckets),
                 prefill_chunk=prefill_chunk, decoder_opts=opts,
-                pump_period=0, registry=self._registry)
+                pump_period=0, batch_window=batch_window,
+                registry=self._registry)
             cache = ServicesCache(self.decode_rt)
             self.client = PrefillClient(
                 self.decode_rt, self.decoder, services_cache=cache,

@@ -1,0 +1,706 @@
+# Paged KV block pool for continuous-batching serving (ISSUE 15,
+# ROADMAP item 3 residue c).
+#
+# The dense slot cache ([S, H, T, D] per layer) made three subsystems
+# move KV by COPY: a prefix-cache hit copied the cached chain's rows
+# into the slot, harvest copied them back out at retire, and the
+# disaggregated install paid the same copy on top of the wire transfer.
+# vLLM's PagedAttention (Kwon et al., SOSP 2023) is the fix: ONE pool
+# of fixed-size token blocks per layer plus per-slot int32 block
+# tables, so "this slot holds that prefix" is a table edit over
+# refcounted blocks, not a row movement —
+#
+#   * a prefix hit ALIASES the cached chain's pool blocks into the
+#     slot's table (retain refs; zero bytes move);
+#   * harvest is "retain + record key" — the slot's own blocks BECOME
+#     the cache entries (the double write is gone);
+#   * the disaggregated install (DistServe, OSDI 2024) writes shipped
+#     blocks straight into pool blocks once — later admits are table
+#     edits;
+#   * copy-on-extend: writing into a SHARED block (refs > 1 — e.g. the
+#     near-seq-cap final-chunk slide-back into a cached region) first
+#     copies it to a fresh block, so aliased readers never see a
+#     mutation.  At most one partial block copies per such write; the
+#     common hit path copies nothing.
+#
+# Device-side discipline: the compiled step GATHERS a slot-major
+# [S, H, T, D] view from the pool once per round (the main cache is
+# read-only through the scan, so the gather hoists out of it), slices
+# it to the dense path's exact time extent, and runs the SAME attention
+# bodies (_slot_attention_block / _slot_attention_spec) — the gathered
+# view is value- and shape-identical to the dense slot cache, so paged
+# greedy output is BIT-IDENTICAL to dense by construction.  Round-end
+# side-buffer merges scatter to (block, offset) pairs computed from the
+# tables, with out-of-range ids dropping exactly like the dense path's
+# _POS_INVALID entries.  This module owns the pool allocator and the
+# paged compiled-program builders; serving.ContinuousDecoder(
+# paged_kv=True) is the integration point and keeps the dense path as
+# the A/B (AIKO_BENCH_LLAMA_PAGED=off).
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import layers as L
+from .models.llama import LlamaConfig, llama_ffn
+from .utils import get_logger
+
+__all__ = ["BlockPool"]
+
+
+class BlockPool:
+    """Device-resident paged KV block pool + host-side refcounting
+    allocator.
+
+    One pool id addresses one `block_tokens`-token block ACROSS the
+    whole model: k_pools[i][id] / v_pools[i][id] are layer i's K/V rows
+    for that block ([H, B, D] native, or the int8 serving form
+    {"q" i8 [H, B, D], "s" f32 [H, B]}).  Block 0 is the reserved NULL
+    block (all zeros, never allocated): unfilled table entries point at
+    it, so gathers stay in bounds and read only masked positions.
+
+    Refcounts count LOGICAL OWNERS — slot tables, prefix-cache nodes,
+    in-flight installs.  alloc_blocks() hands out refs=1 ids (growing
+    the device arrays in `grow_blocks` steps when the free list runs
+    dry — the paged sibling of _fit_caches' grow); retain()/
+    release_blocks() move ownership; refs hitting zero returns the id
+    to the free list with its contents left in place (stale rows are
+    only ever gathered at masked positions until the next owner
+    overwrites them, the same dead-cell invariant as the dense cache).
+
+    Single-threaded like the decoder that owns it (pump runs on the
+    event engine)."""
+
+    def __init__(self, config: LlamaConfig, block_tokens: int,
+                 kv_int8: bool, initial_blocks: int = 64,
+                 grow_blocks: int = 64, name: str = "pool",
+                 registry=None):
+        self.config = config
+        self.block_tokens = int(block_tokens)
+        if self.block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}")
+        self.kv_int8 = bool(kv_int8)
+        self.name = str(name)
+        self.grow_blocks = max(1, int(grow_blocks))
+        self.logger = get_logger(f"serving.pool.{name}")
+        n = max(2, int(initial_blocks) + 1)          # +1: null block
+        self.num_blocks = n
+        self.k_pools = self._zero_pools(n)
+        self.v_pools = self._zero_pools(n)
+        self._refs = np.zeros((n,), np.int32)
+        self._free = list(range(n - 1, 0, -1))       # 0 reserved
+        itemsize = jnp.dtype(config.dtype).itemsize
+        per_position = (config.head_dim + 4) if self.kv_int8 \
+            else config.head_dim * itemsize
+        # K + V, all layers, one block's tokens — the budget currency
+        self.block_nbytes = (2 * config.num_layers *
+                             config.num_kv_heads * per_position *
+                             self.block_tokens)
+        from .observe.metrics import MirroredStats, default_registry
+        self._registry = registry or default_registry()
+        self.stats = MirroredStats(
+            {"allocs": 0, "frees": 0, "grows": 0, "cow_copies": 0,
+             "cow_copy_bytes": 0, "install_blocks": 0,
+             "install_bytes": 0},
+            metric="kv_pool_events_total",
+            help="paged KV block-pool events by kind",
+            registry=self._registry,
+            skip=("cow_copy_bytes", "install_bytes"),
+            labels={"pool": self.name})
+        self._gauge_total = self._registry.gauge(
+            "kv_pool_blocks", "paged KV pool capacity in blocks",
+            labels={"pool": self.name})
+        self._gauge_used = self._registry.gauge(
+            "kv_pool_blocks_used",
+            "paged KV pool blocks with at least one owner",
+            labels={"pool": self.name})
+        self._used = 0
+        self._publish_gauges()
+
+    # -- device arrays -----------------------------------------------------
+    def _zero_pools(self, n: int) -> list:
+        config = self.config
+        shape = (n, config.num_kv_heads, self.block_tokens,
+                 config.head_dim)
+        if self.kv_int8:
+            return [{"q": jnp.zeros(shape, jnp.int8),
+                     "s": jnp.zeros(shape[:3], jnp.float32)}
+                    for _ in range(config.num_layers)]
+        return [jnp.zeros(shape, config.dtype)
+                for _ in range(config.num_layers)]
+
+    def nbytes(self) -> int:
+        """Bytes currently allocated to the pool device arrays — what
+        ContinuousDecoder.kv_cache_bytes() reports in paged mode."""
+        return int(sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for pools in (self.k_pools, self.v_pools)
+            for pool in pools
+            for leaf in jax.tree_util.tree_leaves(pool)))
+
+    def _grow(self, need: int) -> None:
+        # GEOMETRIC growth (at least doubling): every distinct pool
+        # capacity is a fresh shape for every compiled program that
+        # touches it, so linear growth would retrace the whole
+        # step/admit/extend family once per increment — measured as a
+        # 10x cold-TTFT inflation on the conversation rung.  Doubling
+        # bounds the retrace count to O(log blocks), the same
+        # discipline as _fit_caches' t_block quantization.
+        extra = -(-max(need, 1) // self.grow_blocks) * self.grow_blocks
+        extra = max(extra, self.num_blocks - 1)
+        old_n, new_n = self.num_blocks, self.num_blocks + extra
+        grow = _pool_grow_fn(old_n, new_n)
+        self.k_pools = grow(self.k_pools)
+        self.v_pools = grow(self.v_pools)
+        self._free.extend(range(new_n - 1, old_n - 1, -1))
+        self._refs = np.concatenate(
+            [self._refs, np.zeros((extra,), np.int32)])
+        self.num_blocks = new_n
+        self.stats["grows"] += 1
+        self._publish_gauges()
+
+    def reserve(self, capacity: int) -> None:
+        """Grow the pool to at least `capacity` allocatable blocks NOW
+        (no allocation).  Every distinct pool capacity is a fresh
+        shape for the compiled programs, so callers that can predict
+        steady-state residency (slot coverage + prefix-cache budget)
+        reserve it up front and keep growth retraces out of the
+        serving window."""
+        short = int(capacity) - (self.num_blocks - 1)
+        if short > 0:
+            self._grow(short)
+
+    # -- allocator ---------------------------------------------------------
+    def alloc_blocks(self, count: int) -> list:
+        """`count` fresh block ids, each with refs=1 owned by the
+        caller.  Grows the device pools when the free list runs dry."""
+        count = int(count)
+        if count <= 0:
+            return []
+        if len(self._free) < count:
+            self._grow(count - len(self._free))
+        ids = [self._free.pop() for _ in range(count)]
+        for block_id in ids:
+            self._refs[block_id] = 1
+        self._used += count
+        self.stats["allocs"] += count
+        self._publish_gauges()
+        return ids
+
+    def retain(self, ids) -> None:
+        for block_id in ids:
+            if not 0 < block_id < self.num_blocks or \
+                    self._refs[block_id] <= 0:
+                raise ValueError(
+                    f"pool {self.name!r}: retain of dead block "
+                    f"{block_id}")
+            self._refs[block_id] += 1
+
+    def release_blocks(self, ids) -> None:
+        """Drop one ref per id; refs hitting zero return the id to the
+        free list (contents stay — dead cells until reallocated)."""
+        freed = 0
+        for block_id in ids:
+            if not 0 < block_id < self.num_blocks:
+                continue
+            refs = self._refs[block_id]
+            if refs <= 0:
+                raise ValueError(
+                    f"pool {self.name!r}: release of free block "
+                    f"{block_id}")
+            self._refs[block_id] = refs - 1
+            if refs == 1:
+                self._free.append(block_id)
+                freed += 1
+        if freed:
+            self._used -= freed
+            self.stats["frees"] += freed
+            self._publish_gauges()
+
+    def refs(self, block_id: int) -> int:
+        return int(self._refs[block_id])
+
+    def used_blocks(self) -> int:
+        """Blocks with at least one live owner (null block excluded).
+        The refs scan stays the AUDIT surface (drain/leak tests);
+        the hot path publishes the incremental `_used` twin, which
+        alloc (every 0->1) and release (every 1->0) keep exact."""
+        return int((self._refs[1:] > 0).sum())
+
+    def occupancy(self) -> float:
+        capacity = self.num_blocks - 1
+        return self.used_blocks() / capacity if capacity else 0.0
+
+    def _publish_gauges(self) -> None:
+        # alloc/release land here once per pump-path transition: an
+        # O(num_blocks) used_blocks() scan per one-block allocation
+        # would grow per-round host work with pool capacity
+        self._gauge_total.set(self.num_blocks - 1)
+        self._gauge_used.set(self._used)
+
+    # -- block content movement --------------------------------------------
+    def copy_blocks(self, src_ids, dst_ids) -> int:
+        """Device-copy block contents src -> dst (copy-on-extend): one
+        batched program per call.  Returns the bytes copied — the
+        number the paged A/B is meant to shrink to at most one partial
+        block per shared write."""
+        if not src_ids:
+            return 0
+        src = jnp.asarray(list(src_ids), jnp.int32)
+        dst = jnp.asarray(list(dst_ids), jnp.int32)
+        copy = _copy_blocks_fn(self.config, self.kv_int8)
+        self.k_pools = copy(self.k_pools, src, dst)
+        self.v_pools = copy(self.v_pools, src, dst)
+        copied = len(src_ids) * self.block_nbytes
+        self.stats["cow_copies"] += len(src_ids)
+        self.stats["cow_copy_bytes"] += copied
+        return copied
+
+    def write_blocks(self, ids, k_layers, v_layers) -> None:
+        """Install host block rows directly into pool blocks (the
+        disaggregated KV landing, ISSUE 15): `k_layers`/`v_layers` are
+        per-layer stacks covering len(ids) blocks —
+        [M, H, B, D] arrays or {"q" [M, H, B, D], "s" [M, H, B]} dicts
+        — written as ONE scatter per layer, so a shipped chain costs
+        one device transfer per layer instead of one per leaf."""
+        if not ids:
+            return
+        dst = jnp.asarray(list(ids), jnp.int32)
+        write = _write_blocks_fn(self.config, self.kv_int8)
+        as_device = _as_device_rows
+        self.k_pools = write(self.k_pools, dst,
+                             [as_device(rows) for rows in k_layers])
+        self.v_pools = write(self.v_pools, dst,
+                             [as_device(rows) for rows in v_layers])
+        self.stats["install_blocks"] += len(ids)
+        self.stats["install_bytes"] += len(ids) * self.block_nbytes
+
+    def block_rows(self, block_id: int) -> tuple:
+        """(per-layer K leaves, per-layer V leaves) for one block —
+        device-side slice views in the pool's storage layout (the read
+        behind shipping a pool-resident cache block over the wire)."""
+        return ([L.slice_paged_block(pool, block_id)
+                 for pool in self.k_pools],
+                [L.slice_paged_block(pool, block_id)
+                 for pool in self.v_pools])
+
+
+def _as_device_rows(rows):
+    if isinstance(rows, dict):
+        return {"q": jnp.asarray(rows["q"]),
+                "s": jnp.asarray(rows["s"])}
+    return jnp.asarray(rows)
+
+
+@functools.lru_cache(maxsize=32)
+def _pool_grow_fn(old_n: int, new_n: int):
+    pad = new_n - old_n
+
+    def grow_leaf(leaf):
+        spec = [(0, 0)] * leaf.ndim
+        spec[0] = (0, pad)
+        return jnp.pad(leaf, spec)
+
+    def grow(pools):
+        return [jax.tree.map(grow_leaf, pool) for pool in pools]
+
+    return jax.jit(grow, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=8)
+def _copy_blocks_fn(config: LlamaConfig, kv_int8: bool):
+    def copy(pools, src, dst):
+        def copy_leaf(leaf):
+            return leaf.at[dst].set(jnp.take(leaf, src, axis=0),
+                                    mode="drop")
+        return [jax.tree.map(copy_leaf, pool) for pool in pools]
+
+    return jax.jit(copy, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=8)
+def _write_blocks_fn(config: LlamaConfig, kv_int8: bool):
+    def write(pools, dst, rows):
+        out = []
+        for pool, layer_rows in zip(pools, rows):
+            if isinstance(pool, dict):
+                out.append({
+                    "q": pool["q"].at[dst].set(layer_rows["q"],
+                                               mode="drop"),
+                    "s": pool["s"].at[dst].set(layer_rows["s"],
+                                               mode="drop")})
+            else:
+                out.append(pool.at[dst].set(layer_rows, mode="drop"))
+        return out
+
+    return jax.jit(write, donate_argnums=(0,))
+
+
+# -- compiled paged programs --------------------------------------------------
+
+def _slice_time(cache, t_cap: int):
+    """Slice a gathered slot-major view to the dense path's exact time
+    extent — shape-identical programs are how paged stays bit-identical
+    to dense (an extra masked tail could re-pair the f32 reductions)."""
+    if isinstance(cache, dict):
+        return {"q": cache["q"][:, :, :t_cap],
+                "s": cache["s"][:, :, :t_cap]}
+    return cache[:, :, :t_cap]
+
+
+def _gather_views(pools, tables, t_cap: int) -> list:
+    return [_slice_time(L.gather_paged_kv(pool, tables), t_cap)
+            for pool in pools]
+
+
+def _paged_scatter(pools, tables, positions, live, sides, kv_int8,
+                   block_tokens: int):
+    """Scatter side-buffer rows into pool blocks at absolute
+    `positions` ([S, W]; rows where `live` is False drop).  int8 pools
+    quantize the side rows ONCE here, mirroring the dense merge."""
+    nb = tables.shape[1]
+    num_total = jax.tree_util.tree_leaves(pools[0])[0].shape[0]
+    blocks = positions // block_tokens
+    offsets = positions % block_tokens
+    dest = jnp.take_along_axis(tables, jnp.clip(blocks, 0, nb - 1),
+                               axis=1)
+    dest = jnp.where(live & (blocks >= 0) & (blocks < nb), dest,
+                     num_total)
+    out = []
+    for pool, side in zip(pools, sides):
+        rows = L.quantize_kv_cache(side) if kv_int8 else side
+        out.append(L.scatter_paged_rows(pool, dest, offsets, rows))
+    return out
+
+
+def _build_paged_step(config: LlamaConfig):
+    """Paged sibling of serving._build_step's block-KV variant: gather
+    the slot-major KV views from the pool (once — the main cache is
+    read-only through the scan), run the IDENTICAL scan body
+    (_slot_attention_block owns the numerics), and merge the round's
+    side buffers back by (block, offset) scatter.  t_cap is static and
+    equals the dense path's cache time extent, so every einsum shape
+    matches the dense program exactly."""
+    from .serving import _slot_attention_block, _token_block_argmax
+    cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
+                                  config.rope_theta)
+
+    def step(params, tokens, lengths, active, budgets, k_pools,
+             v_pools, tables, num_steps, eos, t_cap):
+        block_tokens = \
+            jax.tree_util.tree_leaves(k_pools[0])[0].shape[2]
+        k_caches = _gather_views(k_pools, tables, t_cap)
+        v_caches = _gather_views(v_pools, tables, t_cap)
+        entry_lengths = lengths
+        entry_active = active
+        slots_n = tokens.shape[0]
+        side_shape = (slots_n, config.num_kv_heads, num_steps,
+                      config.head_dim)
+        k_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        v_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+
+        def body(carry, step_index):
+            tokens, lengths, active, budgets, k_sides, v_sides = carry
+            new_k, new_v = [], []
+
+            def attend(i, layer, normed):
+                attn_out, k_s, v_s = _slot_attention_block(
+                    layer, config, normed, cos, sin, k_caches[i],
+                    v_caches[i], k_sides[i], v_sides[i],
+                    entry_lengths, lengths, step_index)
+                new_k.append(k_s)
+                new_v.append(v_s)
+                return attn_out
+
+            next_tokens = _token_block_argmax(
+                params, config, tokens[:, None], attend)[:, 0]
+            next_tokens = jnp.where(active, next_tokens, tokens)
+            lengths = jnp.where(active, lengths + 1, lengths)
+            budgets = jnp.where(active, budgets - 1, budgets)
+            still = active & (budgets > 0) & (next_tokens != eos)
+            return ((next_tokens, lengths, still, budgets, new_k,
+                     new_v), (next_tokens, active))
+
+        (tokens, lengths, active, budgets, k_sides, v_sides), \
+            (emitted, emitted_active) = jax.lax.scan(
+                body, (tokens, lengths, active, budgets, k_sides,
+                       v_sides), jnp.arange(num_steps))
+
+        # merge: each slot's side rows land at their absolute positions
+        # [entry_length, entry_length + num_steps) — rows past a slot's
+        # actual take are dead cells in blocks it owns, same invariant
+        # as the dense merge's garbage rows.  Slots inactive at round
+        # entry drop entirely (their stale lengths point into prompt
+        # regions their extends are writing).
+        positions = entry_lengths[:, None] + jnp.arange(num_steps)[None]
+        live = entry_active[:, None]
+        k_pools = _paged_scatter(k_pools, tables, positions, live,
+                                 k_sides, isinstance(k_pools[0], dict),
+                                 block_tokens)
+        v_pools = _paged_scatter(v_pools, tables, positions, live,
+                                 v_sides, isinstance(v_pools[0], dict),
+                                 block_tokens)
+        return (emitted, emitted_active, tokens, lengths,
+                k_pools, v_pools)
+
+    return jax.jit(step, static_argnames=("num_steps", "eos", "t_cap"),
+                   donate_argnames=("k_pools", "v_pools"))
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_step_for(config: LlamaConfig):
+    """Process-wide builder cache, like serving._step_for."""
+    return _build_paged_step(config)
+
+
+def _build_paged_spec_step(config: LlamaConfig, k_spec: int,
+                           ngram: int):
+    """Paged sibling of serving._build_spec_step: the drafting /
+    widened verify / acceptance scan body is the SAME object
+    (serving._spec_scan_body — shared like _slot_attention_spec and
+    _token_block_argmax so the numerics cannot drift) over gathered
+    pool views; the round's consumed side entries scatter-merge to
+    (block, offset) pairs, rejected drafts dropping via their
+    _POS_INVALID positions exactly as the dense merge drops them."""
+    from .serving import _POS_INVALID, _spec_scan_body
+    cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
+                                  config.rope_theta)
+    width = k_spec + 1
+
+    def spec_step(params, tokens, lengths, active, budgets, context,
+                  k_pools, v_pools, tables, num_steps, eos, t_cap):
+        block_tokens = \
+            jax.tree_util.tree_leaves(k_pools[0])[0].shape[2]
+        k_caches = _gather_views(k_pools, tables, t_cap)
+        v_caches = _gather_views(v_pools, tables, t_cap)
+        entry_lengths = lengths
+        slots_n = tokens.shape[0]
+        side_len = num_steps * width
+        side_shape = (slots_n, config.num_kv_heads, side_len,
+                      config.head_dim)
+        k_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        v_sides = [jnp.zeros(side_shape, config.dtype)
+                   for _ in range(config.num_layers)]
+        pos_side = jnp.full((slots_n, side_len), _POS_INVALID,
+                            jnp.int32)
+        body = _spec_scan_body(config, cos, sin, k_spec, ngram,
+                               params, eos, k_caches, v_caches,
+                               entry_lengths)
+
+        (tokens, lengths, active, budgets, context, k_sides, v_sides,
+         pos_side), (emitted, emit_mask) = jax.lax.scan(
+            body, (tokens, lengths, active, budgets, context, k_sides,
+                   v_sides, pos_side), jnp.arange(num_steps))
+
+        live = pos_side < _POS_INVALID
+        k_pools = _paged_scatter(k_pools, tables, pos_side, live,
+                                 k_sides, isinstance(k_pools[0], dict),
+                                 block_tokens)
+        v_pools = _paged_scatter(v_pools, tables, pos_side, live,
+                                 v_sides, isinstance(v_pools[0], dict),
+                                 block_tokens)
+        return (emitted, emit_mask, tokens, lengths, context,
+                k_pools, v_pools)
+
+    return jax.jit(spec_step,
+                   static_argnames=("num_steps", "eos", "t_cap"),
+                   donate_argnames=("context", "k_pools", "v_pools"))
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_spec_step_for(config: LlamaConfig, k_spec: int, ngram: int):
+    return _build_paged_spec_step(config, k_spec, ngram)
+
+
+@functools.lru_cache(maxsize=64)
+def _paged_admit_fn_for(config: LlamaConfig, bucket: int, width: int,
+                        kv_int8: bool, speculative: bool):
+    """Paged sibling of serving._admit_fn_for: the SAME stacked prefill
+    compute, but the K/V prefixes scatter into pool blocks named by
+    each row's table slice instead of dense slot rows.  Positions past
+    a prompt's bucket pad to the block boundary as dead cells in blocks
+    the slot owns; invalid (pad) rows carry out-of-range ids and
+    drop."""
+    from .models.llama import init_llama_caches, llama_hidden
+
+    def admit(params, k_pools, v_pools, tokens, lengths, context,
+              prompts, true_lens, slots, valid, tables_rows):
+        block_tokens = \
+            jax.tree_util.tree_leaves(k_pools[0])[0].shape[2]
+        num_total = \
+            jax.tree_util.tree_leaves(k_pools[0])[0].shape[0]
+        caches = init_llama_caches(config, width, bucket)
+        hidden, caches = llama_hidden(params, config, prompts, caches)
+        idx = jnp.maximum(true_lens - 1, 0)
+        last_hidden = jnp.take_along_axis(
+            hidden, idx[:, None, None], axis=1)[:, 0]
+        last = L.linear_logits(params["lm_head"], last_hidden)
+        firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        nbb = tables_rows.shape[1]
+        padded_t = nbb * block_tokens
+        dest = jnp.where(valid[:, None], tables_rows, num_total)
+        pad = padded_t - bucket
+        for i, cache in enumerate(caches):
+            k_rows, v_rows = cache["k"], cache["v"]
+            if pad:
+                spec = [(0, 0), (0, 0), (0, pad), (0, 0)]
+                k_rows = jnp.pad(k_rows, spec)
+                v_rows = jnp.pad(v_rows, spec)
+            if kv_int8:
+                k_rows = L.quantize_kv_cache(k_rows)
+                v_rows = L.quantize_kv_cache(v_rows)
+            k_pools[i] = L.write_paged_blocks(k_pools[i], dest, k_rows)
+            v_pools[i] = L.write_paged_blocks(v_pools[i], dest, v_rows)
+        tokens = tokens.at[slots].set(
+            jnp.where(valid, firsts, tokens[slots]))
+        lengths = lengths.at[slots].set(
+            jnp.where(valid, true_lens, lengths[slots]))
+        if speculative:
+            context = context.at[slots, :bucket].set(
+                jnp.where(valid[:, None], prompts,
+                          context[slots][:, :bucket]))
+        return firsts, k_pools, v_pools, tokens, lengths, context
+
+    return jax.jit(
+        admit, donate_argnames=("k_pools", "v_pools", "tokens",
+                                "lengths", "context"))
+
+
+@functools.lru_cache(maxsize=64)
+def _paged_extend_fn_for(config: LlamaConfig, chunk_len: int,
+                         width: int, kv_int8: bool, speculative: bool):
+    """Paged sibling of serving._extend_fn_for: the prefix reads come
+    from a gathered pool view (sliced to the dense t_cap so the
+    attention shapes — and therefore the greedy numerics — match the
+    dense program exactly), and only the chunk's positions scatter
+    back.  int8 prefixes dequantize for the attention read and the
+    chunk stores quantized, exactly like dense — untouched positions
+    are never re-rounded because they are never rewritten at all."""
+    cos, sin = L.rope_frequencies(config.head_dim,
+                                  config.max_seq_len,
+                                  config.rope_theta)
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    group = num_heads // num_kv
+
+    def extend(params, k_pools, v_pools, tokens, lengths, context,
+               chunk_tokens, offsets, slots, valid, finish,
+               final_idx, tables_rows, t_cap):
+        block_tokens = \
+            jax.tree_util.tree_leaves(k_pools[0])[0].shape[2]
+        num_total = \
+            jax.tree_util.tree_leaves(k_pools[0])[0].shape[0]
+        x = L.embedding(params["embed"],
+                        chunk_tokens).astype(config.dtype)
+        q_pos = offsets[:, None] + jnp.arange(chunk_len)[None, :]
+        mask = (jnp.arange(t_cap)[None, None, :] <=
+                q_pos[:, :, None])[:, None, None]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(config.head_dim,
+                                           jnp.float32))
+        nbt = tables_rows.shape[1]
+        blocks = q_pos // block_tokens
+        block_offsets = q_pos % block_tokens
+        dest = jnp.take_along_axis(tables_rows,
+                                   jnp.clip(blocks, 0, nbt - 1),
+                                   axis=1)
+        dest = jnp.where(valid[:, None] & (blocks < nbt), dest,
+                         num_total)
+
+        def write_rows(rows, chunk_kv, offs):
+            return jax.vmap(
+                lambda row, kv, off: jax.lax.dynamic_update_slice(
+                    row, kv, (0, off, 0)))(rows, chunk_kv, offs)
+
+        for i, layer in enumerate(params["layers"]):
+            normed = L.rms_norm(layer["ln_attn"], x)
+            q = L._split_heads(L.linear(layer["attn"]["q"], normed),
+                               num_heads)
+            k = L._split_heads(L.linear(layer["attn"]["k"], normed),
+                               num_kv)
+            v = L._split_heads(L.linear(layer["attn"]["v"], normed),
+                               num_kv)
+            q = L.apply_rope(q, cos, sin, offsets)
+            k = L.apply_rope(k, cos, sin, offsets)
+            gathered_k = _slice_time(
+                L.gather_paged_kv(k_pools[i], tables_rows), t_cap)
+            gathered_v = _slice_time(
+                L.gather_paged_kv(v_pools[i], tables_rows), t_cap)
+            if kv_int8:
+                k_rows = write_rows(
+                    L.dequantize_kv_cache(gathered_k, x.dtype), k,
+                    offsets)
+                v_rows = write_rows(
+                    L.dequantize_kv_cache(gathered_v, x.dtype), v,
+                    offsets)
+            else:
+                k_rows = write_rows(gathered_k, k, offsets)
+                v_rows = write_rows(gathered_v, v, offsets)
+            q_grouped = q.reshape(q.shape[0], num_kv, group,
+                                  chunk_len, config.head_dim)
+            scores = jnp.einsum(
+                "akgcd,aktd->akgct", q_grouped, k_rows,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask, scores, -1e30)
+            weights = jax.nn.softmax(
+                scores, axis=-1).astype(v_rows.dtype)
+            out = jnp.einsum("akgct,aktd->akgcd", weights, v_rows,
+                             preferred_element_type=jnp.float32)
+            out = out.reshape(out.shape[0], num_heads, chunk_len,
+                              config.head_dim).astype(x.dtype)
+            x = x + L.linear(layer["attn"]["o"], L._merge_heads(out))
+            x = x + llama_ffn(layer, config,
+                              L.rms_norm(layer["ln_mlp"], x))
+            if kv_int8:
+                k_store = L.quantize_kv_cache(k)
+                v_store = L.quantize_kv_cache(v)
+            else:
+                k_store, v_store = k, v
+            k_pools[i] = L.scatter_paged_rows(k_pools[i], dest,
+                                              block_offsets, k_store)
+            v_pools[i] = L.scatter_paged_rows(v_pools[i], dest,
+                                              block_offsets, v_store)
+        x = L.rms_norm(params["ln_out"], x)
+        last_hidden = jnp.take_along_axis(
+            x, final_idx[:, None, None], axis=1)[:, 0]
+        last = L.linear_logits(params["lm_head"], last_hidden)
+        firsts = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        apply = valid & finish
+        tokens = tokens.at[slots].set(
+            jnp.where(apply, firsts, tokens[slots]))
+        lengths = lengths.at[slots].set(
+            jnp.where(apply, offsets + final_idx + 1,
+                      lengths[slots]))
+        if speculative:
+            ctx_rows = context[slots]
+            written = jax.vmap(
+                lambda row, blk, off: jax.lax.dynamic_update_slice(
+                    row, blk, (off,)))(ctx_rows, chunk_tokens,
+                                       offsets)
+            context = context.at[slots].set(
+                jnp.where(valid[:, None], written, ctx_rows))
+        return firsts, k_pools, v_pools, tokens, lengths, context
+
+    return jax.jit(
+        extend, static_argnames=("t_cap",),
+        donate_argnames=("k_pools", "v_pools", "tokens", "lengths",
+                         "context"))
+
+
+@functools.lru_cache(maxsize=64)
+def _paged_ctx_fn_for(t_write: int):
+    """Speculative-context seed for a paged prefix-hit admit: the KV
+    aliasing is a pure host-side table edit, but the drafter's history
+    buffer still needs the cached prompt tokens — the only device write
+    a paged hit pays (and only with speculation on)."""
+
+    def seed(context, slot, ctx_tokens):
+        return context.at[slot, :t_write].set(ctx_tokens)
+
+    return jax.jit(seed, donate_argnames=("context",))

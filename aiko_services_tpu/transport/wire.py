@@ -54,6 +54,8 @@ __all__ = [
     "WIRE_CODECS", "WIRE_CODEC_DTYPES", "WIRE_CODEC_RANK", "codec_legal",
     "pop_trace", "TENANT_MARKER", "tenant_fields", "is_tenant_fields",
     "parse_tenant", "pop_tenant", "KV_TRANSFER_COMMAND",
+    "KV_BATCH_COMMAND", "encode_kv_batch", "decode_kv_batch",
+    "kv_batch_members", "validate_kv_transfer_params",
     "KV_TRANSFER_SCHEMA", "KV_TRANSFER_DTYPES", "KV_TRANSFER_RANK",
     "kv_leaf_legal", "encode_kv_transfer", "decode_kv_transfer",
 ]
@@ -582,6 +584,51 @@ def encode_kv_transfer(transfer_id: str, tenant: str, tokens,
         trace=trace)
 
 
+# same-destination KV transfers coalesced into one envelope (ISSUE 15
+# satellite, PR 14 residue b): the batch is a plain envelope whose
+# params are the member transfers' COMPLETE encoded payloads as bytes
+# fields — each member stays independently schema-checked by
+# decode_kv_transfer, so a truncated member fails alone and the batch
+# wrapper adds no second validation surface to keep sound
+KV_BATCH_COMMAND = "kv_transfer_batch"
+
+
+def encode_kv_batch(payloads, trace=None) -> bytes:
+    """Coalesce encoded KV-transfer payloads into one batch envelope.
+    Callers batch same-destination transfers within a short window so
+    a prompt burst amortizes the per-envelope wire cost."""
+    members = [bytes(p) for p in payloads]
+    if not members:
+        raise WireError("kv_transfer_batch with no members")
+    return encode_envelope(KV_BATCH_COMMAND, [members], trace=trace)
+
+
+def decode_kv_batch(payload) -> list:
+    """The member payloads (bytes) of a batch envelope — decode each
+    with decode_kv_transfer.  Raises WireError on a foreign command or
+    non-bytes members."""
+    command, params = decode_envelope(payload)
+    return kv_batch_members(command, params)
+
+
+def kv_batch_members(command, params) -> list:
+    """Validate an already-decoded batch envelope's (command, params)
+    — the shared seam for callers that decode_envelope'd once to
+    dispatch on the command."""
+    if command != KV_BATCH_COMMAND:
+        raise WireError(f"not a kv_transfer_batch envelope: "
+                        f"{command!r}")
+    if not params or not isinstance(params[0], list) or not params[0]:
+        raise WireError("kv_transfer_batch carries no members")
+    members = params[0]
+    for i, member in enumerate(members):
+        if not isinstance(member, (bytes, bytearray)):
+            raise WireError(
+                f"kv_transfer_batch member {i} is "
+                f"{type(member).__name__}, want bytes")
+    return [bytes(m) for m in members]
+
+
 def decode_kv_transfer(payload):
     """Decode + validate one KV-transfer envelope.  Returns a dict
     {transfer_id, tenant, start_block, block_tokens, first_token,
@@ -589,6 +636,13 @@ def decode_kv_transfer(payload):
     rank, scale/value agreement, uniform block length) — a truncated or
     foreign payload raises WireError instead of reaching a cache."""
     command, params = decode_envelope(payload)
+    return validate_kv_transfer_params(command, params)
+
+
+def validate_kv_transfer_params(command, params):
+    """The validation body of decode_kv_transfer over an
+    already-decoded (command, params) — shared with the batch path so
+    members are checked by exactly the same code."""
     if command != KV_TRANSFER_COMMAND:
         raise WireError(f"not a kv_transfer envelope: {command!r}")
     if len(params) < 8:

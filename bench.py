@@ -1170,6 +1170,16 @@ LLAMA_KV_DTYPE = os.environ.get("AIKO_BENCH_LLAMA_KV", "int8")
 # so the drafter's accept rate measures the MACHINERY cost, not the
 # real-text win; the rung reports llama_accept_rate either way.
 LLAMA_SPEC_K = int(os.environ.get("AIKO_BENCH_LLAMA_SPEC", "0"))
+# paged KV block pool (ISSUE 15): the slot caches run as a refcounted
+# block pool + per-slot tables by default — prefix hits alias instead
+# of copying, harvest is refcount-only, disagg installs land once.
+# AIKO_BENCH_LLAMA_PAGED=off A/Bs the dense slot cache (greedy output
+# is bit-identical either way; the copy-bytes fields are the delta).
+LLAMA_PAGED = os.environ.get("AIKO_BENCH_LLAMA_PAGED", "on") \
+    .lower() not in ("off", "0", "false", "")
+# pool/prefix block size as a first-class knob so the r06 sweep can
+# score 32 vs 64 (copy/scatter count vs partial-hit granularity)
+LLAMA_BLOCK = int(os.environ.get("AIKO_BENCH_LLAMA_BLOCK", "32"))
 
 
 def _llama_decoder_opts() -> dict:
@@ -1177,7 +1187,32 @@ def _llama_decoder_opts() -> dict:
         "kv_cache_dtype": None if LLAMA_KV_DTYPE in
         ("", "native", "bf16") else LLAMA_KV_DTYPE,
         "speculate_k": LLAMA_SPEC_K,
+        "paged_kv": LLAMA_PAGED,
+        "kv_block": LLAMA_BLOCK,
     }
+
+
+def _llama_pool_fields(decoder, prefix: str) -> dict:
+    """Pool-occupancy bench surface (ISSUE 15): capacity, live blocks,
+    bytes, and the copy counters the paged path zeroes."""
+    fields = {
+        f"{prefix}_kv_paged": bool(decoder.paged),
+        f"{prefix}_kv_block": decoder.kv_block,
+        f"{prefix}_prefix_copy_bytes":
+            decoder.stats["prefix_copy_bytes"],
+        f"{prefix}_harvest_copy_bytes":
+            decoder.stats["harvest_copy_bytes"],
+    }
+    if decoder.paged:
+        pool = decoder.pool
+        fields |= {
+            f"{prefix}_pool_blocks": pool.num_blocks - 1,
+            f"{prefix}_pool_blocks_used": pool.used_blocks(),
+            f"{prefix}_pool_occupancy": round(pool.occupancy(), 4),
+            f"{prefix}_pool_bytes": pool.nbytes(),
+            f"{prefix}_pool_cow_copies": pool.stats["cow_copies"],
+        }
+    return fields
 
 
 def bench_llama(window: float):
@@ -1361,9 +1396,12 @@ def bench_llama(window: float):
                         f"{LLAMA_STEPS_PER_SYNC} steps/sync, "
                         f"off-path prefill, "
                         f"kv={'int8' if decoder.kv_int8 else 'bf16'}"
+                        + (f", paged block {LLAMA_BLOCK}"
+                           if LLAMA_PAGED else ", dense kv")
                         + (f", spec_k={LLAMA_SPEC_K}"
                            if LLAMA_SPEC_K else ""),
-    } | ({} if not LLAMA_SPEC_K else {
+    } | _llama_pool_fields(decoder, "lat_llama") \
+        | ({} if not LLAMA_SPEC_K else {
         "llama_spec_k": LLAMA_SPEC_K,
         "llama_accept_rate": round(decoder.accept_rate(), 4),
         "llama_accepted_per_step": round(
@@ -1492,7 +1530,10 @@ def bench_llama_interactive(window: float = 12.0):
 # prefix/KV reuse cache on the conversation rung (ISSUE 13): block
 # size in tokens, or "off" to A/B the cold path (every turn re-prefills
 # its whole history — the pre-PR 13 behavior).
-LLAMA_PREFIX = os.environ.get("AIKO_BENCH_LLAMA_PREFIX", "32")
+# prefix cache on/off for the conversation rung; a NUMERIC value still
+# sets the block size (PR 13 compat) — otherwise AIKO_BENCH_LLAMA_BLOCK
+# is the block knob for cache and pool alike (ISSUE 15)
+LLAMA_PREFIX = os.environ.get("AIKO_BENCH_LLAMA_PREFIX", "on")
 
 
 def bench_llama_conversation(window: float = 10.0):
@@ -1518,7 +1559,8 @@ def bench_llama_conversation(window: float = 10.0):
     config = _dc.replace(base, dtype=jnp.bfloat16, max_seq_len=1024)
     params = llama_init(jax.random.PRNGKey(0), config)
     prefix_off = LLAMA_PREFIX.lower() in ("off", "0", "false", "")
-    block = 32 if prefix_off else int(LLAMA_PREFIX)
+    block = int(LLAMA_PREFIX) if LLAMA_PREFIX.isdigit() \
+        else LLAMA_BLOCK
     cache = None if prefix_off else PrefixKVCache(
         block_tokens=block, max_bytes=2 << 30, name="bench_conv")
     slots, sps, max_new = 16, 8, 32
@@ -1526,7 +1568,8 @@ def bench_llama_conversation(window: float = 10.0):
     decoder = ContinuousDecoder(params, config, max_slots=slots,
                                 max_seq=1024, prefill_buckets=(64,),
                                 steps_per_sync=sps, prefill_chunk=64,
-                                prefix_cache=cache, name="bench_conv")
+                                prefix_cache=cache, name="bench_conv",
+                                paged_kv=LLAMA_PAGED, kv_block=block)
     rng = np.random.default_rng(31)
     sessions: dict = {}
     turns_done = [0]
@@ -1604,11 +1647,18 @@ def bench_llama_conversation(window: float = 10.0):
             f"8 concurrent sessions x {turns_per_session} turns, "
             f"{transcript}-token restored transcript, "
             f"{user_len}-token turns, "
-            f"prefix=" + ("off" if prefix_off else f"block{block}"),
+            f"prefix=" + ("off" if prefix_off else f"block{block}")
+            + (", paged" if LLAMA_PAGED else ", dense"),
         "lat_llama_conv_sessions": session_seq[0],
         "lat_llama_conv_turns": turns,
         "lat_llama_conv_prefix_hit_rate": round(hit_rate, 4),
-    }
+    } | _llama_pool_fields(decoder, "lat_llama_conv")
+    # the ISSUE 15 acceptance surface: KV bytes a prefix hit copies
+    # into the slot — paged aliasing drops this to ZERO (dense: the
+    # whole pow2-padded chain per hit)
+    admits = max(1, decoder.stats["prefix_admits"])
+    fields["lat_llama_conv_copy_bytes_per_hit"] = \
+        decoder.stats["prefix_copy_bytes"] // admits
     if cache is not None:
         fields["lat_llama_conv_prefix_blocks"] = len(cache)
         fields["lat_llama_conv_prefix_bytes"] = cache.bytes_used
@@ -1701,6 +1751,14 @@ def bench_llama_disagg(window: float = 8.0):
         "lat_llama_coloc_lost": coloc_out["lost"],
         "lat_llama_disagg_prefill_blocks_shipped":
             transfers.get("blocks_shipped", 0),
+        # paged install surface (ISSUE 15): with the pool on, the
+        # shipped chain lands ONCE (wire -> pool scatter) and the
+        # admit is a table edit — install copy bytes drop to zero
+        "lat_llama_disagg_kv_paged": bool(disagg.decoder.paged),
+        "lat_llama_disagg_install_copy_bytes":
+            disagg.decoder.stats["prefix_copy_bytes"],
+        "lat_llama_disagg_transfer_batched":
+            transfers.get("batched_envelopes", 0),
     }
     for key, label in (("transfer_p50_ms", "transfer_p50_ms"),
                        ("transfer_p95_ms", "transfer_p95_ms")):

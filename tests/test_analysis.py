@@ -539,6 +539,46 @@ class TestLint:
         assert not any(f.rule == "lint-linear-timer"
                        for f in lint_source(source, "element.py"))
 
+    # -- lint-paged-free (ISSUE 15) ----------------------------------------
+    def test_discarded_pool_alloc_in_hot_path_flagged(self):
+        # the returned ids are the ONLY refcount handle: discarding
+        # them leaks pool blocks forever
+        rules = self._rules_at(
+            "def pump(self):   # graft: hot-path\n"
+            "    self.pool.alloc_blocks(4)\n")
+        assert ("lint-paged-free", 2) in rules
+
+    def test_discarded_pool_alloc_in_handler_flagged(self):
+        rules = self._rules_at(
+            "class A:\n"
+            "    def _on_msg(self, topic, payload):\n"
+            "        self.pool.alloc_block()\n"
+            "    def setup(self, rt):\n"
+            "        rt.add_message_handler(self._on_msg, 't')\n")
+        assert ("lint-paged-free", 3) in rules
+
+    def test_captured_pool_alloc_exempt(self):
+        # captured ids can be released at retire — the balanced idiom
+        rules = self._rules_at(
+            "def pump(self):   # graft: hot-path\n"
+            "    ids = self.pool.alloc_blocks(4)\n"
+            "    self._slot_blocks.extend(ids)\n")
+        assert not any(r == "lint-paged-free" for r, _ in rules)
+
+    def test_pool_alloc_outside_hot_context_exempt(self):
+        rules = self._rules_at(
+            "def setup(self):\n"
+            "    self.pool.alloc_blocks(4)\n")
+        assert not any(r == "lint-paged-free" for r, _ in rules)
+
+    def test_paged_free_waiver(self):
+        source = ("def pump(self):   # graft: hot-path\n"
+                  "    # audited: probe pool, torn down whole"
+                  "  # graft: disable=lint-paged-free\n"
+                  "    self.pool.alloc_blocks(4)\n")
+        assert not any(f.rule == "lint-paged-free"
+                       for f in lint_source(source, "element.py"))
+
 
 # ---------------------------------------------------------------------------
 # wire codec legality table

@@ -599,6 +599,120 @@ def test_llama_agent_disagg_routes_through_prefill_pool(make_runtime,
 
 # -- review-fix regressions --------------------------------------------------
 
+class TestPagedDisagg:
+    """ISSUE 15: the disaggregated plane over a PAGED decode pool —
+    shipped KV lands ONCE (wire -> pool scatter), the admit is a table
+    edit, bursts coalesce into batch envelopes, and a cacheless pool
+    installs by direct slot-table aliasing."""
+
+    def test_paged_install_lands_once_bit_identical(self, params):
+        harness = make_harness(params, disagg=True,
+                               decoder_opts={"paged_kv": True})
+        try:
+            assert harness.wait_discovered(15.0)
+            tokens = run_one(harness, "r1", PROMPT, 10)
+            assert tokens == oracle(params, PROMPT, 10)
+            stats = harness.client.stats
+            assert stats["installs"] == 1
+            assert stats["local_fallbacks"] == 0
+            assert harness.decoder.stats["prefix_admits"] == 1
+            # the whole point: the admit moved ZERO KV bytes — the
+            # transfer's pool write was the only landing
+            assert harness.decoder.stats["prefix_copy_bytes"] == 0
+            assert harness.decoder.pool.stats["install_blocks"] == 5
+        finally:
+            harness.stop()
+
+    def test_burst_coalesces_into_batch_envelopes(self, params):
+        """Same-destination transfers inside the batch window ride ONE
+        kv_transfer_batch envelope (PR 14 residue b)."""
+        harness = make_harness(params, disagg=True, max_slots=8,
+                               prefill_slots=4, batch_window=0.05,
+                               decoder_opts={"paged_kv": True})
+        try:
+            assert harness.wait_discovered(15.0)
+            rng = np.random.default_rng(3)
+            done = {}
+            for i in range(6):
+                prompt = rng.integers(1, CONFIG.vocab,
+                                      size=40).tolist()
+                harness.submit(f"b{i}", prompt, 4,
+                               lambda r, t: done.update({r: t}))
+            assert harness.run_until(lambda: len(done) == 6,
+                                     timeout=300.0)
+            pstats = harness.prefill.stats
+            assert pstats["batched_envelopes"] >= 1
+            assert pstats["envelopes"] < 6        # burst amortized
+            assert harness.client.stats["batched_replies"] >= 1
+            assert harness.client.stats["installs"] == 6
+            assert harness.client.stats["local_fallbacks"] == 0
+            from aiko_services_tpu.observe.metrics import \
+                default_registry
+            assert default_registry().value(
+                "disagg_transfer_batched_total",
+                {"runtime": "disagg_prefill"}) >= 2
+        finally:
+            harness.stop()
+
+    def test_cacheless_decode_pool_direct_install(self, params):
+        """A paged decoder WITHOUT a prefix cache still rides the
+        split: shipped blocks land in its pool and alias into the
+        request's slot table (ISSUE 15 satellite — PR 14 residue d)."""
+        from aiko_services_tpu.serving import ContinuousDecoder
+        from aiko_services_tpu.serving_disagg import PrefillClient
+        harness = make_harness(params, disagg=True,
+                               decoder_opts={"paged_kv": True})
+        try:
+            assert harness.wait_discovered(15.0)
+            cacheless = ContinuousDecoder(
+                params, CONFIG, max_slots=4, prefill_buckets=(64,),
+                steps_per_sync=4, prefill_chunk=16, paged_kv=True,
+                kv_block=8, name="cacheless")
+            harness.engine.add_flatout_handler(cacheless.pump)
+            client = PrefillClient(harness.decode_rt, cacheless,
+                                   name="cacheless",
+                                   transfer_timeout=60.0)
+            client.add_candidate(harness.prefill.topic_path)
+            done = {}
+            client.submit("c1", PROMPT, 10,
+                          lambda r, t: done.update({r: t}))
+            assert harness.run_until(lambda: "c1" in done,
+                                     timeout=300.0)
+            assert done["c1"] == oracle(params, PROMPT, 10)
+            assert client.stats["direct_installs"] == 1
+            assert client.stats["local_fallbacks"] == 0
+            assert cacheless.stats["prefix_admits"] == 1
+            # cacheless: nothing survives the request — full drain
+            assert harness.run_until(lambda: cacheless.idle,
+                                     timeout=60.0)
+            assert cacheless.pool.used_blocks() == 0
+            client.stop()
+            harness.engine.remove_flatout_handler(cacheless.pump)
+        finally:
+            harness.stop()
+
+    def test_corrupt_batch_member_fails_alone(self, params):
+        """One truncated member of a batch envelope rides the corrupt
+        rung; its siblings still install."""
+        good = wire.encode_kv_transfer(
+            "g1", "", list(range(16)), 0, 8,
+            ("2", "2", "16", "float32", "False", "8", "4"),
+            [[{"k": np.zeros((2, 8, 16), np.float32),
+               "v": np.zeros((2, 8, 16), np.float32)}
+              for _ in range(2)]])
+        batch = wire.encode_kv_batch([good[:40], good])
+        members = wire.decode_kv_batch(batch)
+        assert len(members) == 2
+        with pytest.raises(wire.WireError):
+            wire.decode_kv_transfer(members[0])
+        out = wire.decode_kv_transfer(members[1])
+        assert out["transfer_id"] == "g1"
+        with pytest.raises(wire.WireError):
+            wire.decode_kv_batch(good)      # foreign command refused
+        with pytest.raises(wire.WireError):
+            wire.encode_kv_batch([])
+
+
 class TestReviewFixes:
     def test_non_array_leaves_raise_wire_error_not_attribute_error(
             self):

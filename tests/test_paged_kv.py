@@ -1,0 +1,410 @@
+# Paged KV block pool tests (ISSUE 15): the paged decoder's greedy
+# output must be BIT-IDENTICAL to the dense slot cache across every
+# serving composition (native/int8 x chunked x speculation x
+# mid-stream admits x disaggregated install), prefix hits must move
+# ZERO KV bytes (aliasing, not copying), harvest must be
+# refcount-only, copy-on-extend must protect shared blocks, and the
+# pool's refcounts must drain to zero live blocks after every retire.
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models.llama import (LLAMA_PRESETS,
+                                            llama_greedy_decode,
+                                            llama_init)
+from aiko_services_tpu.serving import ContinuousDecoder, PrefixKVCache
+
+CONFIG = dataclasses.replace(LLAMA_PRESETS["tiny"], max_seq_len=96)
+PROMPT = [(i * 13) % 50 + 1 for i in range(40)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama_init(jax.random.PRNGKey(0), CONFIG)
+
+
+def oracle(params, prompt, max_new):
+    out = llama_greedy_decode(params, CONFIG,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def run(decoder, requests, rounds=400, midstream=None):
+    """Drive requests to completion; `midstream` requests are
+    submitted after the second pump round (the mid-stream admit leg of
+    the parity matrix)."""
+    done = {}
+    for rid, (prompt, max_new) in requests.items():
+        decoder.submit(rid, prompt, max_new,
+                       lambda rid, t: done.update({rid: t}))
+    total = len(requests) + len(midstream or {})
+    for i in range(rounds):
+        decoder.pump()
+        if i == 1 and midstream:
+            for rid, (prompt, max_new) in midstream.items():
+                decoder.submit(rid, prompt, max_new,
+                               lambda rid, t: done.update({rid: t}))
+            midstream = None
+        if len(done) == total:
+            break
+    assert len(done) == total, f"{len(done)}/{total} completed"
+    return done
+
+
+_SEQ = [0]
+
+
+def pair(params, block=8, cache=False, **kwargs):
+    """(dense decoder, paged decoder[, caches]) at one geometry."""
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("prefill_buckets", (64,))
+    kwargs.setdefault("steps_per_sync", 4)
+    if not cache:
+        dense = ContinuousDecoder(params, CONFIG, **kwargs)
+        paged = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                  kv_block=block, **kwargs)
+        return dense, paged
+    _SEQ[0] += 1
+    dense_cache = PrefixKVCache(block_tokens=block, max_bytes=64 << 20,
+                                name=f"pd{_SEQ[0]}")
+    paged_cache = PrefixKVCache(block_tokens=block, max_bytes=64 << 20,
+                                name=f"pp{_SEQ[0]}")
+    dense = ContinuousDecoder(params, CONFIG,
+                              prefix_cache=dense_cache, **kwargs)
+    paged = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                              prefix_cache=paged_cache, **kwargs)
+    return dense, paged, dense_cache, paged_cache
+
+
+REQUESTS = {"a": (PROMPT, 10), "b": (PROMPT[:17] + [3, 4], 8)}
+MIDSTREAM = {"mid": (PROMPT[:9] + [7], 6)}
+
+
+# -- parity matrix ----------------------------------------------------------
+
+class TestPagedParity:
+    def test_native_with_midstream_admit(self, params):
+        dense, paged = pair(params)
+        out_d = run(dense, REQUESTS, midstream=MIDSTREAM)
+        out_p = run(paged, REQUESTS, midstream=MIDSTREAM)
+        assert out_d == out_p
+        assert out_p["a"] == oracle(params, PROMPT, 10)
+        assert paged.pool.used_blocks() == 0      # drain audit
+
+    def test_int8(self, params):
+        dense, paged = pair(params, kv_cache_dtype="int8")
+        assert run(dense, REQUESTS) == run(paged, REQUESTS)
+        assert paged.pool.used_blocks() == 0
+
+    def test_chunked_prefill(self, params):
+        dense, paged = pair(params, prefill_chunk=16)
+        long = {"long": ((PROMPT * 3)[:80], 8)} | REQUESTS
+        assert run(dense, long) == run(paged, long)
+        assert paged.pool.used_blocks() == 0
+
+    @pytest.mark.slow
+    def test_spec_int8_chunked_midstream(self, params):
+        dense, paged = pair(params, speculate_k=2,
+                            kv_cache_dtype="int8", prefill_chunk=16)
+        out_d = run(dense, REQUESTS, midstream=MIDSTREAM)
+        out_p = run(paged, REQUESTS, midstream=MIDSTREAM)
+        assert out_d == out_p
+        assert paged.pool.used_blocks() == 0
+
+    def test_speculative(self, params):
+        dense, paged = pair(params, speculate_k=2)
+        assert run(dense, REQUESTS) == run(paged, REQUESTS)
+        assert paged.pool.used_blocks() == 0
+
+    def test_eos_retire_inside_round(self, params):
+        # a slot retiring mid-round (EOS) must release its blocks and
+        # not corrupt its neighbours' tables
+        dense, paged = pair(params, eos_token=3)
+        reqs = {"a": (PROMPT, 30), "b": (PROMPT[:11], 30)}
+        assert run(dense, reqs) == run(paged, reqs)
+        assert paged.pool.used_blocks() == 0
+
+
+# -- zero-copy prefix hits --------------------------------------------------
+
+class TestPagedPrefixReuse:
+    def test_hit_aliases_with_zero_copy_bytes(self, params):
+        dense, paged, _, paged_cache = pair(params, cache=True,
+                                            prefill_chunk=16)
+        donor = {"donor": (PROMPT, 10)}
+        probes = {"full": (PROMPT, 10),
+                  "part": (PROMPT[:24] + [7, 9, 3], 8)}
+        d1, d2 = run(dense, donor), run(dense, probes)
+        p1, p2 = run(paged, donor), run(paged, probes)
+        assert d1 == p1 and d2 == p2
+        assert paged.stats["prefix_admits"] == \
+            dense.stats["prefix_admits"] == 2
+        # the acceptance number: dense copies the whole chain per hit,
+        # paged aliases — zero KV bytes move on admit AND harvest
+        assert dense.stats["prefix_copy_bytes"] > 0
+        assert dense.stats["harvest_copy_bytes"] > 0
+        assert paged.stats["prefix_copy_bytes"] == 0
+        assert paged.stats["harvest_copy_bytes"] == 0
+        # live pool blocks after drain == cache-resident blocks
+        assert paged.pool.used_blocks() == len(paged_cache)
+        assert all(node.pool_id is not None
+                   for node in paged_cache._nodes.values())
+
+    def test_eviction_releases_pool_blocks(self, params):
+        _, paged, _, cache = pair(params, cache=True,
+                                  prefill_chunk=16)
+        run(paged, {"donor": (PROMPT, 10)})
+        resident = paged.pool.used_blocks()
+        assert resident == len(cache) > 0
+        # evict everything (no pins remain after drain)
+        cache.max_bytes = 1
+        cache._evict_to_budget("default")
+        assert len(cache) == 0
+        assert paged.pool.used_blocks() == 0      # zero live blocks
+
+    def test_shared_chain_across_two_slots(self, params):
+        # two concurrent hits alias the SAME pool blocks; each slot
+        # extends into its own fresh blocks and the chain survives
+        # both retires (ISSUE 15 satellite: copy-on-extend correctness
+        # when two slots share a block)
+        _, paged, _, cache = pair(params, cache=True,
+                                  prefill_chunk=16)
+        run(paged, {"donor": (PROMPT, 10)})
+        chain_ids = [node.pool_id for node in cache._nodes.values()]
+        refs_before = [paged.pool.refs(i) for i in chain_ids]
+        out = run(paged, {"s1": (PROMPT, 10), "s2": (PROMPT, 10)})
+        assert out["s1"] == out["s2"] == oracle(params, PROMPT, 10)
+        # after both retires every shared block is back to its cache
+        # ref alone (or re-harvested children extended the chain)
+        for block_id, before in zip(chain_ids, refs_before):
+            assert paged.pool.refs(block_id) == before == 1
+
+    def test_two_decoders_share_cache_and_pool(self, params):
+        # the dense idiom of several decoders sharing one cache must
+        # stay constructible in paged mode: the second decoder ADOPTS
+        # the cache's pool, and a chain harvested by the first is a
+        # zero-copy hit on the second
+        _SEQ[0] += 1
+        cache = PrefixKVCache(block_tokens=8, max_bytes=64 << 20,
+                              name=f"share{_SEQ[0]}")
+        common = dict(max_slots=4, prefill_buckets=(64,),
+                      steps_per_sync=4, prefill_chunk=16)
+        d1 = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                               kv_block=8, prefix_cache=cache,
+                               **common)
+        d2 = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                               kv_block=8, prefix_cache=cache,
+                               **common)
+        assert d1.pool is d2.pool is cache.pool
+        run(d1, {"donor": (PROMPT, 10)})
+        out = run(d2, {"probe": (PROMPT, 10)})
+        assert out["probe"] == oracle(params, PROMPT, 10)
+        assert d2.stats["prefix_admits"] == 1
+        assert d2.stats["prefix_copy_bytes"] == 0
+        assert d1.pool.used_blocks() == len(cache)
+
+    def test_speculative_hit_seeds_context(self, params):
+        dense, paged, *_ = pair(params, cache=True, speculate_k=2,
+                                prefill_chunk=16)
+        donor = {"donor": (PROMPT, 10)}
+        probe = {"full": (PROMPT, 10)}
+        assert run(dense, donor) == run(paged, donor)
+        assert run(dense, probe) == run(paged, probe)
+        assert paged.stats["prefix_admits"] == 1
+
+
+# -- copy-on-extend ---------------------------------------------------------
+
+class TestCopyOnExtend:
+    def test_seq_cap_slide_back_copies_shared_block(self, params):
+        """The PR 13 seq-cap regression shape: a 95-token prompt at
+        max_seq 96 forces the final chunk to slide BACK into the
+        cached region.  Dense rewrites in place (idempotent); paged
+        must copy the shared block first so the cached chain keeps its
+        rows — and a later hit must still be bit-identical."""
+        long_prompt = [(i * 7) % 50 + 1 for i in range(95)]
+        dense, paged, _, cache = pair(params, cache=True,
+                                      prefill_chunk=16)
+        cold = run(dense, {"cold": (long_prompt, 1)})["cold"]
+        for probe in ("w1", "w2"):
+            warm = run(paged, {probe: (long_prompt, 1)})[probe]
+            assert warm == cold, probe
+        # w1 harvested the chain; w2 hit it and slid back into it —
+        # the shared block was copied, not mutated
+        assert paged.stats["prefix_admits"] >= 1
+        assert paged.pool.stats["cow_copies"] >= 1
+        # a third hit still matches: the cache's rows were never
+        # overwritten by w2's recompute
+        assert run(paged, {"w3": (long_prompt, 1)})["w3"] == cold
+        assert paged.pool.used_blocks() == len(cache)
+
+    def test_no_copies_on_ordinary_hits(self, params):
+        _, paged, *_ = pair(params, cache=True, prefill_chunk=16)
+        run(paged, {"donor": (PROMPT, 10)})
+        run(paged, {"probe": (PROMPT, 10)})
+        assert paged.pool.stats["cow_copies"] == 0
+
+
+# -- pool accounting --------------------------------------------------------
+
+class TestBlockPool:
+    def test_alloc_release_and_growth(self, params):
+        from aiko_services_tpu.serving_paged import BlockPool
+        pool = BlockPool(CONFIG, 8, False, initial_blocks=4,
+                         grow_blocks=4, name="t")
+        ids = pool.alloc_blocks(6)           # forces one growth
+        assert len(set(ids)) == 6 and 0 not in ids
+        assert pool.stats["grows"] == 1
+        assert pool.used_blocks() == 6
+        pool.retain(ids[:2])
+        pool.release_blocks(ids)
+        assert pool.used_blocks() == 2       # retained pair survives
+        assert pool._used == pool.used_blocks()  # gauge twin is exact
+        pool.release_blocks(ids[:2])
+        assert pool.used_blocks() == 0
+        assert pool._used == 0
+        with pytest.raises(ValueError):
+            pool.release_blocks([ids[0]])    # double free is loud
+
+    def test_kv_cache_bytes_models_pool(self, params):
+        _, paged = pair(params)
+        assert paged.kv_cache_bytes() == \
+            paged.pool.nbytes() + paged._tables_np.nbytes
+        # same geometry, same initial coverage: pool models comparable
+        # bytes to the dense allocation (within one block of padding)
+        assert paged.pool.nbytes() > 0
+
+    def test_int8_pool_layout(self, params):
+        _, paged = pair(params, kv_cache_dtype="int8")
+        leaf = paged.pool.k_pools[0]
+        assert set(leaf) == {"q", "s"}
+        assert leaf["q"].dtype == jnp.int8
+        assert leaf["s"].shape == leaf["q"].shape[:3]
+
+    def test_measure_device_step_probes_paged(self, params):
+        from aiko_services_tpu.serving import measure_device_step
+        _, paged = pair(params)
+        assert measure_device_step(paged, steps_per_sync=2,
+                                   chains=1) > 0.0
+
+
+# -- direct slot-table install (cacheless disagg landing) -------------------
+
+class TestDirectInstall:
+    def _blocks_for(self, donor_cache, tokens):
+        """Ship-shaped host blocks for `tokens` harvested from a
+        throwaway dense donor cache."""
+        keys, hit = donor_cache.match("", tokens)
+        nodes = donor_cache.nodes(keys)
+        out = []
+        for node in nodes:
+            k_rows, v_rows = donor_cache.block_rows(node)
+            out.append({"k": [np.asarray(r) for r in k_rows],
+                        "v": [np.asarray(r) for r in v_rows]})
+        return out, hit
+
+    def test_install_and_alias_parity(self, params):
+        donor_cache = PrefixKVCache(block_tokens=8,
+                                    max_bytes=64 << 20, name="dd1")
+        donor = ContinuousDecoder(params, CONFIG,
+                                  prefix_cache=donor_cache,
+                                  max_slots=4, prefill_buckets=(64,),
+                                  steps_per_sync=4)
+        run(donor, {"donor": (PROMPT, 1)})
+        cacheless = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                      kv_block=8, max_slots=4,
+                                      prefill_buckets=(64,),
+                                      steps_per_sync=4,
+                                      prefill_chunk=16)
+        blocks, hit = self._blocks_for(donor_cache, PROMPT)
+        covered, ids = cacheless.install_shipped_blocks(PROMPT, 0,
+                                                        blocks)
+        assert covered == hit == len(ids) * 8
+        done = {}
+        assert cacheless.submit("direct", PROMPT, 10,
+                                lambda r, t: done.update({r: t}),
+                                kv_blocks=(covered, ids))
+        for _ in range(400):
+            cacheless.pump()
+            if "direct" in done:
+                break
+        assert done["direct"] == oracle(params, PROMPT, 10)
+        # the install skipped the covered prefill work entirely
+        assert cacheless.stats["prefix_admits"] == 1
+        assert cacheless.stats["prefix_copy_bytes"] == 0
+        assert cacheless.pool.used_blocks() == 0   # drain audit
+
+    def test_refused_submit_leaves_ids_with_caller(self, params):
+        cacheless = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                      kv_block=8, max_slots=4,
+                                      prefill_buckets=(64,),
+                                      steps_per_sync=4,
+                                      prefill_chunk=16)
+        # prime the round EWMA so deadline admission is live
+        run(cacheless, {"warm": (PROMPT[:9], 2)})
+        ids = cacheless.pool.alloc_blocks(3)
+        import time
+        accepted = cacheless.submit(
+            "late", PROMPT, 4, lambda r, t: None,
+            deadline=time.monotonic() - 1.0,
+            kv_blocks=(24, ids))
+        assert not accepted
+        # ownership never transferred: the caller's release drains
+        cacheless.pool.release_blocks(ids)
+        assert cacheless.pool.used_blocks() == 0
+
+    def test_truncated_prompt_drops_install_to_cold(self, params):
+        # a prompt over the admit cap tail-truncates inside submit, so
+        # pre-installed ids would alias KV for the tokens that were
+        # just cut off — the install must drop to a cold prefill (and
+        # release the ids), never silently emit wrong tokens
+        cacheless = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                      kv_block=8, max_slots=4,
+                                      prefill_buckets=(32,),
+                                      steps_per_sync=4)
+        long_prompt = [(i * 7) % 50 + 1 for i in range(40)]  # cap 32
+        ids = cacheless.pool.alloc_blocks(4)     # zero-filled garbage
+        done = {}
+        assert cacheless.submit("over", long_prompt, 6,
+                                lambda r, t: done.update({r: t}),
+                                kv_blocks=(32, ids))
+        for _ in range(400):
+            cacheless.pump()
+            if "over" in done:
+                break
+        assert cacheless.stats["install_misaligned"] == 1
+        assert done["over"] == oracle(params, long_prompt[-32:], 6)
+        assert cacheless.pool.used_blocks() == 0  # ids were released
+
+    def test_dense_then_paged_share_refused(self, params):
+        # the order-independent twin of the dense-decoder-refuses-
+        # paged-cache check: a dense decoder binding FIRST poisons the
+        # cache for any later paged attach (its insert()ed nodes have
+        # no pool id), so construction must refuse loudly
+        _SEQ[0] += 1
+        cache = PrefixKVCache(block_tokens=8, max_bytes=64 << 20,
+                              name=f"mix{_SEQ[0]}")
+        ContinuousDecoder(params, CONFIG, prefix_cache=cache,
+                          max_slots=4, prefill_buckets=(64,),
+                          steps_per_sync=4)
+        with pytest.raises(ValueError, match="dense"):
+            ContinuousDecoder(params, CONFIG, paged_kv=True,
+                              kv_block=8, prefix_cache=cache,
+                              max_slots=4, prefill_buckets=(64,),
+                              steps_per_sync=4)
+
+    def test_geometry_mismatch_refused_before_landing(self, params):
+        cacheless = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                      kv_block=8, max_slots=4,
+                                      prefill_buckets=(64,),
+                                      steps_per_sync=4)
+        bad = [{"k": [np.zeros((2, 8, 16), np.float32)],   # 1 layer
+                "v": [np.zeros((2, 8, 16), np.float32)]}]
+        with pytest.raises(ValueError):
+            cacheless.install_shipped_blocks(PROMPT, 0, bad)
+        assert cacheless.pool.used_blocks() == 0
