@@ -105,6 +105,16 @@
 #                         blocks, and a discarded alloc can never be
 #                         released).  Capture the ids and release them
 #                         at retire, or waive the audited site
+#   lint-pallas-fallback  pl.pallas_call without an `interpret=`
+#                         keyword: every pallas kernel site in the
+#                         package must carry the interpret/compiled
+#                         dispatch seam (ops/attention.py and
+#                         ops/paged_attention.py both auto-select
+#                         interpret off-TPU), so tier-1 exercises the
+#                         SAME kernel code path on CPU instead of
+#                         silently skipping it — a bare pallas_call is
+#                         hardware-only dead weight in CI and a crash
+#                         on the CPU fallback path
 #   lint-unbounded-cache  dict/OrderedDict CACHES mutated from
 #                         event-handler or `graft: hot-path` contexts
 #                         with no eviction on the same receiver: a
@@ -146,7 +156,7 @@ LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-print", "lint-unbounded-queue",
               "lint-unbounded-cache", "lint-linear-timer",
               "lint-metric-label", "lint-wall-clock",
-              "lint-paged-free")
+              "lint-paged-free", "lint-pallas-fallback")
 
 # block-pool allocator call tails (lint-paged-free): the returned ids
 # are the only refcount handle — a discarded result is a leak
@@ -526,6 +536,16 @@ class _Linter(ast.NodeVisitor):
                     f"by it (O(1) on the timer wheel); the sparse "
                     f"periodic heap's internal scan is the one waived "
                     f"exception")
+        if _func_tail(node.func) == "pallas_call" and not self.is_test \
+                and not any(kw.arg == "interpret"
+                            for kw in node.keywords):
+            self.report(
+                "lint-pallas-fallback", node,
+                "pallas_call without an interpret= keyword: every "
+                "kernel site must carry the interpret/compiled "
+                "dispatch seam (auto-select interpret off-TPU, the "
+                "ops/attention.py pattern) so tier-1 runs the same "
+                "kernel code path on CPU instead of skipping it")
         if _func_tail(node.func) in _METRIC_FACTORIES and \
                 not self.is_test:
             self._check_metric_labels(node)

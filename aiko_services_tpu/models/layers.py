@@ -422,16 +422,19 @@ def kv_rows_nbytes(rows) -> int:
 # The paged serving cache (serving_paged.BlockPool) stores KV in one
 # [N, H, B, D] pool of fixed B-token blocks per layer (int8 pools carry
 # the {"q" i8 [N, H, B, D], "s" f32 [N, H, B]} serving form), addressed
-# by per-slot int32 block tables.  These four primitives are the whole
+# by per-slot int32 block tables.  These primitives are the whole
 # device-side vocabulary of the paged path: a gather that materializes
 # a slot-major [S, H, T, D] view for the attention einsums (the one
 # place paged and dense numerics must agree BIT-for-bit — the gathered
 # view is value-identical to the dense slot cache, so every attention
 # body downstream is shared, not forked), a per-position scatter for
 # the decode round's side-buffer merge, a whole-block scatter for the
-# admit prefill, and a block slice read for harvest-free wire shipping.
-# Out-of-range destination ids drop (mode="drop") — the paged analogue
-# of the dense path's _POS_INVALID discipline.
+# admit prefill, a block slice read for harvest-free wire shipping,
+# and a plane split for the pallas paged-attention kernel (ISSUE 16),
+# which reads pool blocks straight through the table and demotes the
+# gather to the bit-parity oracle role.  Out-of-range destination ids
+# drop (mode="drop") — the paged analogue of the dense path's
+# _POS_INVALID discipline.
 
 def gather_paged_kv(pool, tables):
     """Assemble a slot-major KV view from a block pool: `tables` is
@@ -451,6 +454,18 @@ def gather_paged_kv(pool, tables):
         return g.transpose(0, 2, 1, 3, 4).reshape(s, h, nb * b, d)
     s, nb, h, b = g.shape                  # scales [S, nb, H, B]
     return g.transpose(0, 2, 1, 3).reshape(s, h, nb * b)
+
+
+def paged_pool_planes(pool):
+    """(value plane, scale plane or None) for one paged-pool leaf —
+    the int8 serving dict splits into its i8 values [N, H, B, D] and
+    f32 per-position scales [N, H, B] (separate DMA operands for the
+    pallas paged-attention kernel); native pools carry no scale.  The
+    pool-grain sibling of serving._kv_planes, kept here so the int8
+    pool layout is decoded in exactly one module."""
+    if isinstance(pool, dict):
+        return pool["q"], pool["s"]
+    return pool, None
 
 
 def scatter_paged_rows(pool, dest_blocks, offsets, rows):

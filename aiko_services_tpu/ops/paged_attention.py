@@ -1,0 +1,255 @@
+# Paged decode attention: a pallas TPU kernel that reads K/V straight
+# out of the serving block pool through per-slot block tables — vLLM
+# PagedAttention's indirection (Kwon et al., SOSP 2023), TPU-flavored
+# via scalar-prefetch index maps (ISSUE 16, ROADMAP item 2).
+#
+# The XLA paged path (serving_paged._gather_views) must materialize a
+# slot-major [S, H, T, D] copy of every slot's blocks once per round
+# before the attention einsums can run — the one cost plain XLA cannot
+# delete, measured as the bulk of the 11.38 ms decode step vs its
+# 5.64 ms HBM roofline (BENCH_r05).  Here the block table rides the
+# grid as a scalar-prefetch operand, so each grid step DMAs one pool
+# block [H, B, D] directly into VMEM: K and V stream through HBM
+# exactly once, and nothing slot-major ever exists.
+#
+# Grid (S, 2, nb), two phases per slot:
+#   phase 0  walks K blocks tables[s, j], accumulating masked scores
+#            into a VMEM scratch row [Hkv, G*W, nb*B + P]; the last
+#            step appends the side-buffer scores, softmaxes the whole
+#            row in place, and seeds the accumulator with the side PV
+#   phase 1  walks V blocks, accumulating block PV into the f32
+#            accumulator, and writes the output on the last step
+# The inactive operand's index map parks on an unchanged block index
+# (K on tables[s, nb-1] through phase 1, V on tables[s, 0] through
+# phase 0), so the pallas pipeline skips those re-fetches — net HBM
+# traffic stays one K pass + one V pass.
+#
+# Numerics discipline (the bit-parity contract with the XLA oracle):
+# every elementwise op matches serving._grouped_block_attention /
+# serving_paged's extend body exactly — f32 QK dots * scale, int8
+# scale treatment, -1e30 masking, jax.nn.softmax over the full row,
+# weight casts before the PV dots.  The kernel's extra [t_cap, nb*B)
+# columns are masked to -1e30 and contribute exact zeros to the
+# softmax sum, so no t_cap re-slice is needed.  Only the dot-product
+# ASSOCIATION differs (blockwise vs one full-T contraction), which is
+# why the acceptance criterion is greedy TOKEN identity, proven per
+# combination in tests/test_paged_kv.py (interpret mode on CPU).
+#
+# int8 pools ({"q" i8, "s" f32}) fuse their dequant into the dots two
+# ways, each matching its oracle:
+#   fold_scales=True   (decode/spec steps) — int8 values stay the dot
+#       operand, per-position scales fold into scores (K) and weights
+#       (V), the serving._kv_planes discipline
+#   fold_scales=False  (chunked-prefill extend) — blocks dequantize in
+#       VMEM exactly like layers.dequantize_kv_cache before the dots,
+#       because the extend oracle attends dequantized rows
+#
+# Block sizes honour the (8,128)/(16,128)/(32,128) tiling floors only
+# at serving shapes (/opt/skills/guides/pallas_guide.md "Tiling
+# Constraints"); tests run tiny shapes in interpret mode, hardware
+# validation is BENCH_r06's A/B (AIKO_BENCH_LLAMA_KERNEL).
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["paged_decode_attention"]
+
+
+def _paged_attn_kernel(*refs, int8: bool, fold: bool, groups: int,
+                       width: int, block_tokens: int, side_len: int,
+                       scale: float):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if int8:
+        (tables_ref, entry_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
+         k_side_ref, v_side_ref, valid_ref, o_ref, scores, acc) = refs
+    else:
+        (tables_ref, entry_ref, q_ref, kq_ref, vq_ref,
+         k_side_ref, v_side_ref, valid_ref, o_ref, scores, acc) = refs
+        ks_ref = vs_ref = None
+    del tables_ref                     # consumed by the index maps
+    s = pl.program_id(0)
+    phase = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    main_t = nb * block_tokens
+
+    @pl.when(phase == 0)
+    def _block_scores():
+        q = q_ref[0]                                  # [Hkv, GW, D]
+        k = kq_ref[0]                                 # [Hkv, B, D]
+        if int8 and not fold:
+            # extend-path numerics: cast both factors then multiply in
+            # the compute dtype, layers.dequantize_kv_cache verbatim
+            k = k.astype(q.dtype) * \
+                ks_ref[0][:, :, None].astype(q.dtype)
+        else:
+            k = k.astype(q.dtype)
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [Hkv,GW,B]
+        if int8 and fold:
+            sc = sc * ks_ref[0][:, None, :]
+        # absolute position mask — positions past the slot's read-only
+        # extent (entry_lengths) are dead cells / null-block zeros
+        pos = j * block_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 2)
+        sc = jnp.where(pos < entry_ref[s], sc, -1e30)
+        scores[:, :, pl.ds(j * block_tokens, block_tokens)] = sc
+
+    @pl.when((phase == 0) & (j == nb - 1))
+    def _side_softmax():
+        q = q_ref[0]
+        k_s = k_side_ref[0]                           # [Hkv, P, D]
+        sc = jax.lax.dot_general(
+            q, k_s, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [Hkv,GW,P]
+        valid = jnp.broadcast_to(valid_ref[0][None],
+                                 (groups, width, side_len))
+        valid = valid.reshape(1, groups * width, side_len)
+        scores[:, :, main_t:] = jnp.where(valid, sc, -1e30)
+        weights = jax.nn.softmax(scores[...], axis=-1)
+        scores[...] = weights                # phase 1 reads them back
+        v_s = v_side_ref[0]
+        acc[...] = jax.lax.dot_general(
+            weights[:, :, main_t:].astype(v_s.dtype), v_s,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(phase == 1)
+    def _block_pv():
+        w = scores[:, :, pl.ds(j * block_tokens, block_tokens)]
+        v = vq_ref[0]
+        if int8 and not fold:
+            v = v.astype(q_ref.dtype) * \
+                vs_ref[0][:, :, None].astype(q_ref.dtype)
+        else:
+            if int8:
+                w = w * vs_ref[0][:, None, :]
+            v = v.astype(q_ref.dtype)
+        acc[...] += jax.lax.dot_general(
+            w.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((phase == 1) & (j == nb - 1))
+    def _finish():
+        o_ref[0] = acc[...]
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, k_side, v_side,
+                           side_valid, entry_lengths, *, groups: int,
+                           scale: float | None = None,
+                           fold_scales: bool = True,
+                           interpret: bool | None = None):
+    """Block-table-native decode attention over a paged KV pool.
+
+    q:            [S, Hkv, G*W, D] grouped queries (G-major: the
+                  (group, width) axes flattened)
+    k/v_pool:     per-layer pool leaf [N, Hkv, B, D], or the int8
+                  serving dict {"q" i8 [N, Hkv, B, D], "s" f32
+                  [N, Hkv, B]}
+    tables:       [S, nb] int32 block ids (nb * B >= the slot's
+                  readable extent; unfilled entries point at the null
+                  block and are masked)
+    k/v_side:     [S, Hkv, P, D] this round's side buffers in the
+                  compute dtype
+    side_valid:   [S, W, P] bool — per-query side visibility, computed
+                  by the caller (this is what widens the speculative
+                  verify into the same kernel: W = 1 + k and the
+                  pos_side <= q_pos mask arrive here unchanged)
+    entry_lengths: [S] int32 read-only main extent per slot
+
+    Returns [S, Hkv, G*W, D] f32.  interpret=None auto-selects:
+    compiled pallas on TPU, interpreter mode elsewhere (CPU tests run
+    the same kernel code path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ..models.layers import paged_pool_planes
+
+    kq, k_scales = paged_pool_planes(k_pool)
+    vq, v_scales = paged_pool_planes(v_pool)
+    int8 = k_scales is not None
+    slots_n, num_kv, gw, head_dim = q.shape
+    width = gw // groups
+    nb = tables.shape[1]
+    block_tokens = kq.shape[2]
+    side_len = k_side.shape[2]
+    if scale is None:
+        # f32(1)/sqrt(f32(d)) — the exact value the oracle's traced
+        # 1/jnp.sqrt computes, so the score scaling cannot drift a ulp
+        scale = float(np.float32(1.0) / np.sqrt(np.float32(head_dim)))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def at_slot(s, p, j, tables, entries):
+        return (s, 0, 0, 0)
+
+    def valid_map(s, p, j, tables, entries):
+        return (s, 0, 0)
+
+    def k_map(s, p, j, tables, entries):
+        # phase 0 walks the K blocks; phase 1 parks on the last one so
+        # consecutive grid steps keep an unchanged block index and the
+        # pipeline skips the re-fetch
+        return (jax.lax.select(p == 0, tables[s, j],
+                               tables[s, nb - 1]), 0, 0, 0)
+
+    def v_map(s, p, j, tables, entries):
+        # mirror image: V parks on block 0 through phase 0
+        return (jax.lax.select(p == 0, tables[s, 0],
+                               tables[s, j]), 0, 0, 0)
+
+    def k_scale_map(s, p, j, tables, entries):
+        return k_map(s, p, j, tables, entries)[:3]
+
+    def v_scale_map(s, p, j, tables, entries):
+        return v_map(s, p, j, tables, entries)[:3]
+
+    block_kv = (1, num_kv, block_tokens, head_dim)
+    in_specs = [pl.BlockSpec((1, num_kv, gw, head_dim), at_slot),
+                pl.BlockSpec(block_kv, k_map)]
+    operands = [q, kq]
+    if int8:
+        in_specs.append(
+            pl.BlockSpec((1, num_kv, block_tokens), k_scale_map))
+        operands.append(k_scales)
+    in_specs.append(pl.BlockSpec(block_kv, v_map))
+    operands.append(vq)
+    if int8:
+        in_specs.append(
+            pl.BlockSpec((1, num_kv, block_tokens), v_scale_map))
+        operands.append(v_scales)
+    in_specs += [pl.BlockSpec((1, num_kv, side_len, head_dim), at_slot),
+                 pl.BlockSpec((1, num_kv, side_len, head_dim), at_slot),
+                 pl.BlockSpec((1, width, side_len), valid_map)]
+    operands += [k_side, v_side, side_valid]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots_n, 2, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, num_kv, gw, head_dim), at_slot),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, gw, nb * block_tokens + side_len),
+                       jnp.float32),
+            pltpu.VMEM((num_kv, gw, head_dim), jnp.float32),
+        ])
+    kernel = functools.partial(
+        _paged_attn_kernel, int8=int8, fold=fold_scales, groups=groups,
+        width=width, block_tokens=block_tokens, side_len=side_len,
+        scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (slots_n, num_kv, gw, head_dim), jnp.float32),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), entry_lengths.astype(jnp.int32),
+      *operands)

@@ -144,6 +144,14 @@ def measure_device_step(decoder, steps_per_sync: int = 64,
 # default) hardcodes the two-pass einsums — ATTENTION_IMPL has no
 # effect there; tools/ab_decode_attention.py pins KV mode per case so
 # the labels stay meaningful.
+# "paged_kernel" (ISSUE 16) applies to PAGED decoders only: the
+# decode/spec/extend attentions run the fused pallas kernel
+# (ops.paged_attention) reading pool blocks straight through the
+# block table — no slot-major gather materializes.  The gather path
+# stays the bit-parity oracle; dense decoders ignore the value (it
+# falls through to two_pass).  Read at decoder CONSTRUCTION (stashed
+# as self.paged_kernel), so flipping the module global never switches
+# a live decoder's compiled programs mid-stream.
 ATTENTION_IMPL = os.environ.get("AIKO_DECODE_ATTENTION", "two_pass")
 # KV write strategy inside the decode scan:
 #   "select" — masked full-cache select per step (r4 design);
@@ -1285,7 +1293,7 @@ _POS_INVALID = 1 << 30
 
 def _spec_scan_body(config: LlamaConfig, cos, sin, k_spec: int,
                     ngram: int, params, eos, k_caches, v_caches,
-                    entry_lengths):
+                    entry_lengths, attention=None):
     """The speculative drafting/verify/acceptance scan body, shared
     VERBATIM by the dense (_build_spec_step) and paged
     (serving_paged._build_paged_spec_step) builders — like the
@@ -1293,8 +1301,16 @@ def _spec_scan_body(config: LlamaConfig, cos, sin, k_spec: int,
     bit-parity invariant safe from a fix landing on only one side.
     The builders differ only in how k_caches/v_caches are obtained
     (dense slot caches vs per-round pool gathers) and how the
-    consumed side entries merge back at scan exit."""
+    consumed side entries merge back at scan exit.
+
+    `attention` is the verify attention seam (default
+    _slot_attention_spec over slot-major caches); the paged pallas
+    kernel path passes _kernel_attention_spec with k_caches/v_caches
+    holding the raw pool leaves — the draft/accept machinery around
+    it stays this one copy either way."""
     width = k_spec + 1
+    if attention is None:
+        attention = _slot_attention_spec
     slots_n = entry_lengths.shape[0]
     col = jnp.arange(width)[None]                        # [1, w]
     row = jnp.arange(slots_n)[:, None]                   # [S, 1]
@@ -1345,7 +1361,7 @@ def _spec_scan_body(config: LlamaConfig, cos, sin, k_spec: int,
         new_k, new_v = [], []
 
         def attend(i, layer, normed):
-            attn_out, k_s, v_s = _slot_attention_spec(
+            attn_out, k_s, v_s = attention(
                 layer, config, normed, cos, sin, k_caches[i],
                 v_caches[i], k_sides[i], v_sides[i], pos_side,
                 entry_lengths, lengths, base)
@@ -1721,12 +1737,19 @@ class ContinuousDecoder:
         # credit away (ISSUE 13 satellite)
         self._prefill_token_ewma: float | None = None
 
+        # the paged pallas-kernel toggle is latched here — builder
+        # cache keys include it, so oracle and kernel decoders coexist
+        # in one process (parity tests build one of each)
+        self.paged_kernel = bool(self.paged and
+                                 ATTENTION_IMPL == "paged_kernel")
         if self.paged:
             from .serving_paged import (_paged_spec_step_for,
                                         _paged_step_for)
             self._step = _paged_spec_step_for(
-                config, self.speculate_k, self.speculate_ngram) \
-                if self.speculate_k else _paged_step_for(config)
+                config, self.speculate_k, self.speculate_ngram,
+                self.paged_kernel) \
+                if self.speculate_k \
+                else _paged_step_for(config, self.paged_kernel)
         else:
             self._step = _spec_step_for(config, self.speculate_k,
                                         self.speculate_ngram,
@@ -2108,7 +2131,7 @@ class ContinuousDecoder:
                 from .serving_paged import _paged_extend_fn_for
                 self._prefill_fns[key] = _paged_extend_fn_for(
                     self.config, chunk, width, self.kv_int8,
-                    bool(self.speculate_k))
+                    bool(self.speculate_k), self.paged_kernel)
             else:
                 self._prefill_fns[key] = _extend_fn_for(
                     self.config, chunk, width, self.kv_int8,
@@ -3183,6 +3206,12 @@ class ContinuousDecoder:
             profiler.commit_round()
         else:
             profiler.abandon_round()
+            if self.pool is not None and self.idle:
+                # idle-watermark pool release (ISSUE 16 satellite):
+                # a shrink retraces the paged program family, so it
+                # only ever fires on an idle tick — never inside a
+                # serving window
+                self.pool.maybe_shrink()
         if self.idle and self.on_idle is not None:
             self.on_idle()
 

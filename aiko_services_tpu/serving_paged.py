@@ -104,9 +104,9 @@ class BlockPool:
         from .observe.metrics import MirroredStats, default_registry
         self._registry = registry or default_registry()
         self.stats = MirroredStats(
-            {"allocs": 0, "frees": 0, "grows": 0, "cow_copies": 0,
-             "cow_copy_bytes": 0, "install_blocks": 0,
-             "install_bytes": 0},
+            {"allocs": 0, "frees": 0, "grows": 0, "shrinks": 0,
+             "cow_copies": 0, "cow_copy_bytes": 0,
+             "install_blocks": 0, "install_bytes": 0},
             metric="kv_pool_events_total",
             help="paged KV block-pool events by kind",
             registry=self._registry,
@@ -119,7 +119,15 @@ class BlockPool:
             "kv_pool_blocks_used",
             "paged KV pool blocks with at least one owner",
             labels={"pool": self.name})
+        self._gauge_occupancy = self._registry.gauge(
+            "kv_pool_occupancy",
+            "used / capacity fraction of the paged KV pool",
+            labels={"pool": self.name})
         self._used = 0
+        # shrink floor: construction capacity, raised by reserve() —
+        # maybe_shrink never retraces below what a caller declared as
+        # steady state, so drain/refill cycles don't thrash shapes
+        self._floor_blocks = n
         self._publish_gauges()
 
     # -- device arrays -----------------------------------------------------
@@ -171,9 +179,47 @@ class BlockPool:
         steady-state residency (slot coverage + prefix-cache budget)
         reserve it up front and keep growth retraces out of the
         serving window."""
+        self._floor_blocks = max(self._floor_blocks,
+                                 int(capacity) + 1)
         short = int(capacity) - (self.num_blocks - 1)
         if short > 0:
             self._grow(short)
+
+    def maybe_shrink(self, watermark: float = 0.25) -> int:
+        """Idle-watermark release (ISSUE 16 satellite): when occupancy
+        has fallen to `watermark` or below — a tenant drain — release
+        the pool's FREE TAIL back to the allocator so steady-state HBM
+        stays honest after a burst.  Returns blocks released (0 when
+        the watermark, floor, or geometric hysteresis says no).
+
+        Only the tail [keep, num_blocks) can go: block ids are array
+        positions, so reclaiming interior free blocks would mean
+        compacting live contents and rewriting every owner's table.
+        The release is geometric (at least halving, mirroring _grow's
+        doubling) and never cuts below the reserve()/construction
+        floor — a capacity change retraces every compiled program
+        that touches the pool, so callers gate this on IDLE (the
+        decoder's pump does) and the hysteresis keeps it rare."""
+        capacity = self.num_blocks - 1
+        if capacity <= 0 or self._used > watermark * capacity:
+            return 0
+        keep = self.num_blocks
+        while keep > self._floor_blocks and self._refs[keep - 1] == 0:
+            keep -= 1
+        released = self.num_blocks - keep
+        if released * 2 < self.num_blocks:
+            return 0
+        shrink = _pool_shrink_fn(keep)
+        self.k_pools = shrink(self.k_pools)
+        self.v_pools = shrink(self.v_pools)
+        self._free = [i for i in self._free if i < keep]
+        self._refs = self._refs[:keep]
+        self.num_blocks = keep
+        self.stats["shrinks"] += 1
+        self._publish_gauges()
+        self.logger.info("pool %s shrank by %d blocks to %d",
+                         self.name, released, keep - 1)
+        return released
 
     # -- allocator ---------------------------------------------------------
     def alloc_blocks(self, count: int) -> list:
@@ -240,8 +286,11 @@ class BlockPool:
         # alloc/release land here once per pump-path transition: an
         # O(num_blocks) used_blocks() scan per one-block allocation
         # would grow per-round host work with pool capacity
-        self._gauge_total.set(self.num_blocks - 1)
+        capacity = self.num_blocks - 1
+        self._gauge_total.set(capacity)
         self._gauge_used.set(self._used)
+        self._gauge_occupancy.set(
+            self._used / capacity if capacity else 0.0)
 
     # -- block content movement --------------------------------------------
     def copy_blocks(self, src_ids, dst_ids) -> int:
@@ -312,6 +361,15 @@ def _pool_grow_fn(old_n: int, new_n: int):
     return jax.jit(grow, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=32)
+def _pool_shrink_fn(new_n: int):
+    def shrink(pools):
+        return [jax.tree.map(lambda leaf: leaf[:new_n], pool)
+                for pool in pools]
+
+    return jax.jit(shrink, donate_argnums=(0,))
+
+
 @functools.lru_cache(maxsize=8)
 def _copy_blocks_fn(config: LlamaConfig, kv_int8: bool):
     def copy(pools, src, dst):
@@ -358,6 +416,89 @@ def _gather_views(pools, tables, t_cap: int) -> list:
             for pool in pools]
 
 
+# -- pallas kernel attention (ISSUE 16) ---------------------------------------
+# AIKO_DECODE_ATTENTION=paged_kernel swaps the gather+shared-body
+# attention for ops.paged_attention.paged_decode_attention: the pool
+# leaves and the round table go to the kernel directly, so the
+# slot-major [S, H, T, D] gather never materializes.  The gather path
+# stays the bit-parity ORACLE — tests prove greedy token identity per
+# (int8 × chunked × spec × block size) combination, and the kernel
+# builders key their lru caches on the toggle so both variants coexist
+# in one process (tools/ab_decode_attention.py flips per case).
+
+def _table_cap(tables, block_tokens: int, t_cap: int):
+    """Slice a round table to the blocks covering t_cap — an int32
+    table slice, not a KV gather; the kernel masks positions against
+    entry_lengths natively, so this is the only t_cap handling the
+    kernel path needs."""
+    return tables[:, :-(-t_cap // block_tokens)]
+
+
+def _kernel_grouped_attention(layer, config: LlamaConfig, x, cos, sin,
+                              k_pool, v_pool, tables, k_side, v_side,
+                              entry_lengths, lengths, write_index,
+                              side_valid):
+    """Kernel-path sibling of serving._grouped_block_attention: the
+    same QKV projection / rope / side-buffer write, then the fused
+    paged kernel instead of the gathered-view einsums.  `side_valid`
+    is the caller's per-query mask in its compact [S, W, P] form (the
+    kernel broadcasts it over heads and groups) — one kernel serves
+    the plain scan (W=1) and the widened speculative verify
+    (W=1+k)."""
+    from .ops.paged_attention import paged_decode_attention
+    from .serving import _project_qkv
+    num_heads, num_kv = config.num_heads, config.num_kv_heads
+    q, k, v = _project_qkv(layer, config, x)
+    q = L.apply_rope(q, cos, sin, lengths)
+    k = L.apply_rope(k, cos, sin, lengths)
+    k_side = jax.lax.dynamic_update_slice_in_dim(k_side, k,
+                                                 write_index, axis=2)
+    v_side = jax.lax.dynamic_update_slice_in_dim(v_side, v,
+                                                 write_index, axis=2)
+    slots_n, num_q, head_dim = q.shape[0], q.shape[2], q.shape[3]
+    group = num_heads // num_kv
+    q_grouped = q.reshape(slots_n, num_kv, group * num_q, head_dim)
+    out = paged_decode_attention(q_grouped, k_pool, v_pool, tables,
+                                 k_side, v_side, side_valid,
+                                 entry_lengths, groups=group)
+    out = out.reshape(slots_n, num_heads, num_q,
+                      head_dim).astype(x.dtype)
+    return (L.linear(layer["attn"]["o"], L._merge_heads(out)),
+            k_side, v_side)
+
+
+def _kernel_attention_block(tables, layer, config: LlamaConfig, x,
+                            cos, sin, k_pool, v_pool, k_side, v_side,
+                            entry_lengths, lengths, step_index):
+    """Kernel sibling of serving._slot_attention_block — the same side
+    mask, in [S, 1, P] form."""
+    side_positions = jnp.arange(k_side.shape[2])
+    side_valid = ((side_positions[None] <= step_index) &
+                  (side_positions[None] <
+                   (lengths - entry_lengths + 1)[:, None]))[:, None, :]
+    return _kernel_grouped_attention(layer, config, x, cos, sin,
+                                     k_pool, v_pool, tables, k_side,
+                                     v_side, entry_lengths, lengths,
+                                     step_index, side_valid)
+
+
+def _kernel_attention_spec(tables, layer, config: LlamaConfig, x, cos,
+                           sin, k_pool, v_pool, k_side, v_side,
+                           pos_side, entry_lengths, lengths, base):
+    """Kernel sibling of serving._slot_attention_spec: the in-kernel
+    speculative verify is just the same kernel at W = 1 + k with the
+    pos_side <= q_pos causal mask — no second variant.  Signature
+    matches _slot_attention_spec after the leading `tables` partial,
+    so serving._spec_scan_body takes it via its attention= seam."""
+    width = x.shape[1]
+    q_pos = lengths[:, None] + jnp.arange(width)[None]       # [S, w]
+    side_valid = pos_side[:, None, :] <= q_pos[:, :, None]   # [S,w,P]
+    return _kernel_grouped_attention(layer, config, x, cos, sin,
+                                     k_pool, v_pool, tables, k_side,
+                                     v_side, entry_lengths, lengths,
+                                     base, side_valid)
+
+
 def _paged_scatter(pools, tables, positions, live, sides, kv_int8,
                    block_tokens: int):
     """Scatter side-buffer rows into pool blocks at absolute
@@ -378,14 +519,20 @@ def _paged_scatter(pools, tables, positions, live, sides, kv_int8,
     return out
 
 
-def _build_paged_step(config: LlamaConfig):
+def _build_paged_step(config: LlamaConfig, kernel: bool = False):
     """Paged sibling of serving._build_step's block-KV variant: gather
     the slot-major KV views from the pool (once — the main cache is
     read-only through the scan), run the IDENTICAL scan body
     (_slot_attention_block owns the numerics), and merge the round's
     side buffers back by (block, offset) scatter.  t_cap is static and
     equals the dense path's cache time extent, so every einsum shape
-    matches the dense program exactly."""
+    matches the dense program exactly.
+
+    kernel=True swaps the gather + shared attention body for the
+    fused pallas kernel reading pool blocks through the table
+    (_kernel_attention_block); the scan structure, side buffers, and
+    merge are unchanged, and the gather path remains the parity
+    oracle."""
     from .serving import _slot_attention_block, _token_block_argmax
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
@@ -394,8 +541,12 @@ def _build_paged_step(config: LlamaConfig):
              v_pools, tables, num_steps, eos, t_cap):
         block_tokens = \
             jax.tree_util.tree_leaves(k_pools[0])[0].shape[2]
-        k_caches = _gather_views(k_pools, tables, t_cap)
-        v_caches = _gather_views(v_pools, tables, t_cap)
+        if kernel:
+            k_caches = v_caches = None
+            cap_tables = _table_cap(tables, block_tokens, t_cap)
+        else:
+            k_caches = _gather_views(k_pools, tables, t_cap)
+            v_caches = _gather_views(v_pools, tables, t_cap)
         entry_lengths = lengths
         entry_active = active
         slots_n = tokens.shape[0]
@@ -411,10 +562,17 @@ def _build_paged_step(config: LlamaConfig):
             new_k, new_v = [], []
 
             def attend(i, layer, normed):
-                attn_out, k_s, v_s = _slot_attention_block(
-                    layer, config, normed, cos, sin, k_caches[i],
-                    v_caches[i], k_sides[i], v_sides[i],
-                    entry_lengths, lengths, step_index)
+                if kernel:
+                    attn_out, k_s, v_s = _kernel_attention_block(
+                        cap_tables, layer, config, normed, cos, sin,
+                        k_pools[i], v_pools[i], k_sides[i],
+                        v_sides[i], entry_lengths, lengths,
+                        step_index)
+                else:
+                    attn_out, k_s, v_s = _slot_attention_block(
+                        layer, config, normed, cos, sin, k_caches[i],
+                        v_caches[i], k_sides[i], v_sides[i],
+                        entry_lengths, lengths, step_index)
                 new_k.append(k_s)
                 new_v.append(v_s)
                 return attn_out
@@ -455,20 +613,27 @@ def _build_paged_step(config: LlamaConfig):
 
 
 @functools.lru_cache(maxsize=16)
-def _paged_step_for(config: LlamaConfig):
-    """Process-wide builder cache, like serving._step_for."""
-    return _build_paged_step(config)
+def _paged_step_for(config: LlamaConfig, kernel: bool = False):
+    """Process-wide builder cache, like serving._step_for.  Keyed on
+    the kernel toggle so the pallas variant and the gather oracle
+    coexist in one process (parity tests, ab_decode_attention)."""
+    return _build_paged_step(config, kernel)
 
 
 def _build_paged_spec_step(config: LlamaConfig, k_spec: int,
-                           ngram: int):
+                           ngram: int, kernel: bool = False):
     """Paged sibling of serving._build_spec_step: the drafting /
     widened verify / acceptance scan body is the SAME object
     (serving._spec_scan_body — shared like _slot_attention_spec and
     _token_block_argmax so the numerics cannot drift) over gathered
     pool views; the round's consumed side entries scatter-merge to
     (block, offset) pairs, rejected drafts dropping via their
-    _POS_INVALID positions exactly as the dense merge drops them."""
+    _POS_INVALID positions exactly as the dense merge drops them.
+
+    kernel=True routes the scan body's attention seam to the fused
+    pallas kernel (_kernel_attention_spec over the pool leaves +
+    table) — the verify stays widened INSIDE the one kernel, so spec
+    mode needs no second pallas variant."""
     from .serving import _POS_INVALID, _spec_scan_body
     cos, sin = L.rope_frequencies(config.head_dim, config.max_seq_len,
                                   config.rope_theta)
@@ -478,8 +643,15 @@ def _build_paged_spec_step(config: LlamaConfig, k_spec: int,
                   k_pools, v_pools, tables, num_steps, eos, t_cap):
         block_tokens = \
             jax.tree_util.tree_leaves(k_pools[0])[0].shape[2]
-        k_caches = _gather_views(k_pools, tables, t_cap)
-        v_caches = _gather_views(v_pools, tables, t_cap)
+        if kernel:
+            k_caches, v_caches = k_pools, v_pools
+            attention = functools.partial(
+                _kernel_attention_spec,
+                _table_cap(tables, block_tokens, t_cap))
+        else:
+            k_caches = _gather_views(k_pools, tables, t_cap)
+            v_caches = _gather_views(v_pools, tables, t_cap)
+            attention = None
         entry_lengths = lengths
         slots_n = tokens.shape[0]
         side_len = num_steps * width
@@ -493,7 +665,7 @@ def _build_paged_spec_step(config: LlamaConfig, k_spec: int,
                             jnp.int32)
         body = _spec_scan_body(config, cos, sin, k_spec, ngram,
                                params, eos, k_caches, v_caches,
-                               entry_lengths)
+                               entry_lengths, attention=attention)
 
         (tokens, lengths, active, budgets, context, k_sides, v_sides,
          pos_side), (emitted, emit_mask) = jax.lax.scan(
@@ -516,8 +688,9 @@ def _build_paged_spec_step(config: LlamaConfig, k_spec: int,
 
 
 @functools.lru_cache(maxsize=16)
-def _paged_spec_step_for(config: LlamaConfig, k_spec: int, ngram: int):
-    return _build_paged_spec_step(config, k_spec, ngram)
+def _paged_spec_step_for(config: LlamaConfig, k_spec: int, ngram: int,
+                         kernel: bool = False):
+    return _build_paged_spec_step(config, k_spec, ngram, kernel)
 
 
 @functools.lru_cache(maxsize=64)
@@ -576,14 +749,25 @@ def _paged_admit_fn_for(config: LlamaConfig, bucket: int, width: int,
 
 @functools.lru_cache(maxsize=64)
 def _paged_extend_fn_for(config: LlamaConfig, chunk_len: int,
-                         width: int, kv_int8: bool, speculative: bool):
+                         width: int, kv_int8: bool, speculative: bool,
+                         kernel: bool = False):
     """Paged sibling of serving._extend_fn_for: the prefix reads come
     from a gathered pool view (sliced to the dense t_cap so the
     attention shapes — and therefore the greedy numerics — match the
     dense program exactly), and only the chunk's positions scatter
     back.  int8 prefixes dequantize for the attention read and the
     chunk stores quantized, exactly like dense — untouched positions
-    are never re-rounded because they are never rewritten at all."""
+    are never re-rounded because they are never rewritten at all.
+
+    kernel=True reads the prefix through the pallas kernel instead of
+    gathering: the chunk's own K/V ride as the kernel's side buffer
+    with a causal triangle mask (the chunk must NOT round-trip through
+    the pool before attention — the oracle attends the exact compute-
+    dtype rows, then stores quantized), the prefix mask is t < offset
+    (positions the pool actually owns; the chunk covers [offset,
+    offset + chunk)), and int8 prefixes dequantize INSIDE the kernel
+    (fold_scales=False) to match the oracle's dequantize-then-dot
+    numerics bit-for-bit."""
     cos, sin = L.rope_frequencies(config.head_dim,
                                   config.max_seq_len,
                                   config.rope_theta)
@@ -604,6 +788,13 @@ def _paged_extend_fn_for(config: LlamaConfig, chunk_len: int,
                 q_pos[:, :, None])[:, None, None]
         scale = 1.0 / jnp.sqrt(jnp.asarray(config.head_dim,
                                            jnp.float32))
+        if kernel:
+            cap_tables = _table_cap(tables_rows, block_tokens, t_cap)
+            # per-query chunk causality: side position p is visible to
+            # chunk query c iff p <= c (both offset-relative)
+            tri = jnp.broadcast_to(
+                jnp.tril(jnp.ones((chunk_len, chunk_len), bool))[None],
+                (x.shape[0], chunk_len, chunk_len))
         nbt = tables_rows.shape[1]
         blocks = q_pos // block_tokens
         block_offsets = q_pos % block_tokens
@@ -628,32 +819,45 @@ def _paged_extend_fn_for(config: LlamaConfig, chunk_len: int,
                                num_kv)
             q = L.apply_rope(q, cos, sin, offsets)
             k = L.apply_rope(k, cos, sin, offsets)
-            gathered_k = _slice_time(
-                L.gather_paged_kv(k_pools[i], tables_rows), t_cap)
-            gathered_v = _slice_time(
-                L.gather_paged_kv(v_pools[i], tables_rows), t_cap)
-            if kv_int8:
-                k_rows = write_rows(
-                    L.dequantize_kv_cache(gathered_k, x.dtype), k,
-                    offsets)
-                v_rows = write_rows(
-                    L.dequantize_kv_cache(gathered_v, x.dtype), v,
-                    offsets)
+            if kernel:
+                from .ops.paged_attention import \
+                    paged_decode_attention
+                q_grouped = q.reshape(q.shape[0], num_kv,
+                                      group * chunk_len,
+                                      config.head_dim)
+                out = paged_decode_attention(
+                    q_grouped, k_pools[i], v_pools[i], cap_tables,
+                    k, v, tri, offsets, groups=group,
+                    fold_scales=False)
+                out = out.reshape(out.shape[0], num_heads, chunk_len,
+                                  config.head_dim).astype(x.dtype)
             else:
-                k_rows = write_rows(gathered_k, k, offsets)
-                v_rows = write_rows(gathered_v, v, offsets)
-            q_grouped = q.reshape(q.shape[0], num_kv, group,
-                                  chunk_len, config.head_dim)
-            scores = jnp.einsum(
-                "akgcd,aktd->akgct", q_grouped, k_rows,
-                preferred_element_type=jnp.float32) * scale
-            scores = jnp.where(mask, scores, -1e30)
-            weights = jax.nn.softmax(
-                scores, axis=-1).astype(v_rows.dtype)
-            out = jnp.einsum("akgct,aktd->akgcd", weights, v_rows,
-                             preferred_element_type=jnp.float32)
-            out = out.reshape(out.shape[0], num_heads, chunk_len,
-                              config.head_dim).astype(x.dtype)
+                gathered_k = _slice_time(
+                    L.gather_paged_kv(k_pools[i], tables_rows), t_cap)
+                gathered_v = _slice_time(
+                    L.gather_paged_kv(v_pools[i], tables_rows), t_cap)
+                if kv_int8:
+                    k_rows = write_rows(
+                        L.dequantize_kv_cache(gathered_k, x.dtype), k,
+                        offsets)
+                    v_rows = write_rows(
+                        L.dequantize_kv_cache(gathered_v, x.dtype), v,
+                        offsets)
+                else:
+                    k_rows = write_rows(gathered_k, k, offsets)
+                    v_rows = write_rows(gathered_v, v, offsets)
+                q_grouped = q.reshape(q.shape[0], num_kv, group,
+                                      chunk_len, config.head_dim)
+                scores = jnp.einsum(
+                    "akgcd,aktd->akgct", q_grouped, k_rows,
+                    preferred_element_type=jnp.float32) * scale
+                scores = jnp.where(mask, scores, -1e30)
+                weights = jax.nn.softmax(
+                    scores, axis=-1).astype(v_rows.dtype)
+                out = jnp.einsum("akgct,aktd->akgcd", weights, v_rows,
+                                 preferred_element_type=jnp.float32)
+                out = out.reshape(out.shape[0], num_heads, chunk_len,
+                                  config.head_dim).astype(x.dtype)
             x = x + L.linear(layer["attn"]["o"], L._merge_heads(out))
             x = x + llama_ffn(layer, config,
                               L.rms_norm(layer["ln_mlp"], x))

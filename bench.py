@@ -1180,9 +1180,27 @@ LLAMA_PAGED = os.environ.get("AIKO_BENCH_LLAMA_PAGED", "on") \
 # pool/prefix block size as a first-class knob so the r06 sweep can
 # score 32 vs 64 (copy/scatter count vs partial-hit granularity)
 LLAMA_BLOCK = int(os.environ.get("AIKO_BENCH_LLAMA_BLOCK", "32"))
+# fused pallas decode kernel (ISSUE 16): AIKO_BENCH_LLAMA_KERNEL=on
+# swaps the paged path's gather+einsum attention for the block-table-
+# native kernel (ops/paged_attention.py) so BENCH_r06 can A/B the
+# gather deletion on hardware.  Paged-only: combine with
+# AIKO_BENCH_LLAMA_PAGED=on (the default) and any
+# AIKO_BENCH_LLAMA_BLOCK; greedy output is bit-identical either way.
+LLAMA_KERNEL = os.environ.get("AIKO_BENCH_LLAMA_KERNEL", "off") \
+    .lower() in ("on", "1", "true")
+
+
+def _apply_llama_kernel_toggle() -> None:
+    """Latch the decode-attention toggle BEFORE decoder construction —
+    serving reads ATTENTION_IMPL once, at __init__ (builder cache keys
+    include the kernel flag, so both variants coexist in-process)."""
+    if LLAMA_KERNEL:
+        from aiko_services_tpu import serving
+        serving.ATTENTION_IMPL = "paged_kernel"
 
 
 def _llama_decoder_opts() -> dict:
+    _apply_llama_kernel_toggle()
     return {
         "kv_cache_dtype": None if LLAMA_KV_DTYPE in
         ("", "native", "bf16") else LLAMA_KV_DTYPE,
@@ -1197,6 +1215,8 @@ def _llama_pool_fields(decoder, prefix: str) -> dict:
     bytes, and the copy counters the paged path zeroes."""
     fields = {
         f"{prefix}_kv_paged": bool(decoder.paged),
+        f"{prefix}_kernel": bool(decoder.paged
+                                 and decoder.paged_kernel),
         f"{prefix}_kv_block": decoder.kv_block,
         f"{prefix}_prefix_copy_bytes":
             decoder.stats["prefix_copy_bytes"],
@@ -1563,6 +1583,7 @@ def bench_llama_conversation(window: float = 10.0):
         else LLAMA_BLOCK
     cache = None if prefix_off else PrefixKVCache(
         block_tokens=block, max_bytes=2 << 30, name="bench_conv")
+    _apply_llama_kernel_toggle()
     slots, sps, max_new = 16, 8, 32
     transcript, turns_per_session, user_len = 600, 6, 24
     decoder = ContinuousDecoder(params, config, max_slots=slots,

@@ -579,6 +579,46 @@ class TestLint:
         assert not any(f.rule == "lint-paged-free"
                        for f in lint_source(source, "element.py"))
 
+    # -- lint-pallas-fallback (ISSUE 16) -----------------------------------
+    def test_bare_pallas_call_flagged(self):
+        # a kernel site without the interpret seam is hardware-only
+        # dead weight in CI: tier-1 must run the same kernel code path
+        rules = self._rules_at(
+            "def attention(q, k, v):\n"
+            "    return pl.pallas_call(kernel,\n"
+            "                          out_shape=shape)(q, k, v)\n")
+        assert ("lint-pallas-fallback", 2) in rules
+
+    def test_pallas_call_with_interpret_exempt(self):
+        rules = self._rules_at(
+            "def attention(q, k, v, interpret=None):\n"
+            "    if interpret is None:\n"
+            "        interpret = jax.default_backend() != 'tpu'\n"
+            "    return pl.pallas_call(kernel, out_shape=shape,\n"
+            "                          interpret=interpret)(q, k, v)\n")
+        assert not any(r == "lint-pallas-fallback" for r, _ in rules)
+
+    def test_pallas_fallback_waiver(self):
+        source = ("def attention(q):\n"
+                  "    # audited: TPU-only microbench"
+                  "  # graft: disable=lint-pallas-fallback\n"
+                  "    return pl.pallas_call(kernel)(q)\n")
+        assert not any(f.rule == "lint-pallas-fallback"
+                       for f in lint_source(source, "element.py"))
+
+    def test_package_kernel_sites_carry_fallback_seam(self):
+        # the audit the rule encodes: every pallas_call already in the
+        # package (ops/attention.py's two kernels and the ISSUE 16
+        # paged-attention kernel) dispatches through interpret=
+        import pathlib
+
+        import aiko_services_tpu
+        from aiko_services_tpu.analysis.lint import lint_paths
+        pkg = pathlib.Path(aiko_services_tpu.__file__).parent
+        findings = [f for f in lint_paths([pkg / "ops"])
+                    if f.rule == "lint-pallas-fallback"]
+        assert findings == []
+
 
 # ---------------------------------------------------------------------------
 # wire codec legality table

@@ -5,6 +5,12 @@
 # ZERO KV bytes (aliasing, not copying), harvest must be
 # refcount-only, copy-on-extend must protect shared blocks, and the
 # pool's refcounts must drain to zero live blocks after every retire.
+#
+# ISSUE 16 adds the fused pallas decode kernel: TestPagedKernelParity
+# proves the kernel path (interpret mode on CPU — the same kernel code
+# that compiles on TPU) emits greedy tokens identical to the gather
+# oracle across the same matrix, and that its traced step contains no
+# _gather_views materialization.
 
 import dataclasses
 
@@ -13,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import aiko_services_tpu.serving as serving
 from aiko_services_tpu.models.llama import (LLAMA_PRESETS,
                                             llama_greedy_decode,
                                             llama_init)
@@ -85,6 +92,36 @@ REQUESTS = {"a": (PROMPT, 10), "b": (PROMPT[:17] + [3, 4], 8)}
 MIDSTREAM = {"mid": (PROMPT[:9] + [7], 6)}
 
 
+def paged_at(params, impl, block=8, cache=None, **kwargs):
+    """One paged decoder with the decode-attention toggle latched to
+    `impl` at construction (the only moment serving reads it)."""
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("prefill_buckets", (64,))
+    kwargs.setdefault("steps_per_sync", 4)
+    before = serving.ATTENTION_IMPL
+    serving.ATTENTION_IMPL = impl
+    try:
+        return ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                 kv_block=block, prefix_cache=cache,
+                                 **kwargs)
+    finally:
+        serving.ATTENTION_IMPL = before
+
+
+def kernel_pair(params, block=8, cache=False, **kwargs):
+    """(gather-oracle paged decoder, pallas-kernel paged decoder)."""
+    if not cache:
+        return (paged_at(params, "two_pass", block, **kwargs),
+                paged_at(params, "paged_kernel", block, **kwargs))
+    _SEQ[0] += 1
+    caches = [PrefixKVCache(block_tokens=block, max_bytes=64 << 20,
+                            name=f"kp{_SEQ[0]}{tag}")
+              for tag in ("o", "k")]
+    return (paged_at(params, "two_pass", block, caches[0], **kwargs),
+            paged_at(params, "paged_kernel", block, caches[1],
+                     **kwargs), caches[0], caches[1])
+
+
 # -- parity matrix ----------------------------------------------------------
 
 class TestPagedParity:
@@ -128,6 +165,135 @@ class TestPagedParity:
         reqs = {"a": (PROMPT, 30), "b": (PROMPT[:11], 30)}
         assert run(dense, reqs) == run(paged, reqs)
         assert paged.pool.used_blocks() == 0
+
+
+# -- fused pallas kernel vs gather oracle (ISSUE 16) ------------------------
+
+class TestPagedKernelParity:
+    """Greedy TOKEN identity between the pallas kernel (interpret mode
+    on CPU) and the XLA gather oracle — the acceptance matrix: int8 x
+    chunked prefill x speculation x mid-stream admits x block sizes.
+    Float bit-equality is NOT the claim (the kernel's blockwise dots
+    associate differently); emitted-token identity per combination is."""
+
+    def test_native_with_midstream_admit(self, params):
+        oracle_d, kernel_d = kernel_pair(params)
+        assert kernel_d.paged_kernel and not oracle_d.paged_kernel
+        out_o = run(oracle_d, REQUESTS, midstream=MIDSTREAM)
+        out_k = run(kernel_d, REQUESTS, midstream=MIDSTREAM)
+        assert out_o == out_k
+        assert out_k["a"] == oracle(params, PROMPT, 10)
+        assert kernel_d.pool.used_blocks() == 0   # drain audit
+
+    def test_int8(self, params):
+        oracle_d, kernel_d = kernel_pair(params, kv_cache_dtype="int8")
+        assert run(oracle_d, REQUESTS) == run(kernel_d, REQUESTS)
+        assert kernel_d.pool.used_blocks() == 0
+
+    def test_speculative(self, params):
+        # the (1+k)-token verify widens INSIDE the kernel (W = 1+k):
+        # same kernel, no second variant
+        oracle_d, kernel_d = kernel_pair(params, speculate_k=2)
+        assert run(oracle_d, REQUESTS) == run(kernel_d, REQUESTS)
+        assert kernel_d.pool.used_blocks() == 0
+
+    @pytest.mark.parametrize("block", [32, 64])
+    def test_block_sizes(self, params, block):
+        oracle_d, kernel_d = kernel_pair(params, block=block)
+        assert run(oracle_d, REQUESTS) == run(kernel_d, REQUESTS)
+        assert kernel_d.pool.used_blocks() == 0
+
+    def test_int8_chunked_prefill(self, params):
+        # the delicate leg: the extend oracle DEQUANTIZES then dots
+        # (fold_scales=False in the kernel), and any drift compounds
+        # through the stored chunk KV
+        oracle_d, kernel_d = kernel_pair(params, kv_cache_dtype="int8",
+                                         prefill_chunk=16)
+        long = {"long": ((PROMPT * 3)[:80], 8)} | REQUESTS
+        assert run(oracle_d, long) == run(kernel_d, long)
+        assert kernel_d.pool.used_blocks() == 0
+
+    @pytest.mark.slow
+    def test_spec_int8_chunked(self, params):
+        oracle_d, kernel_d = kernel_pair(params, speculate_k=2,
+                                         kv_cache_dtype="int8",
+                                         prefill_chunk=16)
+        out_o = run(oracle_d, REQUESTS, midstream=MIDSTREAM)
+        out_k = run(kernel_d, REQUESTS, midstream=MIDSTREAM)
+        assert out_o == out_k
+        assert kernel_d.pool.used_blocks() == 0
+
+    def test_copy_on_extend_shared_blocks(self, params):
+        # the PR 13 slide-back shape over SHARED blocks, kernel mode:
+        # a cached chain is hit, the final chunk slides back into it,
+        # copy-on-extend must fire and the kernel must read the copied
+        # block — warm output stays identical to the oracle's cold run
+        long_prompt = [(i * 7) % 50 + 1 for i in range(95)]
+        oracle_d, kernel_d, _, kcache = kernel_pair(params, cache=True,
+                                                    prefill_chunk=16)
+        cold = run(oracle_d, {"cold": (long_prompt, 1)})["cold"]
+        for probe in ("w1", "w2", "w3"):
+            warm = run(kernel_d, {probe: (long_prompt, 1)})[probe]
+            assert warm == cold, probe
+        assert kernel_d.pool.stats["cow_copies"] >= 1
+        assert kernel_d.pool.used_blocks() == len(kcache)
+
+    def test_disagg_installed_chain(self, params):
+        # blocks shipped from a dense donor land via
+        # install_shipped_blocks and the kernel reads the installed
+        # chain through its table — TestDirectInstall with kernel on
+        donor_cache = PrefixKVCache(block_tokens=8,
+                                    max_bytes=64 << 20, name="kdd")
+        donor = ContinuousDecoder(params, CONFIG,
+                                  prefix_cache=donor_cache,
+                                  max_slots=4, prefill_buckets=(64,),
+                                  steps_per_sync=4)
+        run(donor, {"donor": (PROMPT, 1)})
+        kernel_d = paged_at(params, "paged_kernel", prefill_chunk=16)
+        keys, hit = donor_cache.match("", PROMPT)
+        blocks = []
+        for node in donor_cache.nodes(keys):
+            k_rows, v_rows = donor_cache.block_rows(node)
+            blocks.append({"k": [np.asarray(r) for r in k_rows],
+                           "v": [np.asarray(r) for r in v_rows]})
+        covered, ids = kernel_d.install_shipped_blocks(PROMPT, 0,
+                                                       blocks)
+        assert covered == hit == len(ids) * 8
+        done = {}
+        assert kernel_d.submit("direct", PROMPT, 10,
+                               lambda r, t: done.update({r: t}),
+                               kv_blocks=(covered, ids))
+        for _ in range(400):
+            kernel_d.pump()
+            if "direct" in done:
+                break
+        assert done["direct"] == oracle(params, PROMPT, 10)
+        assert kernel_d.stats["prefix_copy_bytes"] == 0
+        assert kernel_d.pool.used_blocks() == 0
+
+    def test_traced_step_has_no_gather(self, params, monkeypatch):
+        # the acceptance clause "no [S,H,T,D] gather in the kernel
+        # path's traced step", checked at the trace itself: lower both
+        # fresh-built steps and count _gather_views calls
+        from aiko_services_tpu import serving_paged
+        calls = []
+        real = serving_paged._gather_views
+        monkeypatch.setattr(
+            serving_paged, "_gather_views",
+            lambda *a, **k: calls.append(1) or real(*a, **k))
+        pools = [jnp.zeros((9, CONFIG.num_kv_heads, 8,
+                            CONFIG.head_dim), CONFIG.dtype)
+                 for _ in range(CONFIG.num_layers)]
+        arrays = (jnp.ones((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+                  jnp.ones((2,), bool), jnp.full((2,), 8, jnp.int32),
+                  pools, pools,
+                  jnp.zeros((2, 4), jnp.int32))
+        serving_paged._build_paged_step(CONFIG, kernel=True).lower(
+            params, *arrays, num_steps=4, eos=-1, t_cap=32)
+        assert calls == []                   # kernel path: gather-free
+        serving_paged._build_paged_step(CONFIG, kernel=False).lower(
+            params, *arrays, num_steps=4, eos=-1, t_cap=32)
+        assert calls                         # oracle still gathers
 
 
 # -- zero-copy prefix hits --------------------------------------------------
@@ -270,6 +436,53 @@ class TestBlockPool:
         assert pool._used == 0
         with pytest.raises(ValueError):
             pool.release_blocks([ids[0]])    # double free is loud
+
+    def test_idle_watermark_shrink_after_drain(self, params):
+        # ISSUE 16 satellite: a burst grows the pool; after the tenant
+        # drains, maybe_shrink returns the free tail so steady-state
+        # HBM stays honest — but never below the construction floor,
+        # never while occupied, and only past the geometric hysteresis
+        from aiko_services_tpu.serving_paged import BlockPool
+        pool = BlockPool(CONFIG, 8, False, initial_blocks=4,
+                         grow_blocks=4, name="shrink")
+        floor = pool.num_blocks
+        ids = pool.alloc_blocks(40)          # burst: forces growth
+        grown = pool.num_blocks
+        assert grown > floor
+        assert pool.maybe_shrink() == 0      # occupied: watermark says no
+        assert pool.num_blocks == grown
+        pool.release_blocks(ids)
+        released = pool.maybe_shrink()       # drained: tail goes back
+        assert released > 0
+        assert pool.num_blocks == grown - released == floor
+        assert pool.stats["shrinks"] == 1
+        assert pool.used_blocks() == 0 and pool._used == 0
+        assert pool.occupancy() == 0.0
+        # the shrunk pool still serves: realloc regrows cleanly
+        again = pool.alloc_blocks(6)
+        assert len(set(again)) == 6 and 0 not in again
+        pool.release_blocks(again)
+        # hysteresis: a trivial free tail (< half the pool) is kept
+        small = pool.alloc_blocks(2)
+        pool.release_blocks(small)
+        assert pool.maybe_shrink() == 0 or \
+            pool.num_blocks >= floor         # never below the floor
+
+    def test_shrink_respects_retained_tail(self, params):
+        # a cache-retained block in the tail stops the scan: shrink
+        # releases only the free run ABOVE the highest live block
+        from aiko_services_tpu.serving_paged import BlockPool
+        pool = BlockPool(CONFIG, 8, False, initial_blocks=4,
+                         grow_blocks=4, name="shrink2")
+        ids = pool.alloc_blocks(40)
+        keep = max(ids)                      # pin the tail block
+        pool.retain([keep])
+        pool.release_blocks(ids)
+        assert pool.maybe_shrink() == 0      # tail pinned: nothing moves
+        assert pool.refs(keep) == 1
+        pool.release_blocks([keep])
+        assert pool.maybe_shrink() > 0
+        assert pool.used_blocks() == 0
 
     def test_kv_cache_bytes_models_pool(self, params):
         _, paged = pair(params)

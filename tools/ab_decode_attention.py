@@ -1,10 +1,16 @@
 # A/B the decode-attention inner loop IN-PROGRAM (serving._build_step,
 # the exact compiled step the ContinuousDecoder runs): two_pass
 # (score/weight einsums) vs online (flash-style single sweep) vs vpu
-# (broadcast-multiply reductions).  Microbenchmark wins do not survive
-# program context (measured on the int8-KV lever: +35% isolated, -24%
-# fused), so the only number that counts is the chained full-step time
-# at the serving shape.
+# (broadcast-multiply reductions), plus the paged-pool pair —
+# gather-oracle vs the fused pallas kernel (ISSUE 16) — so BENCH_r06
+# can price the gather deletion at the serving shape.  Microbenchmark
+# wins do not survive program context (measured on the int8-KV lever:
+# +35% isolated, -24% fused), so the only number that counts is the
+# chained full-step time at the serving shape.
+#
+# Any case that errors is reported AND fails the run (exit 1): PR 7's
+# signature change silently broke all four cases for a whole bench
+# round because the harness swallowed the exceptions.
 #
 #   python tools/ab_decode_attention.py [preset] [slots] [cache_t]
 
@@ -63,22 +69,84 @@ def measure(impl: str, preset: str, slots: int, cache_t: int,
     return best * 1000.0
 
 
+def measure_paged(kernel: bool, preset: str, slots: int, cache_t: int,
+                  num_steps: int = 64, chains: int = 4,
+                  block_tokens: int = 32) -> float:
+    """Chained paged-step time: gather oracle (kernel=False) vs the
+    fused pallas kernel reading pool blocks through the table."""
+    from aiko_services_tpu import serving_paged
+    from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+
+    config = dataclasses.replace(LLAMA_PRESETS[preset],
+                                 dtype=jnp.bfloat16, max_seq_len=1024)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    step = serving_paged._paged_step_for(config, kernel)
+    nb = -(-cache_t // block_tokens)
+    pool_shape = (1 + slots * nb, config.num_kv_heads, block_tokens,
+                  config.head_dim)
+    k = [jnp.zeros(pool_shape, config.dtype)
+         for _ in range(config.num_layers)]
+    v = [jnp.zeros(pool_shape, config.dtype)
+         for _ in range(config.num_layers)]
+    # block 0 is the pool's null block; each slot owns a contiguous run
+    tables = (1 + jnp.arange(slots * nb, dtype=jnp.int32)
+              ).reshape(slots, nb)
+    tokens = jnp.ones((slots,), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    active = jnp.ones((slots,), bool)
+    budgets = jnp.full((slots,), 1 << 30, jnp.int32)
+
+    def chain(rounds):
+        nonlocal tokens, lengths, k, v
+        out = None
+        for _ in range(rounds):
+            out = step(params, tokens, lengths, active, budgets, k, v,
+                       tables, num_steps=num_steps, eos=-1,
+                       t_cap=cache_t)
+            _, _, tokens, lengths, k, v = out
+        np.asarray(out[0][-1])
+    chain(1)
+    best = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        chain(chains)
+        best = min(best, (time.perf_counter() - start) /
+                   (chains * num_steps))
+    return best * 1000.0
+
+
 def main() -> None:
     preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
     slots = int(sys.argv[2]) if len(sys.argv) > 2 else 256
     cache_t = int(sys.argv[3]) if len(sys.argv) > 3 else 256
-    cases = [("two_pass", "select"), ("online", "select"),
-             ("vpu", "select"), ("two_pass", "block")]
-    for impl, kv_write in cases:
-        label = f"{impl}/{kv_write}"
+    cases = [
+        ("two_pass/select",
+         lambda: measure("two_pass", preset, slots, cache_t)),
+        ("online/select",
+         lambda: measure("online", preset, slots, cache_t)),
+        ("vpu/select",
+         lambda: measure("vpu", preset, slots, cache_t)),
+        ("two_pass/block",
+         lambda: measure("two_pass", preset, slots, cache_t,
+                         kv_write="block")),
+        ("paged/gather",
+         lambda: measure_paged(False, preset, slots, cache_t)),
+        ("paged/kernel",
+         lambda: measure_paged(True, preset, slots, cache_t)),
+    ]
+    failed = []
+    for label, case in cases:
         try:
-            ms = measure(impl, preset, slots, cache_t,
-                         kv_write=kv_write)
+            ms = case()
             print(f"{label:17s}: {ms:.3f} ms/step "
                   f"({preset}, {slots} slots, cache {cache_t})")
         except Exception as exc:
             print(f"{label:17s}: FAILED {exc!r}")
+            failed.append(label)
         jax.clear_caches()
+    if failed:
+        print(f"FAILED cases: {', '.join(failed)}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":
