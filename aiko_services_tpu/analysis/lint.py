@@ -115,6 +115,19 @@
 #                         silently skipping it — a bare pallas_call is
 #                         hardware-only dead weight in CI and a crash
 #                         on the CPU fallback path
+#   lint-host-transfer    device↔host copies of KV pool-block rows
+#                         (jax.device_put / np.asarray / np.array of
+#                         block_rows()/k_rows/v_rows/k_pools/v_pools
+#                         expressions) inside event-handler or
+#                         `graft: hot-path` contexts: a tier crossing
+#                         is milliseconds of synchronous copy per
+#                         block — on the event loop it stalls every
+#                         decode round in the process.  Tier moves go
+#                         through the prefetcher seam (the tiered
+#                         cache's AsyncPromoter worker stages off-loop
+#                         and the loop installs staged arrays), never
+#                         inline in a handler; audited exceptions
+#                         carry per-line waivers
 #   lint-unbounded-cache  dict/OrderedDict CACHES mutated from
 #                         event-handler or `graft: hot-path` contexts
 #                         with no eviction on the same receiver: a
@@ -156,11 +169,22 @@ LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
               "lint-print", "lint-unbounded-queue",
               "lint-unbounded-cache", "lint-linear-timer",
               "lint-metric-label", "lint-wall-clock",
-              "lint-paged-free", "lint-pallas-fallback")
+              "lint-paged-free", "lint-pallas-fallback",
+              "lint-host-transfer")
 
 # block-pool allocator call tails (lint-paged-free): the returned ids
 # are the only refcount handle — a discarded result is a leak
 _POOL_ALLOC_TAILS = {"alloc_blocks", "alloc_block"}
+
+# device<->host transfer calls applied to KV pool-block rows
+# (lint-host-transfer, ISSUE 17): tier crossings are synchronous
+# millisecond copies — in a handler they stall every decode round.
+# Matched lexically: a transfer-call tail from these modules whose
+# first argument's source mentions a pool-row expression.
+_TRANSFER_TAILS = {"device_put", "asarray", "array"}
+_TRANSFER_MODULES = {"jax", "np", "numpy", "jnp", "jax.numpy"}
+_POOL_ROW_TOKENS = ("block_rows", "k_rows", "v_rows", "k_pools",
+                    "v_pools")
 
 # wall-epoch clock reads (lint-wall-clock): canonical spellings; call
 # targets are CANONICALIZED through the module's actual time/datetime
@@ -388,6 +412,22 @@ class _ContextScanner(ast.NodeVisitor):
                     f"same receiver: pop/popitem/clear or a len() "
                     f"budget check must bound it, or waive the audited "
                     f"site with `graft: disable=lint-unbounded-cache`")
+        if (self.event or self.hot) and tail in _TRANSFER_TAILS and \
+                node.args and \
+                (target.rpartition(".")[0] in _TRANSFER_MODULES
+                 or target == "device_put"):
+            arg_src = ast.unparse(node.args[0])
+            if any(token in arg_src for token in _POOL_ROW_TOKENS):
+                self.lint.report(
+                    "lint-host-transfer", node,
+                    f"{target}() copies KV pool-block rows across the "
+                    f"device/host boundary in context {self.context!r}: "
+                    f"a tier crossing is a synchronous per-block copy "
+                    f"that stalls every decode round — route it "
+                    f"through the tiered cache's prefetcher seam "
+                    f"(AsyncPromoter stages off-loop, the loop "
+                    f"installs staged arrays) or waive the audited "
+                    f"site with `graft: disable=lint-host-transfer`")
         if self.hot and tail in _ALLOC_TAILS and \
                 target.rpartition(".")[0] in _ALLOC_MODULES:
             self.lint.report(

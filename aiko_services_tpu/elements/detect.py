@@ -314,6 +314,22 @@ class PE_LlamaAgent(PipelineElement):
                     name=self.definition.name)
                 prefill_chunk = int(prefill_chunk) or \
                     int(self.prompt_length)
+                # tiered KV (ISSUE 17): parameter `host_kv_mb` > 0
+                # backs the prefix cache with a host-RAM block store —
+                # session demotion and LRU pressure demote chain
+                # blocks to host instead of forgetting them, and the
+                # admission/session-touch prefetch kicks re-land them
+                # asynchronously before the next turn's admit round
+                host_kv_mb, _ = self.get_parameter("host_kv_mb", 0)
+                if int(host_kv_mb) > 0:
+                    from ..serving_tiered import HostBlockStore
+                    host_tenant_mb, _ = self.get_parameter(
+                        "host_kv_tenant_mb", 0)
+                    self.prefix_cache.attach_host_store(HostBlockStore(
+                        max_bytes=int(float(host_kv_mb) * (1 << 20)),
+                        tenant_max_bytes=int(
+                            float(host_tenant_mb) * (1 << 20)) or None,
+                        name=self.definition.name))
             # paged KV (ISSUE 15): parameter `paged` rebuilds the slot
             # cache as a block pool + per-slot tables — prefix hits
             # alias instead of copying, and the disagg path can land
@@ -346,11 +362,18 @@ class PE_LlamaAgent(PipelineElement):
                     "session_lease", 300.0)
                 session_shards, _ = self.get_parameter(
                     "session_shards", 2)
+                session_idle, _ = self.get_parameter(
+                    "session_idle", 0.0)
+                # tiered cache: expiry/demotion DEMOTE the pinned KV
+                # to the host store (demote-not-forget, ISSUE 17);
+                # without a host store demote_sessions degrades to
+                # release_sessions exactly
                 self._session_table = SessionTable(
                     self.pipeline, num_shards=int(session_shards),
                     lease_time=float(session_lease),
-                    on_expired=self.prefix_cache.release_sessions,
-                    on_demoted=self.prefix_cache.release_sessions)
+                    on_expired=self.prefix_cache.demote_sessions,
+                    on_demoted=self.prefix_cache.demote_sessions,
+                    demote_idle=float(session_idle) or None)
             # disaggregated serving (ISSUE 14): parameter `disagg`
             # routes prompts through a PrefillClient — a role=prefill
             # runtime computes the prompt KV and ships it over the
@@ -440,6 +463,9 @@ class PE_LlamaAgent(PipelineElement):
             self._prefill_client = None
         if self._session_table is not None:
             self._session_table.stop()
+        if self.prefix_cache is not None and \
+                self.prefix_cache.promoter is not None:
+            self.prefix_cache.promoter.stop()
         self.decoder.detach(self.runtime.event)
 
     def _pad_prompt(self, text):
@@ -485,6 +511,13 @@ class PE_LlamaAgent(PipelineElement):
                     history = [int(t) for t in
                                payload.get("history", ())]
             tokens = (history + turn)[-cap:] if history else turn[-cap:]
+            if history and self.prefix_cache is not None and \
+                    self.prefix_cache.tiered:
+                # session touch = the earliest possible promotion kick
+                # (ISSUE 17): a revived conversation's demoted chain
+                # starts re-landing from host RAM NOW, while the turn
+                # is still threading through submit/admission
+                self.prefix_cache.prefetch(tenant, tokens)
 
             def on_done(_rid, generated):
                 if table is not None:

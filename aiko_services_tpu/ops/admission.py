@@ -293,12 +293,20 @@ class DeadlineRouter:
 
     def __init__(self, urgent_budget_s: float = 1.0,
                  name: str = "router",
-                 registry: MetricsRegistry | None = None):
+                 registry: MetricsRegistry | None = None,
+                 on_route=None):
         self.urgent_budget_s = float(urgent_budget_s)
         self.name = str(name)
         self._rr = 0
         self._registry = registry or default_registry()
         self._counters: dict = {}
+        # on_route(candidate, remaining) fires on every successful
+        # verdict — the next-hop seam (ISSUE 17): a tiered KV cache
+        # hangs its promotion prefetch here so host-resident chains
+        # start re-landing the moment a destination is KNOWN, not when
+        # the routed work finally lands.  Failures are swallowed: a
+        # prefetch hook must never turn a route into an exception.
+        self.on_route = on_route
 
     def _count(self, verdict: str) -> None:
         counter = self._counters.get(verdict)
@@ -319,10 +327,17 @@ class DeadlineRouter:
         order = sorted(loads)           # deterministic tie-break
         if remaining is not None and remaining <= self.urgent_budget_s:
             self._count("urgent-least-loaded")
-            return min(order, key=lambda c: (float(loads[c] or 0.0), c))
-        self._count("round-robin")
-        choice = order[self._rr % len(order)]
-        self._rr += 1
+            choice = min(order,
+                         key=lambda c: (float(loads[c] or 0.0), c))
+        else:
+            self._count("round-robin")
+            choice = order[self._rr % len(order)]
+            self._rr += 1
+        if self.on_route is not None:
+            try:
+                self.on_route(choice, remaining)
+            except Exception:
+                pass
         return choice
 
 

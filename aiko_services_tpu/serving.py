@@ -287,6 +287,12 @@ class DecodeRequest:
     # CACHELESS decoder — admit aliases them into the slot's table
     # (ownership transfers to the slot) and prefills only the suffix
     kv_block_ids: list = dataclasses.field(default_factory=list)
+    # chunk-streamed prefill progress (ISSUE 17): invoked (request,
+    # finished) after each chunk extend advances prefill_pos — the
+    # disaggregated PrefillRuntime harvests + ships the newly
+    # complete blocks from here, so transfer overlaps the remaining
+    # prefill compute instead of trailing it
+    progress_callback: object = None
 
 
 def prefix_chain_keys(tenant: str, tokens, block_tokens: int) -> list:
@@ -442,12 +448,19 @@ class PrefixKVCache:
         # pool blocks
         self._pool = None
         self._dense_bound = False
+        # tiered KV (ISSUE 17): an attached HostBlockStore turns
+        # eviction of pool-resident blocks into DEMOTION (rows copy to
+        # host, the chain key survives) and brings the AsyncPromoter's
+        # prefetch/promote seam online — see attach_host_store
+        self._host = None
+        self._promoter = None
         from .observe.metrics import MirroredStats, default_registry
         self._registry = registry or default_registry()
         self.stats = MirroredStats(
             {"hits": 0, "misses": 0, "hit_tokens": 0, "miss_tokens": 0,
              "inserts": 0, "evictions": 0, "insert_refused": 0,
-             "session_handles": 0, "session_released": 0},
+             "session_handles": 0, "session_released": 0,
+             "demoted": 0, "promoted": 0},
             metric="prefix_cache_events_total",
             help="prefix KV cache events by kind",
             registry=self._registry,
@@ -522,6 +535,69 @@ class PrefixKVCache:
                 f"prefix cache {self.name!r} holds dense blocks; "
                 f"cannot switch to paged storage mid-flight")
         self._pool = pool
+
+    def attach_host_store(self, store, promoter=None) -> None:
+        """Bring the host KV tier online (ISSUE 17): pool-resident
+        blocks DEMOTE into `store` instead of vanishing when LRU
+        pressure or the SessionTable's demotion wheel evicts them, and
+        the returned promoter's prefetch/promote_for seam re-lands
+        them ahead of the prompts that need them.  Paged caches only —
+        a dense node's rows never shared a pool geometry to begin
+        with (offload them is a different, uninteresting copy)."""
+        if self._dense_bound:
+            raise ValueError(
+                f"prefix cache {self.name!r} is bound by a dense "
+                f"decoder; the host tier offloads pool blocks")
+        if self._host is not None and self._host is not store:
+            raise ValueError(
+                f"prefix cache {self.name!r} already has host store "
+                f"{self._host.name!r}")
+        self._host = store
+        if self._promoter is None:
+            if promoter is None:
+                from .serving_tiered import AsyncPromoter
+                promoter = AsyncPromoter(self, store,
+                                         registry=self._registry)
+            self._promoter = promoter
+
+    @property
+    def host_store(self):
+        return self._host
+
+    @property
+    def promoter(self):
+        return self._promoter
+
+    @property
+    def tiered(self) -> bool:
+        return self._host is not None
+
+    @property
+    def promotions_ready(self) -> bool:
+        """Hot-path probe: staged async promotions are waiting for
+        poll_promotions() (checked every admit round)."""
+        return self._promoter is not None and self._promoter.ready
+
+    def prefetch(self, tenant: str, tokens) -> int:
+        """Non-blocking promotion kick for this prompt's
+        host-resident chain tail (admission probes, session touches,
+        the disagg client's submit).  No-op without a host tier."""
+        if self._promoter is None:
+            return 0
+        return self._promoter.prefetch(tenant, tokens)
+
+    def poll_promotions(self) -> int:
+        """Land staged async promotions (event loop only)."""
+        if self._promoter is None:
+            return 0
+        return self._promoter.poll()
+
+    def promote_for(self, tenant: str, tokens) -> int:
+        """Admit-time sync fallback: ensure this prompt's promotable
+        chain tail is device-resident before the probe runs."""
+        if self._promoter is None:
+            return 0
+        return self._promoter.promote_for(tenant, tokens)
 
     def insert_block(self, tenant: str, parent: str, key: str,
                      pool_id: int) -> bool:
@@ -772,12 +848,72 @@ class PrefixKVCache:
                 victim = node
                 break
             if victim is None:
+                # all-pinned pressure (ISSUE 17 satellite): every
+                # evictable leaf is session-pinned.  With a host tier
+                # attached, route the pressure into DEMOTION — unpin
+                # and demote the oldest session's chain, then retry —
+                # instead of refusing the insert outright.
+                if self._host is not None and \
+                        self._demote_oldest_session(scope):
+                    continue
                 return
             self._evict(victim)
+
+    def _demote_oldest_session(self, scope: str | None) -> int:
+        """Demote the oldest session handle (scope-matched on a
+        tenant breach) to the host tier; returns blocks freed from
+        the device (0 ends the caller's pressure loop — remaining
+        pins belong to live requests, not idle sessions)."""
+        for tenant, sid in self._sessions:
+            if scope and tenant != scope:
+                continue
+            return self.demote_sessions([(tenant, sid)])
+        return 0
+
+    def demote_sessions(self, pairs) -> int:
+        """Batch demotion matching SessionTable's on_expired /
+        on_demoted callback shape ([(tenant, sid), ...]) — the
+        expiry/demotion wheel's KV trigger (ISSUE 17).  Releases each
+        session's pin, then walks its chain LEAF→ROOT demoting blocks
+        to the host tier; a block still pinned by a live request or
+        shared with another chain ends the walk (it stays device-
+        resident — demotion never breaks a reader).  Without a host
+        store this degrades to release_sessions (unpin only).
+        Returns device blocks demoted."""
+        demoted = 0
+        for tenant, sid in pairs:
+            keys = self._sessions.pop(
+                (str(tenant or "default"), str(sid)), None)
+            if keys is None:
+                continue
+            self.release(keys)
+            self.stats["session_released"] += 1
+            if self._host is None:
+                continue
+            for key in reversed(keys):
+                node = self._nodes.get(key)
+                if node is None:
+                    continue    # already demoted/evicted; walk on up
+                if node.refs or node.children:
+                    break       # pinned or shared below: stays hot
+                self._evict(node)
+                demoted += 1
+        return demoted
 
     def _evict(self, node: _PrefixBlock) -> None:
         del self._nodes[node.key]
         if node.pool_id is not None:
+            # demote-not-forget (ISSUE 17): with a host tier attached
+            # the rows copy down BEFORE the pool ref goes — the chain
+            # key survives in HostBlockStore and the promoter can
+            # re-land it; only a host-budget refusal makes this a
+            # true eviction
+            if self._host is not None:
+                k_rows, v_rows = self._pool.block_rows(node.pool_id)
+                if self._host.put_from_device(
+                        node.tenant, node.parent, node.key,
+                        k_rows, v_rows, node.nbytes):
+                    self.stats["demoted"] += 1
             # paged: the cache's ref goes; the pool block frees when
             # no slot table still aliases it
             self._pool.release_blocks([node.pool_id])
@@ -1898,6 +2034,12 @@ class ContinuousDecoder:
                 _, hit = self.prefix_cache.match(
                     tenant, prompt, limit=len(prompt) - 1)
                 uncached -= hit
+                if hit < len(prompt) - 1 and self.prefix_cache.tiered:
+                    # admission-probe promotion kick (ISSUE 17): the
+                    # probe knows this prompt is coming before its
+                    # admit round — start re-landing its host-tier
+                    # chain tail now (non-blocking)
+                    self.prefix_cache.prefetch(tenant, prompt)
             wait += uncached * self._prefill_token_ewma
         return wait
 
@@ -1949,7 +2091,8 @@ class ContinuousDecoder:
                callback, deadline: float | None = None,
                tenant: str | None = None,
                prefill_label: str | None = None,
-               kv_blocks: tuple | None = None) -> bool:
+               kv_blocks: tuple | None = None,
+               progress_callback=None) -> bool:
         """Enqueue one request; returns False when deadline-aware
         admission rejected it instead (the callback is NOT invoked —
         the caller owns the refusal).  `deadline` (absolute,
@@ -2006,6 +2149,14 @@ class ContinuousDecoder:
         prompt = [int(t) for t in prompt] or [0]
         truncated = len(prompt) > limit
         prompt = prompt[-limit:]
+        if self.prefix_cache is not None and len(prompt) > 1 and \
+                self.prefix_cache.tiered:
+            # submit-time promotion kick (ISSUE 17): the admit round
+            # is at least one pump tick away — a prefetch kicked here
+            # overlaps the whole queue wait, so the admit probe finds
+            # the chain staged (or already resident) instead of
+            # paying the H2D inline
+            self.prefix_cache.prefetch(journey.tenant, prompt)
         if deadline is not None:
             wait = self.estimated_admit_wait(prompt=prompt,
                                              tenant=journey.tenant)
@@ -2023,7 +2174,8 @@ class ContinuousDecoder:
             request_id, prompt, int(max_new_tokens), callback,
             submit_time=now, journey=journey, deadline=deadline,
             tenant=journey.tenant,
-            prefill_label=str(prefill_label or ""))
+            prefill_label=str(prefill_label or ""),
+            progress_callback=progress_callback)
         if kv_blocks:
             # direct slot-table install (ISSUE 15 satellite): the
             # caller pre-installed pool blocks covering the prompt's
@@ -2292,6 +2444,17 @@ class ContinuousDecoder:
             self.stats["tokens_prefill"] += max(
                 0, new_pos - request.prefill_pos)
             request.prefill_pos = new_pos
+            if request.progress_callback is not None:
+                # chunk streaming (ISSUE 17): the runtime harvests +
+                # ships the chunk's finished blocks NOW — paged
+                # harvest is a refcount bump, so this stays a host-
+                # side table walk on the prefill hot path
+                try:
+                    request.progress_callback(request, bool(finish))
+                except Exception:
+                    self.logger.exception(
+                        "progress callback failed for %s",
+                        request.request_id)
             if request.journey is not None:
                 request.journey.wave("extend")
             if finish:
@@ -2405,20 +2568,23 @@ class ContinuousDecoder:
         shipped chain blocks straight into fresh pool blocks and hand
         the ids to the caller for submit(..) via DecodeRequest
         aliasing — the cacheless decode pool's KV landing (no
-        PrefixKVCache required).  Returns (covered_tokens, ids);
-        ownership of the ids transfers to the caller (release on a
-        refused submit).  Raises ValueError on geometry mismatch,
-        before any row lands."""
+        PrefixKVCache required).  Returns (covered_tokens, ids) for
+        THESE blocks; ownership of the ids transfers to the caller
+        (release on a refused submit).  `start_block` > 0 is the
+        chunk-streamed accumulation path (ISSUE 17): the caller holds
+        the ids for blocks [0, start_block) from earlier chunks and
+        owns contiguity (the client's ordered-cursor guard) — this
+        method only installs and sizes the given span.  Raises
+        ValueError on geometry mismatch, before any row lands."""
         if not self.paged:
             raise ValueError(
                 "install_shipped_blocks needs a paged decoder")
-        if int(start_block) != 0:
-            raise ValueError(
-                "direct slot-table install cannot start mid-chain "
-                f"(start_block={start_block}): without a prefix cache "
-                "the decode side holds no earlier blocks")
+        start = int(start_block)
+        if start < 0:
+            raise ValueError(f"negative start_block {start}")
         block = self.kv_block
-        count = min(len(blocks), len(tokens) // block)
+        count = min(len(blocks),
+                    max(0, len(tokens) // block - start))
         entries = blocks[:count]
         for entry in entries:
             check_block_geometry(self._kv_layout, block, entry)
@@ -2521,6 +2687,13 @@ class ContinuousDecoder:
         set, bucketed admission stops for the round once the budget is
         spent — arrivals defer rather than stall active decode slots
         (prefix copies are exempt: they move bytes, not FLOPs)."""
+        if self.prefix_cache is not None and \
+                self.prefix_cache.promotions_ready:
+            # land staged async promotions FIRST (ISSUE 17): a
+            # prefetch kicked rounds ago becomes a plain cache hit
+            # for the probes below — the hot-session admit stays a
+            # table edit
+            self.prefix_cache.poll_promotions()
         free = [s for s in range(self.max_slots)
                 if self._slots[s] is None]
         if not free or not self._pending:
@@ -2564,6 +2737,15 @@ class ContinuousDecoder:
                     continue
             if self.prefix_cache is not None and \
                     not request.prefix_probed:
+                if self.prefix_cache.tiered:
+                    # sync promotion fallback (ISSUE 17): whatever of
+                    # this prompt's chain still lives on the host
+                    # tier must be device-resident BEFORE the probe —
+                    # a staged prefetch installs instantly, an
+                    # unkicked one stages inline; either way the
+                    # acquire below sees the full chain
+                    self.prefix_cache.promote_for(
+                        request.tenant, request.prompt)
                 block = self.prefix_cache.block_tokens
                 if len(request.prompt) > block:
                     lead = self.prefix_cache.keys_for(
@@ -2819,6 +3001,21 @@ class ContinuousDecoder:
                 is request:
             self._inflight_chains.pop(request.inflight_key, None)
             request.inflight_key = ""
+
+    def harvest_progress(self, request: DecodeRequest) -> int:
+        """Mid-prefill prompt harvest (ISSUE 17): register the
+        complete blocks written so far ([0, prefill_pos)) with the
+        prefix cache NOW, without waiting for retire — the chunk-
+        streaming shipper reads them the moment the chunk's extend is
+        dispatched.  Idempotent (already-cached keys skip); returns
+        complete prompt blocks at the current position."""
+        if self.prefix_cache is None or request.slot < 0 or \
+                self._slots[request.slot] is not request:
+            return 0
+        pos = int(request.prefill_pos)
+        self._harvest_rows(request.slot, request.tenant,
+                           list(request.prompt[:pos]))
+        return pos // self.prefix_cache.block_tokens
 
     def _harvest_rows(self, slot: int, tenant: str, tokens) -> None:
         cache = self.prefix_cache

@@ -109,7 +109,8 @@ class PrefillRuntime(Actor):
                  prefill_chunk: int | None = None,
                  decoder_opts: dict | None = None,
                  pump_period: float = 0.002,
-                 batch_window: float = 0.0, registry=None):
+                 batch_window: float = 0.0,
+                 chunk_stream: bool | None = None, registry=None):
         super().__init__(runtime, name, PROTOCOL_PREFILL,
                          tags=[role_tag(ROLE_PREFILL)])
         from .serving import ContinuousDecoder, PrefixKVCache
@@ -142,6 +143,15 @@ class PrefillRuntime(Actor):
             raise ValueError(
                 "PrefillRuntime needs a decoder with a bound "
                 "PrefixKVCache (the harvest IS the product)")
+        # chunk streaming (ISSUE 17): when the donor prefills in
+        # chunks, ship each chunk's finished blocks the moment the
+        # chunk lands instead of holding the whole prompt's KV for one
+        # ship-on-finish envelope — the transfer overlaps the rest of
+        # the prefill compute.  Default: on whenever chunked prefill
+        # is on (there is nothing to stream otherwise).
+        if chunk_stream is None:
+            chunk_stream = bool(self.decoder.prefill_chunk)
+        self.chunk_stream = bool(chunk_stream)
         # pump_period <= 0 drives the pump flat-out (once per engine
         # step) instead of on a periodic timer — what the single-engine
         # harness uses so a busy pump cannot starve the engine's
@@ -154,7 +164,8 @@ class PrefillRuntime(Actor):
         self.stats = MirroredStats(
             {"requests": 0, "computed": 0, "blocks_shipped": 0,
              "bytes_shipped": 0, "handle_blocks": 0, "refused": 0,
-             "empty_ships": 0, "envelopes": 0, "batched_envelopes": 0},
+             "empty_ships": 0, "envelopes": 0, "batched_envelopes": 0,
+             "chunks_shipped": 0, "chunk_blocks": 0},
             metric="prefill_runtime_events_total",
             help="prefill-runtime events by kind",
             registry=self._registry, skip=("bytes_shipped",),
@@ -202,22 +213,37 @@ class PrefillRuntime(Actor):
         tokens = tokens[-_prompt_cap(self.decoder):] or [0]
         tenant = str(tenant)
         context = tracing.current_trace()
+        # chunk-stream cursor: the next chain block index to ship.
+        # Shared by the per-chunk progress callback and the final ship
+        # so a block crosses the wire exactly once.
+        state = {"cursor": None}
 
         def computed(_rid, generated):
             self._publish_depth()
             with tracing.activate(context):
                 self._ship(str(transfer_id), str(reply_topic), tenant,
                            have, tokens,
-                           int(generated[0]) if generated else None)
+                           int(generated[0]) if generated else None,
+                           cursor=state["cursor"])
+
+        progress = None
+        if self.chunk_stream:
+            def progress(request, finished):
+                if finished:
+                    return   # the final ship (with first_token) owns the tail
+                with tracing.activate(context):
+                    self._ship_chunk(str(transfer_id), str(reply_topic),
+                                     tenant, have, tokens, request, state)
 
         accepted = self.decoder.submit(str(transfer_id), tokens, 1,
-                                       computed, tenant=tenant)
+                                       computed, tenant=tenant,
+                                       progress_callback=progress)
         if not accepted:
             self.stats["refused"] += 1
         self._publish_depth()
 
     def _ship(self, transfer_id: str, reply_topic: str, tenant: str,
-              have: int, tokens, first_token) -> None:
+              have: int, tokens, first_token, cursor=None) -> None:
         self.stats["computed"] += 1
         cache = self.cache
         block = cache.block_tokens
@@ -232,9 +258,66 @@ class PrefillRuntime(Actor):
                 "cache holds none of its chain; shipping empty",
                 self.name, transfer_id, len(tokens))
         start_block = min(have // block, hit // block)
-        nodes = cache.nodes(keys[start_block:hit // block])
+        # handle-shipping accounting keys on the caller's holdings, not
+        # on what chunk streaming already moved — count before the
+        # cursor advances the window
+        self.stats["handle_blocks"] += start_block
+        if cursor:
+            # chunk streaming already shipped blocks below the cursor;
+            # the final envelope carries only the tail (plus
+            # first_token, which must always cross)
+            start_block = min(max(start_block, int(cursor)),
+                              hit // block)
+        blocks = self._wire_blocks(keys[start_block:hit // block])
+        context = tracing.current_trace()
+        payload = wire.encode_kv_transfer(
+            transfer_id, tenant, tokens, start_block, block,
+            cache.wire_layout(), blocks, first_token=first_token,
+            trace=context.to_fields(self.runtime.event.clock.now())
+            if context is not None else None)
+        self.stats["blocks_shipped"] += len(blocks)
+        self.stats["bytes_shipped"] += len(payload)
+        self._post(reply_topic, payload)
+
+    def _ship_chunk(self, transfer_id: str, reply_topic: str,
+                    tenant: str, have: int, tokens, request,
+                    state: dict) -> None:
+        """Ship the chain blocks a finished prefill chunk just made
+        durable (ISSUE 17 chunk streaming).  Runs from the decoder's
+        progress callback — the request is still resident, so the rows
+        are harvested into the cache first and shipped from there with
+        the same block path the final ship uses."""
+        cache = self.cache
+        block = cache.block_tokens
+        self.decoder.harvest_progress(request)
+        pos = int(request.prefill_pos)
+        keys, hit = cache.match(tenant, list(tokens[:pos]))
+        if state["cursor"] is None:
+            # blocks the caller already holds never ship, streamed or
+            # not — the cursor starts at the handle boundary
+            state["cursor"] = min(have // block, hit // block)
+        cursor = state["cursor"]
+        end = hit // block
+        if end <= cursor:
+            return
+        blocks = self._wire_blocks(keys[cursor:end])
+        context = tracing.current_trace()
+        payload = wire.encode_kv_transfer(
+            transfer_id, tenant, list(tokens[:end * block]), cursor,
+            block, cache.wire_layout(), blocks, final=False,
+            trace=context.to_fields(self.runtime.event.clock.now())
+            if context is not None else None)
+        state["cursor"] = end
+        self.stats["chunks_shipped"] += 1
+        self.stats["chunk_blocks"] += len(blocks)
+        self.stats["blocks_shipped"] += len(blocks)
+        self.stats["bytes_shipped"] += len(payload)
+        self._post(reply_topic, payload)
+
+    def _wire_blocks(self, keys) -> list:
+        cache = self.cache
         blocks = []
-        for node in nodes:
+        for node in cache.nodes(keys):
             # block_rows reads the node's storage home — its own rows
             # in dense mode, the block POOL in paged mode (ISSUE 15:
             # harvest left the rows in pool blocks, so shipping is the
@@ -245,16 +328,7 @@ class PrefillRuntime(Actor):
                 layers.append({"k": _to_host(k_leaf),
                                "v": _to_host(v_leaf)})
             blocks.append(layers)
-        context = tracing.current_trace()
-        payload = wire.encode_kv_transfer(
-            transfer_id, tenant, tokens, start_block, block,
-            cache.wire_layout(), blocks, first_token=first_token,
-            trace=context.to_fields(self.runtime.event.clock.now())
-            if context is not None else None)
-        self.stats["blocks_shipped"] += len(blocks)
-        self.stats["handle_blocks"] += start_block
-        self.stats["bytes_shipped"] += len(payload)
-        self._post(reply_topic, payload)
+        return blocks
 
     def _post(self, reply_topic: str, payload: bytes) -> None:
         """Ship one finished transfer: immediately, or coalesced with
@@ -401,10 +475,13 @@ class PrefillClient:
              "local_fallbacks": 0, "local_short": 0,
              "local_no_pool": 0, "local_cached": 0,
              "install_shed": 0, "direct_installs": 0,
-             "batched_replies": 0},
+             "batched_replies": 0, "chunk_installs": 0,
+             "chunk_blocks": 0, "chunk_dropped": 0,
+             "chunk_streamed": 0, "transfer_overlap_s": 0.0},
             metric="disagg_client_events_total",
             help="disaggregated serving client events by kind",
-            registry=self._registry, skip=("transfer_bytes",),
+            registry=self._registry,
+            skip=("transfer_bytes", "transfer_overlap_s"),
             labels={"client": name})
         self._transfer_seconds = self._registry.histogram(
             "disagg_transfer_seconds",
@@ -488,6 +565,12 @@ class PrefillClient:
             _, have = self.cache.match(tenant_key, prompt)
             complete = (len(prompt) // self.block_tokens) * \
                 self.block_tokens
+            if have < complete and self.cache.tiered:
+                # tiered KV (ISSUE 17): the routing probe doubles as
+                # the promotion kick — host-resident chain blocks for
+                # this prompt start re-landing while the transfer (or
+                # local prefill) is still in flight
+                self.cache.prefetch(tenant_key, prompt)
             if complete and have >= complete:
                 # the decode side already holds the ENTIRE chain
                 # (session KV, a repeated prompt): a remote hop would
@@ -551,6 +634,18 @@ class PrefillClient:
             self.loads[target] = max(0, self.loads[target] - 1)
         return entry
 
+    def _drop_chunks(self, entry: dict) -> None:
+        """Forget a transfer's streamed-chunk progress (ISSUE 17).
+        Cache-path installs stay — they are content-addressed and a
+        retry's `have` probe reuses them — but direct pool blocks are
+        owned by the stream and must not leak when it abandons."""
+        entry.pop("chunk_next", None)
+        entry.pop("chunk_first", None)
+        entry.pop("chunk_base", None)
+        ids = entry.pop("direct_ids", None)
+        if ids:
+            self.decoder.pool.release_blocks(ids)
+
     # -- the fallback ladder ----------------------------------------------
     def _transfer_expired(self, transfer_id: str) -> None:
         entry = self._pending.get(transfer_id)
@@ -575,6 +670,7 @@ class PrefillClient:
                                              remaining)
             if retry_target is not None:
                 self.stats["retries"] += 1
+                self._drop_chunks(entry)   # the retry streams afresh
                 have = 0
                 if self.cache is not None:
                     _, have = self.cache.match(
@@ -583,6 +679,7 @@ class PrefillClient:
                 return
         # rung 2: local prefill — counted, never dropped
         self._pending.pop(transfer_id, None)
+        self._drop_chunks(entry)
         self.stats["local_fallbacks"] += 1
         self.logger.warning(
             "disagg %s: transfer %s to %s gave up after %d attempt(s); "
@@ -657,9 +754,16 @@ class PrefillClient:
             self.logger.warning("disagg %s: corrupt KV transfer "
                                 "dropped: %s", self.name, exc)
             return
+        if not out.get("final", True):
+            # chunk-streamed member (ISSUE 17): install incrementally
+            # WITHOUT settling — the final envelope still owes
+            # first_token and the decode submit
+            self._handle_chunk(payload, out)
+            return
         entry = self._settle(out["transfer_id"])
         if entry is None:
             return              # late duplicate after timeout/fallback
+        chunk_first = entry.get("chunk_first")
         # out["first_token"] is deliberately unused: the decode-side
         # suffix extend recomputes the first token, so greedy parity
         # never depends on donor state — the field is a wire-level
@@ -680,25 +784,13 @@ class PrefillClient:
                 "disagg %s: transfer %s layout %r does not match the "
                 "decode cache %r; prefilling locally", self.name,
                 out["transfer_id"], out["layout"], local_layout)
+            self._drop_chunks(entry)
             self._local(entry["request_id"], entry["prompt"],
                         entry["max_new"], entry["callback"],
                         entry["deadline"], entry["tenant"],
                         entry["on_refused"])
             return
-        if self.cache is not None and not self.cache.paged:
-            # dense cache: owned host copies (per-leaf device_puts on
-            # the event loop stalled decode rounds — PR 14 finding);
-            # the admit-time concat ships one transfer per layer
-            blocks = [{"k": [_copy_host(layer["k"]) for layer in block],
-                       "v": [_copy_host(layer["v"]) for layer in block]}
-                      for block in out["blocks"]]
-        else:
-            # paged landings (ISSUE 15) write the wire views straight
-            # into pool blocks — ONE device scatter per layer, no host
-            # copy in between: the transferred bytes land exactly once
-            blocks = [{"k": [layer["k"] for layer in block],
-                       "v": [layer["v"] for layer in block]}
-                      for block in out["blocks"]]
+        blocks = self._landing_blocks(out["blocks"])
         direct_ids: list = []
         try:
             if self.cache is not None:
@@ -708,10 +800,29 @@ class PrefillClient:
             else:
                 # direct slot-table install (ISSUE 15 satellite): the
                 # cacheless decode pool lands the chain in pool blocks
-                # and hands the ids to submit() for slot aliasing
-                covered, direct_ids = \
-                    self.decoder.install_shipped_blocks(
-                        out["tokens"], out["start_block"], blocks)
+                # and hands the ids to submit() for slot aliasing.
+                # Streamed chunks already landed a contiguous prefix;
+                # the final span must continue it exactly (ordered-
+                # cursor guard) or the prefix alone is used.
+                prior = entry.get("direct_ids") or []
+                start = out["start_block"]
+                if prior and entry.get("chunk_next") == start:
+                    _, ids = self.decoder.install_shipped_blocks(
+                        out["tokens"], start, blocks)
+                    direct_ids = prior + ids
+                elif prior:
+                    self.stats["chunk_dropped"] += 1
+                    direct_ids = prior
+                else:
+                    if start != 0:
+                        raise ValueError(
+                            "direct install cannot start mid-chain "
+                            f"(start_block={start}) with no streamed "
+                            "prefix")
+                    _, direct_ids = \
+                        self.decoder.install_shipped_blocks(
+                            out["tokens"], 0, blocks)
+                entry.pop("direct_ids", None)
                 installed = len(direct_ids)
                 self.stats["direct_installs"] += 1
         except (ValueError, TypeError, IndexError) as exc:
@@ -725,6 +836,7 @@ class PrefillClient:
                 "disagg %s: transfer %s refused at install (%s); "
                 "prefilling locally", self.name, out["transfer_id"],
                 exc)
+            self._drop_chunks(entry)
             self._local(entry["request_id"], entry["prompt"],
                         entry["max_new"], entry["callback"],
                         entry["deadline"], entry["tenant"],
@@ -732,8 +844,22 @@ class PrefillClient:
             return
         self.stats["installs"] += 1
         self.stats["installed_blocks"] += installed
-        self.stats["handle_blocks"] += out["start_block"]
+        # a streamed transfer's final start_block sits at the chunk
+        # cursor, not the handle boundary — only blocks below the
+        # stream's low-water mark crossed as handles
+        handle = out["start_block"]
+        base = entry.get("chunk_base")
+        if base is not None:
+            handle = min(handle, int(base))
+        self.stats["handle_blocks"] += handle
         self.stats["raw_blocks"] += len(out["blocks"])
+        if chunk_first is not None:
+            # the stream began landing KV while the donor was still
+            # prefilling: everything between the first chunk and this
+            # final envelope was transfer time hidden behind compute
+            self.stats["chunk_streamed"] += 1
+            self.stats["transfer_overlap_s"] += \
+                max(0.0, time.perf_counter() - chunk_first)
         trc = tracing.tracer
         if trc.enabled and entry.get("trace") is not None:
             trc.record("kv_transfer", entry["started"], elapsed,
@@ -757,6 +883,90 @@ class PrefillClient:
                                        kv_blocks=(covered, direct_ids))
             else:
                 self._submit_installed(entry)
+
+    def _handle_chunk(self, payload, out: dict) -> None:
+        """Install one streamed chunk for a still-pending transfer
+        (ISSUE 17).  Chunks are best-effort accelerant: any anomaly
+        (gap, layout drift, install refusal) drops the CHUNK and lets
+        the final envelope's full fallback ladder own correctness —
+        a dropped chunk can shorten the streamed prefix, never poison
+        the chain (cache installs are content-addressed; direct
+        installs keep only a contiguous-from-zero prefix)."""
+        transfer_id = out["transfer_id"]
+        entry = self._pending.get(transfer_id)
+        if entry is None:
+            return          # late chunk after settle/timeout/fallback
+        local_layout = self.cache.wire_layout() \
+            if self.cache is not None else self.decoder.kv_wire_layout()
+        if not out["blocks"] or \
+                tuple(str(f) for f in out["layout"]) != local_layout:
+            self.stats["chunk_dropped"] += 1
+            return
+        expected = entry.get("chunk_next")
+        if expected is not None and out["start_block"] != expected:
+            # ordered-cursor guard: a lost/corrupt sibling left a gap;
+            # later chunks no longer extend the landed prefix
+            self.stats["chunk_dropped"] += 1
+            return
+        if self.cache is None and expected is None and \
+                out["start_block"] != 0:
+            # a direct (cacheless) stream is only usable as a
+            # contiguous-from-zero prefix
+            self.stats["chunk_dropped"] += 1
+            return
+        try:
+            if self.cache is not None:
+                installed = self.cache.install_chain(
+                    str(entry["tenant"] or ""), out["tokens"],
+                    out["start_block"],
+                    self._landing_blocks(out["blocks"]))
+            else:
+                _, ids = self.decoder.install_shipped_blocks(
+                    out["tokens"], out["start_block"],
+                    self._landing_blocks(out["blocks"]))
+                entry.setdefault("direct_ids", []).extend(ids)
+                installed = len(ids)
+        except (ValueError, TypeError, IndexError) as exc:
+            self.stats["chunk_dropped"] += 1
+            self.logger.warning(
+                "disagg %s: streamed chunk for %s refused at install "
+                "(%s); dropped", self.name, transfer_id, exc)
+            return
+        if "chunk_first" not in entry:
+            entry["chunk_first"] = time.perf_counter()
+            # the stream's low-water mark: blocks below it crossed as
+            # handles, blocks at/above it as raw streamed bytes — the
+            # final envelope's handle accounting keys on this
+            entry["chunk_base"] = out["start_block"]
+        entry["chunk_next"] = out["start_block"] + len(out["blocks"])
+        self.stats["chunk_installs"] += 1
+        self.stats["chunk_blocks"] += installed
+        self.stats["raw_blocks"] += len(out["blocks"])
+        self.stats["transfer_bytes"] += len(payload)
+        # a streaming donor is demonstrably alive: restart the
+        # transfer timeout per chunk so a long prompt's stream is not
+        # killed mid-flight by a budget sized for one envelope
+        timer = entry.pop("timer", None)
+        if timer is not None:
+            self.runtime.event.remove_timer_handler(timer)
+            entry["timer"] = self.runtime.event.add_oneshot_handler(
+                lambda: self._transfer_expired(transfer_id),
+                self.transfer_timeout)
+
+    def _landing_blocks(self, wire_blocks) -> list:
+        if self.cache is not None and not self.cache.paged:
+            # dense cache: owned host copies (per-leaf device_puts on
+            # the event loop stalled decode rounds — PR 14 finding);
+            # the admit-time concat ships one transfer per layer
+            return [{"k": [_copy_host(layer["k"]) for layer in block],
+                     "v": [_copy_host(layer["v"]) for layer in block]}
+                    for block in wire_blocks]
+        # paged landings (ISSUE 15) write the wire views straight
+        # into pool blocks — ONE device scatter per layer, no host
+        # copy in between: the transferred bytes land exactly once
+        return [{"k": [layer["k"] for layer in block],
+                 "v": [layer["v"] for layer in block]}
+                for block in wire_blocks]
 
     def _submit_installed(self, entry: dict,
                           kv_blocks: tuple | None = None) -> None:
@@ -788,6 +998,7 @@ class PrefillClient:
             entry = self._settle(transfer_id)
             if entry is not None:
                 # teardown owes every in-flight request a local home
+                self._drop_chunks(entry)
                 self.stats["local_fallbacks"] += 1
                 self._local(entry["request_id"], entry["prompt"],
                             entry["max_new"], entry["callback"],
@@ -855,7 +1066,7 @@ class DisaggHarness:
                  cache_mb: int = 512, decoder_opts: dict | None = None,
                  fault_plan=None, transfer_timeout: float = 5.0,
                  retries: int = 1, batch_window: float = 0.0,
-                 registry=None):
+                 chunk_stream: bool | None = None, registry=None):
         from .event import EventEngine
         from .registrar import Registrar
         from .serving import ContinuousDecoder, PrefixKVCache
@@ -918,7 +1129,7 @@ class DisaggHarness:
                 prefill_buckets=tuple(prefill_buckets),
                 prefill_chunk=prefill_chunk, decoder_opts=opts,
                 pump_period=0, batch_window=batch_window,
-                registry=self._registry)
+                chunk_stream=chunk_stream, registry=self._registry)
             cache = ServicesCache(self.decode_rt)
             self.client = PrefillClient(
                 self.decode_rt, self.decoder, services_cache=cache,
@@ -1093,6 +1304,14 @@ class DisaggHarness:
                     self.client.handle_hit_rate(), 4),
                 "local_fallbacks": stats["local_fallbacks"],
                 "install_shed": stats["install_shed"],
+                # chunk streaming (ISSUE 17): how many transfers
+                # overlapped the donor's prefill compute, and how much
+                # transfer wall time that overlap hid
+                "chunk_streamed": stats["chunk_streamed"],
+                "chunk_installs": stats["chunk_installs"],
+                "chunk_dropped": stats["chunk_dropped"],
+                "transfer_overlap_s": round(
+                    stats["transfer_overlap_s"], 4),
             })
         return out
 

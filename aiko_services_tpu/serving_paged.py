@@ -282,6 +282,19 @@ class BlockPool:
         capacity = self.num_blocks - 1
         return self.used_blocks() / capacity if capacity else 0.0
 
+    def tail_free_blocks(self) -> int:
+        """Length of the pool's free TAIL — the only span
+        maybe_shrink can release (ids are array positions).  The
+        tiered-KV interplay surface (ISSUE 17): a demotion wave frees
+        device blocks via release_blocks, and this reports how much
+        of that release the NEXT idle shrink can actually give back
+        (interior frees fragment until their tail neighbours drain
+        too)."""
+        keep = self.num_blocks
+        while keep > 1 and self._refs[keep - 1] == 0:
+            keep -= 1
+        return self.num_blocks - keep
+
     def _publish_gauges(self) -> None:
         # alloc/release land here once per pump-path transition: an
         # O(num_blocks) used_blocks() scan per one-block allocation

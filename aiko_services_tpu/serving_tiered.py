@@ -1,0 +1,494 @@
+# Tiered KV: host-RAM block offload with async promotion (ISSUE 17,
+# ROADMAP item 3).
+#
+# Million-user conversation serving dies on HBM long before FLOPs:
+# session-resident KV handles (PR 13) pin pool blocks for a lease's
+# lifetime, so resident conversations × mean history is bounded by one
+# chip's HBM.  The CachedAttention/AttentionStore pattern is the fix —
+# idle conversations' KV lives in host memory and streams back
+# just-in-time:
+#
+#   * HostBlockStore — the host tier.  Same block geometry as the
+#     device BlockPool (per-layer [H, B, D] rows, int8 {"q", "s"}
+#     dicts included), keyed by the SAME content-addressed chain keys
+#     the prefix cache uses, with its own LRU + global/per-tenant byte
+#     budgets and kv_host_bytes{tenant} gauges.  Demotion
+#     (PrefixKVCache._evict with a host store attached, and the
+#     SessionTable's on_demoted/on_expired wheel via demote_sessions)
+#     copies a pool block's rows down ONCE and frees the device block
+#     — the chain key survives, so the session's history is
+#     recoverable instead of re-prefilled.
+#
+#   * AsyncPromoter — the off-event-loop prefetcher.  Admission
+#     probes (estimated_admit_wait / the DeadlineRouter's next-hop
+#     knowledge), the disagg client's submit, and PE_LlamaAgent's
+#     session touch kick prefetch(tenant, tokens): host rows for the
+#     chain's non-device-resident tail are captured ON the event loop
+#     (GC-safe against concurrent host eviction) and a worker thread
+#     stages them — per-layer [M, H, B, D] stacks, device_put'd off
+#     the loop, so the H2D overlaps event-loop work.  poll() (the
+#     decoder's admit round) and promote_for() (the sync fallback at
+#     the actual admit probe) land staged stacks into freshly
+#     allocated pool blocks + insert_block registrations — a warm
+#     session's hit is then a table edit plus one overlapped H2D
+#     instead of a cold prefill.
+#
+# The device↔host copies live HERE, behind the prefetcher seam —
+# graft-check's lint-host-transfer rule refuses pool-block
+# device_put/np.asarray inline in event-handler or hot-path contexts
+# (a blocking H2D on the event loop stalls every stream it serves).
+#
+# Single-threaded discipline: every structure mutation (store dicts,
+# cache inserts, pool alloc/write) happens on the event loop; the
+# worker thread only reads row references it was handed and builds
+# fresh arrays.  Fully CPU-verifiable — tests/test_tiered_kv.py proves
+# greedy bit-parity across demote→promote cycles and a zero-block leak
+# audit on both tiers.
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .utils import Lock, get_logger
+
+__all__ = ["HostBlockStore", "AsyncPromoter"]
+
+
+def _host_leaf(leaf):
+    """One block leaf copied to a host ndarray (the D2H of demotion).
+    int8 storage keeps its {"q", "s"} dict form — the host tier holds
+    the SAME geometry the pool does, so promotion is a pure write."""
+    if isinstance(leaf, dict):
+        return {"q": np.asarray(leaf["q"]), "s": np.asarray(leaf["s"])}
+    return np.asarray(leaf)
+
+
+class _HostBlock:
+    """One demoted block: per-layer host K/V rows plus the chain
+    bookkeeping promotion needs (parent key, tenant, bytes)."""
+
+    __slots__ = ("key", "parent", "tenant", "k_rows", "v_rows",
+                 "nbytes")
+
+    def __init__(self, key, parent, tenant, k_rows, v_rows, nbytes):
+        self.key = key
+        self.parent = parent
+        self.tenant = tenant
+        self.k_rows = k_rows
+        self.v_rows = v_rows
+        self.nbytes = int(nbytes)
+
+
+class HostBlockStore:
+    """Host-RAM tier of the two-tier KV store (ISSUE 17).
+
+    Holds demoted prefix-cache blocks as host ndarrays under their
+    content-addressed chain keys.  LRU over one OrderedDict (oldest
+    first, like PrefixKVCache) with a global byte budget plus an
+    optional per-tenant residency cap — the host twin of the device
+    tier's pin caps, so one tenant's idle history cannot evict
+    everyone else's.  Gauges: kv_host_bytes{store, tenant} per tenant
+    and kv_host_blocks{store}; counters kv_host_events_total{event=
+    demoted|promoted|evicted|refused}.
+
+    Single-threaded: called only from the event loop (the promoter's
+    worker thread never touches the dicts — it reads row references
+    captured at kick time)."""
+
+    def __init__(self, max_bytes: int | None = 2 << 30,
+                 tenant_max_bytes: int | None = None,
+                 name: str = "host_kv", registry=None):
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.tenant_max_bytes = int(tenant_max_bytes) \
+            if tenant_max_bytes else None
+        self.name = str(name)
+        from collections import OrderedDict
+        self._nodes: OrderedDict = OrderedDict()
+        self._tenant_bytes: dict = {}
+        self.bytes_used = 0
+        self.logger = get_logger(f"serving.host_kv.{name}")
+        from .observe.metrics import MirroredStats, default_registry
+        self._registry = registry or default_registry()
+        self.stats = MirroredStats(
+            {"demoted": 0, "promoted": 0, "evicted": 0, "refused": 0,
+             "demote_bytes": 0, "promote_bytes": 0},
+            metric="kv_host_events_total",
+            help="host KV tier events by kind",
+            registry=self._registry,
+            skip=("demote_bytes", "promote_bytes"),
+            labels={"store": self.name})
+        self._gauge_blocks = self._registry.gauge(
+            "kv_host_blocks", "host-tier resident KV blocks",
+            labels={"store": self.name})
+        self._tenant_gauges: dict = {}
+
+    # -- residency ---------------------------------------------------------
+    def has(self, key: str) -> bool:
+        return key in self._nodes
+
+    def get(self, key: str):
+        return self._nodes.get(key)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def tenant_bytes(self, tenant: str) -> int:
+        return self._tenant_bytes.get(str(tenant or "default"), 0)
+
+    def put_from_device(self, tenant: str, parent: str, key: str,
+                        k_rows, v_rows, nbytes: int) -> bool:
+        """Demote one block: host-copy the pool's per-layer row views
+        (the D2H — this IS the prefetcher seam's demotion half) and
+        register them under the chain key.  Returns False when the
+        host budgets refused it (the block is then truly evicted —
+        demote-not-forget only holds while host bytes last)."""
+        tenant = str(tenant or "default")
+        if key in self._nodes:
+            self._nodes.move_to_end(key)
+            return True
+        if self.max_bytes is not None and nbytes > self.max_bytes:
+            self.stats["refused"] += 1
+            return False
+        node = _HostBlock(key, parent, tenant,
+                          [_host_leaf(leaf) for leaf in k_rows],
+                          [_host_leaf(leaf) for leaf in v_rows],
+                          nbytes)
+        self._nodes[key] = node
+        self.bytes_used += node.nbytes
+        self._tenant_bytes[tenant] = \
+            self._tenant_bytes.get(tenant, 0) + node.nbytes
+        self.stats["demoted"] += 1
+        self.stats["demote_bytes"] += node.nbytes
+        self._evict_to_budget(tenant)
+        if key not in self._nodes:      # budget evicted the newcomer
+            self.stats["refused"] += 1
+            self._publish_gauges(tenant)
+            return False
+        self._publish_gauges(tenant)
+        return True
+
+    def touch(self, key: str) -> None:
+        if key in self._nodes:
+            self._nodes.move_to_end(key)
+
+    def chain_nodes(self, keys) -> list:
+        """Contiguous host-resident run of `keys` from the front —
+        the promotable segment (a gap ends it: promotion past a
+        missing block could never be longest-matched)."""
+        nodes = []
+        for key in keys:
+            node = self._nodes.get(key)
+            if node is None:
+                break
+            nodes.append(node)
+        return nodes
+
+    def pop_promoted(self, keys) -> int:
+        """Drop promoted blocks from the host tier (they live on the
+        device again); returns bytes released."""
+        released = 0
+        tenants = set()
+        for key in keys:
+            node = self._nodes.pop(key, None)
+            if node is None:
+                continue
+            released += node.nbytes
+            self._drop_bytes(node)
+            tenants.add(node.tenant)
+            self.stats["promoted"] += 1
+            self.stats["promote_bytes"] += node.nbytes
+        for tenant in tenants:
+            self._publish_gauges(tenant)
+        return released
+
+    # -- budgets -----------------------------------------------------------
+    def _drop_bytes(self, node: _HostBlock) -> None:
+        self.bytes_used -= node.nbytes
+        remaining = self._tenant_bytes.get(node.tenant, 0) - node.nbytes
+        if remaining > 0:
+            self._tenant_bytes[node.tenant] = remaining
+        else:
+            self._tenant_bytes.pop(node.tenant, None)
+
+    def _over_budget(self, tenant: str) -> str | None:
+        if self.tenant_max_bytes is not None and \
+                self._tenant_bytes.get(tenant, 0) > \
+                self.tenant_max_bytes:
+            return tenant
+        if self.max_bytes is not None and \
+                self.bytes_used > self.max_bytes:
+            return ""                   # global breach: any tenant
+        return None
+
+    def _evict_to_budget(self, tenant: str) -> None:
+        # plain LRU from the front — host blocks are terminal (there
+        # is no third tier), and a mid-chain eviction only shortens
+        # the promotable run, never corrupts it (content-addressed)
+        while True:
+            scope = self._over_budget(tenant)
+            if scope is None:
+                return
+            victim = None
+            for node in self._nodes.values():
+                if scope and node.tenant != scope:
+                    continue
+                victim = node
+                break
+            if victim is None:
+                return
+            del self._nodes[victim.key]
+            self._drop_bytes(victim)
+            self.stats["evicted"] += 1
+            self._publish_gauges(victim.tenant)
+
+    def _publish_gauges(self, tenant: str) -> None:
+        self._gauge_blocks.set(len(self._nodes))
+        gauge = self._tenant_gauges.get(tenant)
+        if gauge is None:
+            gauge = self._registry.gauge(
+                "kv_host_bytes",
+                "host-tier resident KV bytes by tenant",
+                labels={"store": self.name, "tenant": tenant})
+            self._tenant_gauges[tenant] = gauge
+        gauge.set(self._tenant_bytes.get(tenant, 0))
+
+
+class _PromoteJob:
+    __slots__ = ("key", "tenant", "keys", "parent", "rows", "stacks",
+                 "done")
+
+    def __init__(self, key, tenant, keys, parent, rows):
+        self.key = key              # dedup key: first host-tier key
+        self.tenant = tenant
+        self.keys = keys            # chain keys being promoted
+        self.parent = parent        # device-resident parent ("" root)
+        self.rows = rows            # [(k_rows, v_rows), ...] captured
+        self.stacks = None          # staged (k_layers, v_layers)
+        self.done = threading.Event()
+
+
+class AsyncPromoter:
+    """Off-event-loop H2D prefetcher for the host KV tier (ISSUE 17).
+
+    prefetch() captures host row references on the event loop and
+    hands them to ONE daemon worker that stacks them per layer and
+    device_puts the stacks — the only place pool-shaped host arrays
+    cross to the device (the lint-host-transfer seam).  poll() (every
+    admit round) and promote_for() (the admit-time sync fallback) run
+    back on the loop: allocate pool blocks, scatter the staged stacks
+    in, register the chain with insert_block, and drop the host
+    copies.  A prompt whose prefetch landed before its admit round
+    pays nothing at admit (installs_async); one that races its admit
+    waits out the in-flight staging (installs_wait) or stages inline
+    (installs_sync) — all three beat the cold re-prefill."""
+
+    def __init__(self, cache, store: HostBlockStore,
+                 name: str | None = None, registry=None,
+                 wait_s: float = 2.0):
+        self.cache = cache
+        self.store = store
+        self.name = str(name or f"{store.name}.promote")
+        self.wait_s = float(wait_s)
+        self.logger = get_logger(f"serving.{self.name}")
+        self._jobs: dict = {}           # first key -> _PromoteJob
+        self._ready: list = []          # staged, awaiting install
+        self._lock = Lock(f"{self.name}._ready")
+        self._queue: queue.Queue = queue.Queue()
+        self._thread = None
+        from .observe.metrics import MirroredStats, default_registry
+        self._registry = registry or default_registry()
+        self.stats = MirroredStats(
+            {"kicks": 0, "staged": 0, "installs": 0,
+             "installs_async": 0, "installs_sync": 0,
+             "installs_wait": 0, "stale": 0},
+            metric="kv_promote_events_total",
+            help="host-tier KV promotion events by kind",
+            registry=self._registry,
+            labels={"promoter": self.name})
+
+    # -- event-loop side ---------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        """Cheap hot-path probe: staged promotions are waiting for
+        poll() (plain list truthiness — GIL-atomic)."""
+        return bool(self._ready)
+
+    def _segment(self, tenant: str, tokens) -> tuple:
+        """(keys, device_hit_blocks, host nodes) for the chain's
+        promotable tail: the device-resident run first, then the
+        host-resident continuation."""
+        cache = self.cache
+        block = cache.block_tokens
+        count = max(0, len(tokens) - 1) // block
+        if count == 0 or not len(self.store):
+            return [], 0, []
+        keys = cache.keys_for(tenant, tokens[:count * block])
+        device = 0
+        while device < count and cache.has(keys[device]):
+            device += 1
+        if device >= count:
+            return keys, device, []
+        return keys, device, self.store.chain_nodes(keys[device:])
+
+    def prefetch(self, tenant: str, tokens) -> int:
+        """Kick an async promotion for the host-resident tail of this
+        prompt's chain; returns the tokens being promoted (0: nothing
+        host-resident, already device-resident, or already in
+        flight).  Non-blocking — safe from admission probes and
+        session touches on the event loop."""
+        keys, device, nodes = self._segment(tenant, tokens)
+        if not nodes:
+            return 0
+        first = keys[device]
+        if first in self._jobs:
+            return 0                     # already staging/staged
+        job = _PromoteJob(
+            first, str(tenant or "default"),
+            keys[device:device + len(nodes)],
+            keys[device - 1] if device else "",
+            [(node.k_rows, node.v_rows) for node in nodes])
+        self._jobs[first] = job
+        self._ensure_thread()
+        self._queue.put(job)
+        self.stats["kicks"] += 1
+        return len(nodes) * self.cache.block_tokens
+
+    def poll(self) -> int:
+        """Install every staged promotion (event loop only); returns
+        tokens landed.  Called at the top of the decoder's admit round
+        so a prefetch kicked N rounds ago is a cache hit by the time
+        its prompt admits."""
+        if not self._ready:
+            return 0
+        with self._lock:
+            jobs, self._ready = self._ready, []
+        landed = 0
+        for job in jobs:
+            landed += self._install(job, kind="installs_async")
+        return landed
+
+    def promote_for(self, tenant: str, tokens) -> int:
+        """Synchronous admit-time fallback: make the host-resident
+        tail of this prompt's chain device-resident NOW.  A staged job
+        installs immediately; an in-flight one is waited out (bounded
+        by wait_s — still cheaper than the cold re-prefill it
+        replaces); no job at all stages inline.  Returns tokens
+        promoted."""
+        self.poll()
+        keys, device, nodes = self._segment(tenant, tokens)
+        if not nodes:
+            return 0
+        job = self._jobs.get(keys[device])
+        if job is not None:
+            if not job.done.wait(self.wait_s):
+                return 0                 # mid-stage: lands next round
+            with self._lock:
+                if job in self._ready:
+                    self._ready.remove(job)
+            return self._install(job, kind="installs_wait")
+        job = _PromoteJob(
+            keys[device], str(tenant or "default"),
+            keys[device:device + len(nodes)],
+            keys[device - 1] if device else "",
+            [(node.k_rows, node.v_rows) for node in nodes])
+        self._jobs[job.key] = job
+        self._stage(job)
+        return self._install(job, kind="installs_sync")
+
+    def _install(self, job: _PromoteJob, kind: str) -> int:
+        self._jobs.pop(job.key, None)
+        cache = self.cache
+        pool = cache.pool
+        if pool is None or job.stacks is None:
+            self.stats["stale"] += 1
+            return 0
+        if job.parent and not cache.has(job.parent):
+            # the device-resident parent demoted while we staged: an
+            # install would land unreachable-by-match blocks — drop;
+            # the next kick re-segments from the new boundary
+            self.stats["stale"] += 1
+            return 0
+        skip = 0
+        while skip < len(job.keys) and cache.has(job.keys[skip]):
+            skip += 1                   # re-prefilled while staging
+        keys = job.keys[skip:]
+        if not keys:
+            self.stats["stale"] += 1
+            return 0
+        k_layers, v_layers = job.stacks
+        if skip:
+            k_layers = [_slice_stack(s, skip) for s in k_layers]
+            v_layers = [_slice_stack(s, skip) for s in v_layers]
+        ids = pool.alloc_blocks(len(keys))
+        pool.write_blocks(ids, k_layers, v_layers)
+        parent = job.keys[skip - 1] if skip else job.parent
+        installed = 0
+        for j, key in enumerate(keys):
+            if not cache.insert_block(job.tenant, parent, key,
+                                      ids[j]):
+                break                   # device budget refused: stop
+            parent = key
+            installed += 1
+        pool.release_blocks(ids)
+        if installed:
+            self.store.pop_promoted(keys[:installed])
+            cache.stats["promoted"] += installed
+            self.stats["installs"] += installed
+            self.stats[kind] += installed
+        return installed * cache.block_tokens
+
+    # -- worker side -------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name=self.name, daemon=True)
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._stage(job)
+            except Exception:
+                self.logger.exception("promotion staging failed")
+                job.stacks = None
+            with self._lock:
+                self._ready.append(job)
+            job.done.set()
+
+    def _stage(self, job: _PromoteJob) -> None:
+        """Build the per-layer [M, H, B, D] stacks write_blocks wants
+        and move them to the device — the H2D half of the prefetcher
+        seam, off the event loop when the worker runs it."""
+        import jax
+        from .serving import _stack_block_leaves
+        layers = len(job.rows[0][0])
+        job.stacks = (
+            [jax.device_put(_stack_block_leaves(
+                [rows[0][i] for rows in job.rows]))
+             for i in range(layers)],
+            [jax.device_put(_stack_block_leaves(
+                [rows[1][i] for rows in job.rows]))
+             for i in range(layers)])
+        self.stats["staged"] += len(job.keys)
+
+    def stop(self) -> None:
+        """Drain the worker (idempotent).  In-flight jobs finish
+        staging and are dropped unpolled — stop() is a teardown path,
+        the store keeps the host copies."""
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            self._queue.put(None)
+            thread.join(timeout=5.0)
+        self._thread = None
+
+
+def _slice_stack(stack, skip: int):
+    if isinstance(stack, dict):
+        return {"q": stack["q"][skip:], "s": stack["s"][skip:]}
+    return stack[skip:]

@@ -87,9 +87,9 @@ class TenantBudget:
 
 class _Session:
     __slots__ = ("tenant", "sid", "payload", "nbytes", "due", "gen",
-                 "demoted")
+                 "demoted", "touched")
 
-    def __init__(self, tenant, sid, payload, nbytes, due):
+    def __init__(self, tenant, sid, payload, nbytes, due, touched):
         self.tenant = tenant
         self.sid = sid
         self.payload = payload
@@ -97,6 +97,7 @@ class _Session:
         self.due = due
         self.gen = 0            # bumped per touch: stale wheel entries
         self.demoted = False
+        self.touched = touched  # last activity: the idle-demote clock
 
     @property
     def key(self):
@@ -158,7 +159,8 @@ class SessionTable:
                  snapshot_interval: float = 0.0,
                  default_budget: TenantBudget | None = None,
                  budgets: dict[str, TenantBudget] | None = None,
-                 on_expired=None, on_demoted=None):
+                 on_expired=None, on_demoted=None,
+                 demote_idle: float | None = None):
         """`service` supplies the runtime and the topic root (a Service
         or anything with .runtime/.topic_path).  `on_expired(keys)` is
         the expiry-batch callback: one call per wheel advance that
@@ -169,7 +171,13 @@ class SessionTable:
         handles ride them, ISSUE 13 / PR 10 residue (c)).
         `snapshot_interval` > 0 re-synchronizes dirty shards' live
         consumers periodically (compacted snapshot: current state, not
-        the delta history); 0 leaves recovery to lease re-requests."""
+        the delta history); 0 leaves recovery to lease re-requests.
+        `demote_idle` > 0 demotes sessions untouched for that many
+        seconds BEFORE their lease lapses (ISSUE 17): the session
+        survives for dedup/revival but its payload — and whatever it
+        pinned outside the table, when on_demoted routes into a tiered
+        KV cache — drops to the cold tier early instead of hogging the
+        hot tier for a whole lease."""
         self.runtime = service.runtime
         self.topic_path = service.topic_path
         self.num_shards = int(num_shards)
@@ -178,6 +186,8 @@ class SessionTable:
         self.budgets = dict(budgets or {})
         self.on_expired = on_expired
         self.on_demoted = on_demoted
+        self.demote_idle = float(demote_idle) \
+            if demote_idle and float(demote_idle) > 0 else None
         self._sessions: dict[tuple, _Session] = {}
         # per-tenant insertion-ordered sid → session (touch re-inserts,
         # so iteration order IS oldest-touched-first: the demote scan
@@ -262,7 +272,7 @@ class SessionTable:
         nbytes = _value_nbytes(payload)
         now = self.runtime.event.clock.now()
         session = _Session(tenant, sid, payload, nbytes,
-                           now + (lease_time or self.lease_time))
+                           now + (lease_time or self.lease_time), now)
         self._sessions[key] = session
         self._by_tenant.setdefault(tenant, {})[sid] = session
         self._tenant_bytes[tenant] = \
@@ -285,6 +295,9 @@ class SessionTable:
         session.payload = payload
         session.nbytes = nbytes
         session.demoted = False
+        # a fresh payload is activity: a just-revived session must not
+        # re-demote on the next wheel tick
+        session.touched = self.runtime.event.clock.now()
         self._tenant_bytes[tenant] = \
             self._tenant_bytes.get(tenant, 0) + delta
         self._gauge_bytes.inc(delta)
@@ -304,6 +317,7 @@ class SessionTable:
             return False
         now = self.runtime.event.clock.now()
         session.due = now + (lease_time or self.lease_time)
+        session.touched = now
         session.gen += 1
         self._wheel.schedule(session.due, (key, session.gen))
         # re-insert → this tenant dict stays oldest-touched-first
@@ -361,19 +375,44 @@ class SessionTable:
                 break
             if session.demoted or session.nbytes == 0:
                 continue
-            freed = session.nbytes
-            session.payload = None
-            session.nbytes = 0
-            session.demoted = True
-            over -= freed
-            self._tenant_bytes[tenant] -= freed
-            self._gauge_bytes.dec(freed)
-            self.stats["demoted"] += 1
-            self._publish(session)
+            over -= self._demote(session)
             demoted.append(session.key)
         if demoted and self.on_demoted is not None:
             # demotion drops the payload, so whatever it pinned outside
             # the table (conversation KV handles) must release too
+            self.on_demoted(demoted)
+
+    def _demote(self, session: _Session) -> int:
+        """Drop one session's payload to dedup-only; returns the bytes
+        freed inside the table (the on_demoted batch frees the rest)."""
+        freed = session.nbytes
+        session.payload = None
+        session.nbytes = 0
+        session.demoted = True
+        self._tenant_bytes[session.tenant] -= freed
+        self._gauge_bytes.dec(freed)
+        self.stats["demoted"] += 1
+        self._publish(session)
+        return freed
+
+    def _demote_idle(self, now: float) -> None:
+        """Idle-demote sweep (ISSUE 17): one pass per wheel tick over
+        each tenant's oldest-touched session(s).  The per-tenant dicts
+        iterate oldest-touched-first, so the scan stops at the first
+        live session that is not yet idle — cost is O(idle found), not
+        O(sessions)."""
+        idle_before = now - self.demote_idle
+        demoted = []
+        for held in list(self._by_tenant.values()):
+            for session in list(held.values()):
+                if session.touched > idle_before:
+                    break           # oldest-first: the rest are newer
+                if session.demoted or session.nbytes == 0:
+                    continue
+                self._demote(session)
+                self.stats["demoted_idle"] += 1
+                demoted.append(session.key)
+        if demoted and self.on_demoted is not None:
             self.on_demoted(demoted)
 
     def _advance(self) -> None:
@@ -395,6 +434,8 @@ class SessionTable:
             self._expiry_batches.observe(len(lapsed))
             if self.on_expired is not None:
                 self.on_expired(lapsed)
+        if self.demote_idle is not None:
+            self._demote_idle(now)
         if self._snapshot_interval > 0 and now >= self._next_snapshot:
             self._next_snapshot = now + self._snapshot_interval
             self._compact()

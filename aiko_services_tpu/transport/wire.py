@@ -551,7 +551,7 @@ def _is_nd_value(value) -> bool:
 def encode_kv_transfer(transfer_id: str, tenant: str, tokens,
                        start_block: int, block_tokens: int,
                        layout, blocks, first_token: int | None = None,
-                       trace=None) -> bytes:
+                       trace=None, final: bool = True) -> bytes:
     """One KV-transfer envelope: `blocks` is [per block [per layer
     {"k": leaf, "v": leaf}]] covering chain blocks
     [start_block, start_block + len(blocks)) of `tokens`; blocks below
@@ -560,7 +560,14 @@ def encode_kv_transfer(transfer_id: str, tenant: str, tokens,
     indices cross, never their bytes (ROADMAP item 3 residue b).
     `layout` is the donor decoder's storage-layout tuple
     (PrefixKVCache.layout) — the receiver refuses a geometry mismatch
-    before any row lands."""
+    before any row lands.
+
+    `final=False` marks a pipelined chunk-stream member (ISSUE 17):
+    an optional ninth "chunk" param rides the envelope, so a pre-17
+    receiver — which reads params[:8] — treats every chunk as a
+    complete transfer and settles on the first one: degraded (it
+    loses the stream's tail, re-prefilling it) but never wrong,
+    which is what a backward-compatible wire change must be."""
     block_tokens = int(block_tokens)
     payload_blocks = []
     for b, per_layer in enumerate(blocks):
@@ -575,13 +582,14 @@ def encode_kv_transfer(transfer_id: str, tenant: str, tokens,
     if tokens.ndim != 1:
         raise WireError(
             f"kv_transfer tokens must be rank 1, got {tokens.ndim}")
-    return encode_envelope(
-        KV_TRANSFER_COMMAND,
-        [str(transfer_id), str(tenant), str(int(start_block)),
-         str(block_tokens),
-         "" if first_token is None else str(int(first_token)),
-         [str(f) for f in layout], {"tokens": tokens}, payload_blocks],
-        trace=trace)
+    params = [str(transfer_id), str(tenant), str(int(start_block)),
+              str(block_tokens),
+              "" if first_token is None else str(int(first_token)),
+              [str(f) for f in layout], {"tokens": tokens},
+              payload_blocks]
+    if not final:
+        params.append("chunk")
+    return encode_envelope(KV_TRANSFER_COMMAND, params, trace=trace)
 
 
 # same-destination KV transfers coalesced into one envelope (ISSUE 15
@@ -697,6 +705,10 @@ def validate_kv_transfer_params(command, params):
         "first_token": first_token,
         "layout": tuple(str(f) for f in (layout or [])),
         "tokens": tokens, "blocks": checked,
+        # chunk streaming (ISSUE 17): a ninth "chunk" param marks a
+        # non-final stream member; anything else (including absence —
+        # every pre-17 sender) is a complete transfer
+        "final": not (len(params) > 8 and str(params[8]) == "chunk"),
     }
 
 

@@ -1555,6 +1555,15 @@ def bench_llama_interactive(window: float = 12.0):
 # is the block knob for cache and pool alike (ISSUE 15)
 LLAMA_PREFIX = os.environ.get("AIKO_BENCH_LLAMA_PREFIX", "on")
 
+# host KV tier on the conversation rung (ISSUE 17): attach a
+# HostBlockStore and, after the measured window, run an idle/revive
+# phase — every live session's history demotes to host RAM (the
+# SessionTable wheel's shape) and then revives with one more turn, so
+# the rung reports how much resident history the host tier carries and
+# how much of the promotion H2D overlapped the admit wait.  "off"
+# keeps the rung single-tier (the pre-17 behavior).
+LLAMA_HOST_KV = os.environ.get("AIKO_BENCH_LLAMA_HOST_KV", "on")
+
 
 def bench_llama_conversation(window: float = 10.0):
     """Multi-turn conversation rung (ISSUE 13): a seeded multi-session
@@ -1583,6 +1592,13 @@ def bench_llama_conversation(window: float = 10.0):
         else LLAMA_BLOCK
     cache = None if prefix_off else PrefixKVCache(
         block_tokens=block, max_bytes=2 << 30, name="bench_conv")
+    store = None
+    if cache is not None and LLAMA_HOST_KV.lower() not in (
+            "off", "0", "false", ""):
+        from aiko_services_tpu.serving_tiered import HostBlockStore
+        store = HostBlockStore(max_bytes=8 << 30,
+                               name="bench_conv_host")
+        cache.attach_host_store(store)
     _apply_llama_kernel_toggle()
     slots, sps, max_new = 16, 8, 32
     transcript, turns_per_session, user_len = 600, 6, 24
@@ -1690,6 +1706,35 @@ def bench_llama_conversation(window: float = 10.0):
             if value is not None:
                 fields[f"lat_llama_conv_ttft_{label}_{suffix}_ms"] = \
                     round(value, 2)
+    if store is not None:
+        # idle/revive phase (ISSUE 17): every live session goes idle —
+        # its whole history demotes to the host tier (device blocks
+        # freed) — then revives with one more turn.  The revive's
+        # prompt chain must come back via promotion, and the
+        # admission-probe prefetch should land most of it BEFORE the
+        # admit round (the overlap ratio).
+        live = list(sessions)
+        for sid in live:
+            cache.session_store("", sid, sessions[sid]["history"])
+        cache.demote_sessions([("", sid) for sid in live])
+        fields["lat_llama_conv_resident_sessions"] = len(live)
+        fields["lat_llama_conv_host_bytes"] = store.bytes_used
+        promoted0 = cache.stats["promoted"]
+        revived0 = turns_done[0]
+        revive_start = time.perf_counter()
+        for sid in live:
+            submit_turn(sid)
+        while turns_done[0] < revived0 + len(live):
+            decoder.pump()
+        fields["lat_llama_conv_revive_wall_s"] = round(
+            time.perf_counter() - revive_start, 3)
+        fields["lat_llama_conv_promotes"] = \
+            cache.stats["promoted"] - promoted0
+        pstats = cache.promoter.stats
+        fields["lat_llama_conv_promote_overlap_ratio"] = round(
+            (pstats["installs_async"] + pstats["installs_wait"]) /
+            max(1, pstats["installs"]), 4)
+        cache.promoter.stop()
     return fields
 
 
@@ -1780,6 +1825,17 @@ def bench_llama_disagg(window: float = 8.0):
             disagg.decoder.stats["prefix_copy_bytes"],
         "lat_llama_disagg_transfer_batched":
             transfers.get("batched_envelopes", 0),
+        # chunk streaming (ISSUE 17): blocks shipped while the donor
+        # was still prefilling, and the wall-clock the client spent
+        # overlapped with donor compute instead of waiting on it
+        "lat_llama_disagg_chunk_streamed":
+            disagg_out.get("chunk_streamed", 0),
+        "lat_llama_disagg_chunk_installs":
+            disagg_out.get("chunk_installs", 0),
+        "lat_llama_disagg_chunk_dropped":
+            disagg_out.get("chunk_dropped", 0),
+        "lat_llama_disagg_transfer_overlap_s":
+            disagg_out.get("transfer_overlap_s", 0.0),
     }
     for key, label in (("transfer_p50_ms", "transfer_p50_ms"),
                        ("transfer_p95_ms", "transfer_p95_ms")):

@@ -606,6 +606,46 @@ class TestLint:
         assert not any(f.rule == "lint-pallas-fallback"
                        for f in lint_source(source, "element.py"))
 
+    # -- lint-host-transfer (ISSUE 17) -------------------------------------
+    def test_host_transfer_in_handler_flagged(self):
+        # a device->host copy of pool-block rows inside an event
+        # handler is a synchronous tier crossing on the loop
+        rules = self._rules_at(
+            "def process_frame(self, frame):\n"
+            "    k_rows, v_rows = self.pool.block_rows(bid)\n"
+            "    host = np.asarray(k_rows)\n")
+        assert ("lint-host-transfer", 3) in rules
+
+    def test_host_transfer_device_put_hot_path_flagged(self):
+        rules = self._rules_at(
+            "def pump(self):   # graft: hot-path\n"
+            "    stack = jax.device_put(node.v_rows)\n")
+        assert ("lint-host-transfer", 2) in rules
+
+    def test_host_transfer_plain_arrays_exempt(self):
+        # ordinary asarray of non-pool data is the round's job, not a
+        # tier crossing
+        rules = self._rules_at(
+            "def process_frame(self, frame):\n"
+            "    tokens = np.asarray(frame.tokens)\n")
+        assert not any(r == "lint-host-transfer" for r, _ in rules)
+
+    def test_host_transfer_off_loop_exempt(self):
+        # the prefetcher seam itself: a worker-thread stage function is
+        # neither an event context nor hot-marked, so staging is legal
+        rules = self._rules_at(
+            "def _stage(self, job):\n"
+            "    return jax.device_put(job.k_rows)\n")
+        assert not any(r == "lint-host-transfer" for r, _ in rules)
+
+    def test_host_transfer_waiver(self):
+        source = ("def process_frame(self, frame):\n"
+                  "    # audited: one-block debug dump"
+                  "  # graft: disable=lint-host-transfer\n"
+                  "    host = np.asarray(self.pool.block_rows(b))\n")
+        assert not any(f.rule == "lint-host-transfer"
+                       for f in lint_source(source, "element.py"))
+
     def test_package_kernel_sites_carry_fallback_seam(self):
         # the audit the rule encodes: every pallas_call already in the
         # package (ops/attention.py's two kernels and the ISSUE 16

@@ -347,6 +347,81 @@ class TestTransferChaos:
             harness.stop()
 
 
+# -- pipelined chunk streaming (ISSUE 17) -----------------------------------
+
+class TestChunkStreaming:
+    # chunked extends (and so chunk streaming) engage only past the
+    # largest prefill bucket (64): 80 tokens = five 16-token chunks
+    LONG = (PROMPT * 3)[:80]
+
+    def test_chunks_stream_during_prefill(self, params):
+        """With chunked prefill on, every finished chunk's blocks ship
+        IMMEDIATELY: the client installs them while the donor is still
+        prefilling (transfer_overlap_s > 0), the final envelope ships
+        only the remainder, and greedy output is unchanged."""
+        harness = make_harness(params, disagg=True)
+        try:
+            assert harness.wait_discovered(15.0)
+            tokens = run_one(harness, "r1", self.LONG, 10)
+            assert tokens == oracle(params, self.LONG, 10)
+            rstats = harness.prefill.stats
+            cstats = harness.client.stats
+            # 80-token prompt, chunk 16, block 8: four mid-prefill
+            # chunks of two blocks each stream ahead of the final
+            assert rstats["chunks_shipped"] == 4
+            assert rstats["chunk_blocks"] == 8
+            assert cstats["chunk_installs"] == 4
+            assert cstats["chunk_blocks"] == 8
+            assert cstats["chunk_dropped"] == 0
+            assert cstats["chunk_streamed"] == 1
+            assert cstats["transfer_overlap_s"] > 0.0
+            assert cstats["installs"] == 1      # final still settles
+            assert cstats["local_fallbacks"] == 0
+            assert harness.client.pending_count() == 0
+        finally:
+            harness.stop()
+
+    def test_chunk_stream_off_matches(self, params):
+        """chunk_stream=False is the A/B: identical tokens, all
+        blocks ride the single final envelope."""
+        harness = make_harness(params, disagg=True, chunk_stream=False)
+        try:
+            assert harness.wait_discovered(15.0)
+            tokens = run_one(harness, "r1", self.LONG, 10)
+            assert tokens == oracle(params, self.LONG, 10)
+            assert harness.prefill.stats["chunks_shipped"] == 0
+            cstats = harness.client.stats
+            assert cstats["chunk_installs"] == 0
+            assert cstats["chunk_streamed"] == 0
+            assert cstats["transfer_overlap_s"] == 0.0
+            assert cstats["installs"] == 1
+        finally:
+            harness.stop()
+
+    def test_corrupt_chunk_recovers_zero_lost(self, params):
+        """The FIRST streamed chunk truncated in flight: the schema
+        check drops it (counted corrupt, never installed), later
+        members and the fallback ladder still complete the request
+        bit-identically — a lost chunk costs bytes, never answers."""
+        from aiko_services_tpu.transport.chaos import FaultPlan
+        plan = FaultPlan(seed=5)
+        plan.truncate(payload_match="kv_transfer", truncate_to=64,
+                      count=1)
+        harness = make_harness(params, disagg=True, fault_plan=plan,
+                               transfer_timeout=0.5, retries=1)
+        try:
+            assert harness.wait_discovered(15.0)
+            tokens = run_one(harness, "r1", self.LONG, 10,
+                             timeout=120.0)
+            assert tokens == oracle(params, self.LONG, 10)
+            cstats = harness.client.stats
+            assert cstats["transfer_corrupt"] >= 1
+            assert cstats["installs"] + cstats["local_fallbacks"] >= 1
+            assert harness.client.pending_count() == 0
+        finally:
+            harness.stop()
+
+
 # -- in-flight prefix dedup window (PR 13 residue d) -------------------------
 
 class TestDedupWindow:
@@ -872,7 +947,10 @@ class TestReviewFixes:
         """A PrefillRuntime built WITHOUT an explicit prefill_chunk
         must still compute and ship chains for prompts longer than
         its largest bucket (chunked prefill is forced on; the old
-        default truncated the prompt so _ship matched nothing)."""
+        default truncated the prompt so _ship matched nothing).
+        Since past-bucket prompts take the chunked path, chunk
+        streaming engages by default: every chain block must cross
+        exactly once across the chunk envelopes plus the final."""
         from aiko_services_tpu.event import EventEngine
         from aiko_services_tpu.process import ProcessRuntime
         from aiko_services_tpu.serving_disagg import PrefillRuntime
@@ -889,11 +967,16 @@ class TestReviewFixes:
         long_prompt = [(i * 7) % 90 + 1 for i in range(40)]  # > bucket
         prefill.prefill("t1", reply_topic, "", "0",
                         {"tokens": np.asarray(long_prompt, np.int32)})
-        assert rt.event.run_until(lambda: got, timeout=60.0)
-        out = wire.decode_kv_transfer(got[0])
-        assert len(out["blocks"]) == 5          # 40 tokens / block 8
-        assert [int(t) for t in out["tokens"]] == long_prompt
+        assert rt.event.run_until(
+            lambda: got and wire.decode_kv_transfer(got[-1])["final"],
+            timeout=60.0)
+        outs = [wire.decode_kv_transfer(p) for p in got]
+        final = outs[-1]
+        assert all(not o["final"] for o in outs[:-1])
+        assert sum(len(o["blocks"]) for o in outs) == 5   # 40 tok / 8
+        assert [int(t) for t in final["tokens"]] == long_prompt
         assert prefill.stats["empty_ships"] == 0
+        assert prefill.stats["chunks_shipped"] >= 1
         prefill.stop()
         rt.terminate()
 

@@ -280,6 +280,32 @@ class TestSessionTable:
         assert table.outstanding_timers() == 0
         table.stop()
 
+    def test_idle_demotion_wheel(self, table_system):
+        """demote_idle sweeps untouched sessions on the wheel tick:
+        payloads demote (batched on_demoted callback — the KV tier's
+        trigger), keys survive, and a touch or update re-stamps the
+        session past the sweep."""
+        runtime, _, service, engine = table_system
+        demoted = []
+        table = SessionTable(service, num_shards=2, lease_time=10.0,
+                             demote_idle=1.0,
+                             on_demoted=demoted.append)
+        for i in range(6):
+            assert table.create("t", f"s{i}", "x" * 40)
+        settle_virtual(engine, 0.5)
+        table.touch("t", "s0")          # s0 stays hot
+        settle_virtual(engine, 0.8)     # the rest cross 1.0 s idle
+        assert table.stats["demoted_idle"] == 5
+        assert sum(len(b) for b in demoted) == 5
+        assert table.get("t", "s1") is None      # payload demoted
+        assert table.tenant_sessions("t") == 6   # keys retained
+        assert table.get("t", "s0") == "x" * 40  # touched survives
+        assert table.update("t", "s1", "y")      # revival re-stamps
+        settle_virtual(engine, 0.5)
+        assert table.get("t", "s1") == "y"
+        assert table.stats["demoted_idle"] == 5  # not re-demoted
+        table.stop()
+
     def test_sharding_is_stable_and_spread(self):
         shards = [session_shard("tenant", f"s{i}", 8)
                   for i in range(1000)]

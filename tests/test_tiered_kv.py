@@ -1,0 +1,352 @@
+# Tiered KV tests (ISSUE 17): the host-RAM block tier must be
+# lossless — a session chain demoted to HostBlockStore and promoted
+# back produces greedy output BIT-IDENTICAL to the run that never left
+# the device, across the same serving matrix the paged tests prove
+# (int8 x chunked x speculation x paged kernel x mid-stream admits).
+# Both tiers must drain to zero blocks after release (leak audit), the
+# byte budgets must hold per tenant on the host tier, and all-pinned
+# device pressure must route into session demotion instead of refusing
+# forever (demote-not-forget).
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import aiko_services_tpu.serving as serving
+from aiko_services_tpu.models.llama import (LLAMA_PRESETS,
+                                            llama_greedy_decode,
+                                            llama_init)
+from aiko_services_tpu.serving import ContinuousDecoder, PrefixKVCache
+from aiko_services_tpu.serving_tiered import HostBlockStore
+
+CONFIG = dataclasses.replace(LLAMA_PRESETS["tiny"], max_seq_len=96)
+PROMPT = [(i * 13) % 50 + 1 for i in range(40)]
+# 41-token prompt + 8 generated = 49 tokens: six FULL blocks at
+# block=8, and (49 - 1) // 8 == 6 so promote_for covers the whole
+# chain — the exact-drain geometry the leak audit needs
+PROMPT41 = PROMPT + [5]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama_init(jax.random.PRNGKey(0), CONFIG)
+
+
+def oracle(params, prompt, max_new):
+    out = llama_greedy_decode(params, CONFIG,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def run(decoder, requests, rounds=400, midstream=None):
+    done = {}
+    for rid, (prompt, max_new) in requests.items():
+        decoder.submit(rid, prompt, max_new,
+                       lambda rid, t: done.update({rid: t}))
+    total = len(requests) + len(midstream or {})
+    for i in range(rounds):
+        decoder.pump()
+        if i == 1 and midstream:
+            for rid, (prompt, max_new) in midstream.items():
+                decoder.submit(rid, prompt, max_new,
+                               lambda rid, t: done.update({rid: t}))
+            midstream = None
+        if len(done) == total:
+            break
+    assert len(done) == total, f"{len(done)}/{total} completed"
+    return done
+
+
+_SEQ = [0]
+
+
+def tiered(params, block=8, host_mb=64, impl=None, cache_bytes=64 << 20,
+           **kwargs):
+    """One paged decoder with the host KV tier attached; returns
+    (decoder, cache, store)."""
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("prefill_buckets", (64,))
+    kwargs.setdefault("steps_per_sync", 4)
+    _SEQ[0] += 1
+    cache = PrefixKVCache(block_tokens=block, max_bytes=cache_bytes,
+                          name=f"tk{_SEQ[0]}")
+    store = HostBlockStore(max_bytes=host_mb << 20,
+                           name=f"tk{_SEQ[0]}h")
+    cache.attach_host_store(store)
+    before = serving.ATTENTION_IMPL
+    if impl is not None:
+        serving.ATTENTION_IMPL = impl
+    try:
+        decoder = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                    kv_block=block, prefix_cache=cache,
+                                    **kwargs)
+    finally:
+        serving.ATTENTION_IMPL = before
+    return decoder, cache, store
+
+
+REQUESTS = {"a": (PROMPT, 10), "b": (PROMPT[:17] + [3, 4], 8)}
+MIDSTREAM = {"mid": (PROMPT[:9] + [7], 6)}
+
+
+def demote_all(cache, out, requests=REQUESTS, tenant="default"):
+    """Pin every finished sequence (prompt + generated — the session
+    wheel's handle shape) and fire the on_demoted callback for all of
+    them: the whole forest demotes to the host tier."""
+    pairs = []
+    for rid, (prompt, _) in requests.items():
+        leaf, hit = cache.session_store(tenant, rid, prompt + out[rid])
+        assert hit > 0, f"{rid}: nothing cached to pin"
+        pairs.append((tenant, rid))
+    demoted = cache.demote_sessions(pairs)
+    assert demoted > 0
+    return demoted
+
+
+def rekey(requests, tag):
+    return {rid + tag: spec for rid, spec in requests.items()}
+
+
+# -- demote -> promote parity matrix ----------------------------------------
+
+class TestTieredParity:
+    def _cycle(self, params, requests=REQUESTS, midstream=None,
+               **kwargs):
+        """Run, demote EVERYTHING to host, rerun: the revived outputs
+        must be bit-identical and the device cache must have been
+        rebuilt by promotion, not re-prefill alone."""
+        decoder, cache, store = tiered(params, **kwargs)
+        out1 = run(decoder, requests, midstream=midstream)
+        specs = dict(requests)
+        specs.update(midstream or {})
+        demote_all(cache, out1, specs)
+        assert len(cache) == 0          # device tier fully demoted
+        assert decoder.pool.used_blocks() == 0
+        assert len(store) > 0
+        out2 = run(decoder, rekey(requests, "2"),
+                   midstream=rekey(midstream, "2") if midstream
+                   else None)
+        for rid, (prompt, _) in specs.items():
+            assert out2[rid + "2"] == out1[rid], rid
+        assert cache.stats["promoted"] > 0
+        assert cache.promoter.stats["installs"] > 0
+        return decoder, cache, store, out1
+
+    def test_native_with_midstream_admit(self, params):
+        decoder, cache, store, out1 = self._cycle(
+            params, midstream=MIDSTREAM)
+        assert out1["a"] == oracle(params, PROMPT, 10)
+
+    def test_int8(self, params):
+        self._cycle(params, kv_cache_dtype="int8")
+
+    def test_chunked_prefill(self, params):
+        long = {"long": ((PROMPT * 3)[:80], 8)} | REQUESTS
+        self._cycle(params, requests=long, prefill_chunk=16)
+
+    def test_speculative(self, params):
+        self._cycle(params, speculate_k=2)
+
+    @pytest.mark.slow
+    def test_paged_kernel(self, params):
+        self._cycle(params, impl="paged_kernel")
+
+
+# -- async prefetch path ----------------------------------------------------
+
+class TestTieredAsync:
+    def test_prefetch_lands_before_admit(self, params):
+        decoder, cache, store = tiered(params)
+        out1 = run(decoder, {"a": (PROMPT, 10)})
+        full = PROMPT + out1["a"]
+        demote_all(cache, out1, {"a": (PROMPT, 10)})
+        kicked = cache.prefetch("default", full)
+        assert kicked > 0
+        deadline = time.monotonic() + 10.0
+        while not cache.promotions_ready and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cache.promotions_ready, "staging never finished"
+        landed = cache.poll_promotions()
+        assert landed == kicked
+        assert cache.promoter.stats["installs_async"] > 0
+        _, hit = cache.match("default", full)
+        assert hit == landed            # device-resident again
+        out2 = run(decoder, {"a2": (PROMPT, 10)})
+        assert out2["a2"] == out1["a"]
+
+    def test_promote_for_inline(self, params):
+        decoder, cache, store = tiered(params)
+        out1 = run(decoder, {"a": (PROMPT, 10)})
+        full = PROMPT + out1["a"]
+        demote_all(cache, out1, {"a": (PROMPT, 10)})
+        promoted = cache.promote_for("default", full)
+        assert promoted == (len(full) - 1) // 8 * 8
+        assert cache.promoter.stats["installs_sync"] > 0
+        out2 = run(decoder, {"a2": (PROMPT, 10)})
+        assert out2["a2"] == out1["a"]
+
+    def test_prefetch_noop_when_resident(self, params):
+        decoder, cache, store = tiered(params)
+        out1 = run(decoder, {"a": (PROMPT, 10)})
+        # nothing host-resident: the kick must be a cheap no-op
+        assert cache.prefetch("default", PROMPT + out1["a"]) == 0
+
+
+# -- leak audit: both tiers drain to zero -----------------------------------
+
+class TestTieredAudit:
+    def test_leak_audit_both_tiers(self, params):
+        decoder, cache, store = tiered(params)
+        out1 = run(decoder, {"a": (PROMPT41, 8)})
+        full = PROMPT41 + out1["a"]     # 49 tokens: 6 full blocks
+        demote_all(cache, out1, {"a": (PROMPT41, 8)})
+        assert decoder.pool.used_blocks() == 0
+        assert len(cache) == 0
+        assert len(store) == 6
+        assert store.bytes_used == 6 * decoder.pool.block_nbytes
+        assert store.tenant_bytes("default") == store.bytes_used
+        # promotion re-lands the WHOLE chain and the host copies drop
+        promoted = cache.promote_for("default", full)
+        assert promoted == 48
+        assert len(store) == 0
+        assert store.bytes_used == 0
+        assert store.tenant_bytes("default") == 0
+        _, hit = cache.match("default", full)
+        assert hit == 48
+        # demote again with a zero host budget: every put refuses, so
+        # demotion degrades to true eviction and BOTH tiers hit zero
+        leaf, hit = cache.session_store("default", "a", full)
+        assert hit == 48
+        store.max_bytes = 0
+        cache.demote_sessions([("default", "a")])
+        assert decoder.pool.used_blocks() == 0
+        assert len(cache) == 0
+        assert len(store) == 0 and store.bytes_used == 0
+        assert store.stats["refused"] >= 6
+
+    def test_host_store_tenant_budget(self):
+        block = 128
+        store = HostBlockStore(max_bytes=1 << 20,
+                               tenant_max_bytes=3 * block,
+                               name="budget")
+        rows = [np.zeros((1, 2, 2), np.float32)]
+        for i in range(5):
+            assert store.put_from_device(
+                "t1", f"k{i - 1}" if i else "", f"k{i}",
+                rows, rows, block)
+        # LRU front evicted to the tenant cap; the newcomers survive
+        assert store.tenant_bytes("t1") == 3 * block
+        assert store.stats["evicted"] == 2
+        assert not store.has("k0") and not store.has("k1")
+        assert store.has("k4")
+        # one tenant's pressure never evicts another's residency
+        assert store.put_from_device("t2", "", "x0", rows, rows, block)
+        assert store.tenant_bytes("t2") == block
+        assert store.has("k2")
+        assert store.bytes_used == 4 * block
+
+    def test_host_store_global_budget(self):
+        block = 128
+        store = HostBlockStore(max_bytes=2 * block, name="global")
+        rows = [np.zeros((1, 2, 2), np.float32)]
+        for i in range(4):
+            store.put_from_device("t1", "", f"g{i}", rows, rows, block)
+        assert store.bytes_used == 2 * block
+        assert len(store) == 2
+        # an oversized block is refused outright, not thrashed in
+        assert not store.put_from_device("t1", "", "big", rows, rows,
+                                         3 * block)
+        assert store.stats["refused"] >= 1
+
+
+# -- all-pinned pressure routes into demotion (satellite b) -----------------
+
+class TestTieredPressure:
+    def test_all_pinned_evicts_via_demotion(self, params):
+        decoder, cache, store = tiered(params)
+        out1 = run(decoder, {"a": (PROMPT, 10)})
+        full = PROMPT + out1["a"]
+        leaf, hit = cache.session_store("default", "sa", full)
+        assert hit == 48                # six blocks pinned
+        # shrink the device budget BELOW the pinned bytes: the next
+        # harvest's eviction loop finds only pinned leaves and must
+        # demote the oldest session instead of refusing forever
+        cache.max_bytes = 4 * decoder.pool.block_nbytes
+        other = [(i * 7) % 50 + 1 for i in range(24)]
+        out_c = run(decoder, {"c": (other, 6)})
+        assert out_c["c"] == oracle(params, other, 6)
+        assert cache.stats["demoted"] > 0
+        assert len(store) > 0
+        # the session handle is gone (demoted, not leaked)
+        assert cache.session_tokens("default", "sa") == 0
+        # the demoted history still revives bit-identically
+        cache.max_bytes = 64 << 20
+        out2 = run(decoder, {"a2": (PROMPT, 10)})
+        assert out2["a2"] == out1["a"]
+
+
+# -- demote -> shrink -> promote interplay (satellite a) --------------------
+
+class TestTieredShrink:
+    def test_demote_shrink_promote_consistent(self, params):
+        decoder, cache, store = tiered(params)
+        out1 = run(decoder, REQUESTS, midstream=MIDSTREAM)
+        specs = dict(REQUESTS)
+        specs.update(MIDSTREAM)
+        demote_all(cache, out1, specs)
+        pool = decoder.pool
+        assert pool.used_blocks() == 0
+        # the demotion wave's releases are ALL shrink-visible: with
+        # zero owners the free tail is the whole pool
+        assert pool.tail_free_blocks() == pool.num_blocks - 1
+        before = pool.num_blocks
+        released = pool.maybe_shrink()
+        assert pool.num_blocks == before - released
+        if released:
+            assert pool.stats["shrinks"] >= 1
+        assert pool.used_blocks() == 0
+        assert pool.occupancy() == 0.0
+        # promotion re-grows the pool as needed; parity survives the
+        # full demote -> shrink -> promote cycle
+        out2 = run(decoder, rekey(REQUESTS, "2"))
+        for rid in REQUESTS:
+            assert out2[rid + "2"] == out1[rid]
+        assert store.stats["promoted"] > 0
+
+
+# -- resident capacity: host tier holds >= 10x the device budget ------------
+
+class TestTieredCapacity:
+    @pytest.mark.slow
+    def test_resident_sessions_10x_device_budget(self, params):
+        """One pinned session device-resident at a time; eleven more
+        idle on the host tier — the memory-scale claim is that idle
+        history costs host bytes, not pool blocks."""
+        decoder, cache, store = tiered(params, host_mb=64)
+        prompts, outs = {}, {}
+        prev = None
+        for i in range(12):
+            sid = f"s{i}"
+            prompt = [(i * 7 + j * 13) % 50 + 1 for j in range(24)]
+            out = run(decoder, {sid: (prompt, 4)})
+            prompts[sid], outs[sid] = prompt, out[sid]
+            cache.session_store("default", sid, prompt + out[sid])
+            if prev is not None:        # the idle wheel fires
+                cache.demote_sessions([("default", prev)])
+            prev = sid
+        block = decoder.pool.block_nbytes
+        resident = cache.session_tokens("default", prev) // 8 * block
+        assert resident > 0
+        assert store.bytes_used >= 10 * resident, (
+            f"host tier holds {store.bytes_used} bytes, wanted "
+            f">= {10 * resident}")
+        # revive the OLDEST session (demoted eleven sessions ago):
+        # its host-tier history must replay bit-identically
+        out2 = run(decoder, {"s0r": (prompts["s0"], 4)})
+        assert out2["s0r"] == outs["s0"]
