@@ -3,12 +3,15 @@
 # A finding is machine-gateable (rule id + severity) and human-locatable
 # (file:line + message).  CI gates on error-severity findings; warnings
 # surface design smells (dead outputs, unreachable elements) without
-# failing the build.
+# failing the build.  Interprocedural findings (effects.py) additionally
+# carry a provenance `chain`: the root-to-leaf call path, one
+# "path:line qualname" frame per hop, so a finding at an event-handler
+# root names the exact helper route to the offending leaf call.
 
 from __future__ import annotations
 
 import json
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 
 __all__ = ["Finding", "ERROR", "WARNING", "INFO", "has_errors",
            "format_findings"]
@@ -27,14 +30,21 @@ class Finding:
     path: str               # file pathname or definition name
     line: int               # 1-based; 0 = whole-file / whole-definition
     message: str
+    # provenance frames root→leaf ("path:line qualname"); None for
+    # syntactic findings, so pre-chain consumers see an unchanged record
+    chain: tuple = field(default=None, compare=False)
 
     @property
     def location(self) -> str:
         return f"{self.path}:{self.line}" if self.line else self.path
 
     def __str__(self) -> str:
-        return f"{self.severity:<7} {self.rule:<24} {self.location}: " \
+        text = f"{self.severity:<7} {self.rule:<24} {self.location}: " \
                f"{self.message}"
+        if self.chain:
+            text += "".join(f"\n        via {frame}"
+                            for frame in self.chain)
+        return text
 
 
 def has_errors(findings) -> bool:

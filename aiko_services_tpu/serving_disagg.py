@@ -771,7 +771,7 @@ class PrefillClient:
         elapsed = time.perf_counter() - entry["started"]
         self.stats["transfer_bytes"] += len(payload)
         self._transfer_seconds.observe(elapsed)
-        # audited: deque(maxlen=4096)  # graft: disable=lint-unbounded-queue
+        # audited: deque(maxlen=4096) bounds this sample window
         self.transfer_samples.append(elapsed)
         tenant_key = str(entry["tenant"] or "")
         local_layout = self.cache.wire_layout() \

@@ -58,11 +58,14 @@ __all__ = [
     "kv_batch_members", "validate_kv_transfer_params",
     "KV_TRANSFER_SCHEMA", "KV_TRANSFER_DTYPES", "KV_TRANSFER_RANK",
     "kv_leaf_legal", "encode_kv_transfer", "decode_kv_transfer",
+    "BUFFER_MARKER_ARITY", "TRACE_FIELDS_ARITY", "TENANT_FIELDS_ARITY",
+    "HOP_ENTRY_FIELDS", "HOP_ENTRY_OPTIONAL", "KV_TRANSFER_PARAMS",
+    "BUFFER_MARKER",
 ]
 
 MAGIC = b"AIKW"
 WIRE_VERSION = 1
-_MARKER = "__aikb__"
+_MARKER = BUFFER_MARKER = "__aikb__"
 # Trace-context header marker (ISSUE 5): a trailing parameter
 # ["__aikt__", trace_id, span_id, remaining, sent] rides in the
 # envelope header (or appended to the sexpr params on text transports)
@@ -77,6 +80,18 @@ _TRACE = TRACE_MARKER
 # (ops/admission.py) charges the frame to the right per-tenant budget,
 # existing RPC consumers never see it.
 TENANT_MARKER = "__aikn__"
+# Declared envelope arities and field lists — the wire-schema lock
+# (analysis/wire_schema.lock, checked by graft-check's lint-wire-schema
+# via analysis/drift.py) snapshots these, so any envelope change is an
+# explicit two-sided diff: edit the constant AND regenerate the lock.
+BUFFER_MARKER_ARITY = 7    # [tag, index, kind, dtype, dims, codec, meta]
+TRACE_FIELDS_ARITY = 5     # [tag, trace_id, span_id, remaining, sent]
+TENANT_FIELDS_ARITY = 3    # [tag, tenant, tier]
+# one pipeline request hop as it crosses the peer wire (pipeline.py
+# _hop_entry builds it; process_frames_remote consumes positionally)
+HOP_ENTRY_FIELDS = ("stream_id", "inputs", "reply_topic", "hop_id")
+HOP_ENTRY_OPTIONAL = ("trace", "tenant")
+KV_TRANSFER_PARAMS = 8     # required param count; optional 9th "chunk"
 _HEAD = struct.Struct("<BI")            # version, header_len
 _COUNT = struct.Struct("<I")
 _BUFLEN = struct.Struct("<Q")
@@ -349,7 +364,8 @@ def encode_envelope(command: str, parameters=(), codec_hints=None,
 # -- decode ------------------------------------------------------------------
 
 def _restore(obj, buffers, payload_nbytes=0):
-    if isinstance(obj, list) and len(obj) == 7 and obj[0] == _MARKER:
+    if isinstance(obj, list) and len(obj) == BUFFER_MARKER_ARITY \
+            and obj[0] == _MARKER:
         _, index, kind, dtype, dims, codec, meta = obj
         try:
             view = buffers[int(index)]
@@ -653,11 +669,11 @@ def validate_kv_transfer_params(command, params):
     members are checked by exactly the same code."""
     if command != KV_TRANSFER_COMMAND:
         raise WireError(f"not a kv_transfer envelope: {command!r}")
-    if len(params) < 8:
+    if len(params) < KV_TRANSFER_PARAMS:
         raise WireError(f"kv_transfer envelope short: {len(params)} "
                         f"params")
     (transfer_id, tenant, start_block, block_tokens, first_token,
-     layout, token_box, blocks) = params[:8]
+     layout, token_box, blocks) = params[:KV_TRANSFER_PARAMS]
     try:
         start_block = int(str(start_block))
         block_tokens = int(str(block_tokens))
@@ -708,7 +724,8 @@ def validate_kv_transfer_params(command, params):
         # chunk streaming (ISSUE 17): a ninth "chunk" param marks a
         # non-final stream member; anything else (including absence —
         # every pre-17 sender) is a complete transfer
-        "final": not (len(params) > 8 and str(params[8]) == "chunk"),
+        "final": not (len(params) > KV_TRANSFER_PARAMS
+                      and str(params[KV_TRANSFER_PARAMS]) == "chunk"),
     }
 
 

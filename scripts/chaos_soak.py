@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# soak CLI: progress + verdict go to the console by design
+# graft: disable-file=lint-print
 # Chaos soak: the speech pipeline across two runtimes over a ChaosBroker,
 # surviving drops, duplicates, a network partition, and a mid-stream kill
 # of the active serving runtime (ISSUE 4 capstone).
@@ -307,7 +309,8 @@ def run_soak(seed: int = 11, frames: int = 8, drop: float = 0.15,
         # codes with per-row scales — 3.8x fewer host→serving bytes
         remote_wire_codecs={"mel": "i8mel"} if peer else None)
     _settle(engine, 2.0)
-    assert caller.remote_elements_ready(), "setup: discovery failed"
+    if not caller.remote_elements_ready():
+        raise RuntimeError("setup: discovery failed")
 
     # -- fleet health plane (ISSUE 11) ----------------------------------
     aggregator = None

@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# smoke CLI: the console verdict is the product
+# graft: disable-file=lint-print
 # CPU smoke for the disaggregated prefill/decode serving plane
 # (ISSUE 14): the SAME two-pool harness as the lat_llama_disagg_* bench
 # rung (serving_disagg.DisaggHarness), run as a colocated-vs-

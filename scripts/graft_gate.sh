@@ -1,0 +1,16 @@
+#!/bin/sh
+# graft_gate.sh: the repo's one-command static-analysis gate.
+#
+# Runs every graft-check layer (syntactic lint, interprocedural effect
+# analysis, metric/wire drift, example pipelines, stale-waiver audit)
+# in strict mode against the committed findings baseline — so only NEW
+# findings fail, while acknowledged debt stays visible in
+# aiko_services_tpu/analysis/baseline.json.
+#
+# Exit 0 = clean at HEAD (tests/test_analysis.py asserts this), 1 =
+# new findings, 2 = usage/setup error.
+set -eu
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m \
+    aiko_services_tpu.analysis --self-check --strict \
+    --baseline analysis/baseline.json "$@"

@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# dump CLI: the rendered exposition on stdout is the product
+# graft: disable-file=lint-print
 # metrics_dump: scrape a namespace's retained metrics snapshots and
 # print them as Prometheus text exposition or JSON (ISSUE 11 satellite).
 #
@@ -50,6 +52,8 @@ def collect_snapshots(runtime, wait: float = 2.0,
     def handler(topic: str, payload) -> None:
         document = parse_retained_json(payload, require_key="snapshot")
         if document is not None:
+            # one snapshot per topic path, bounded by fleet size over
+            # one collection window — graft: disable=lint-unbounded-cache
             documents[str(document.get("topic_path", topic))] = document
 
     runtime.add_message_handler(handler, topic_filter)
@@ -129,7 +133,7 @@ def main(argv=None) -> int:
         runtime.terminate()
     if not documents:
         print(f"no retained metrics snapshots found in namespace "
-              f"{runtime.namespace!r}",  # graft: disable=lint-print
+              f"{runtime.namespace!r}",
               file=sys.stderr)
         return 1
     return 0

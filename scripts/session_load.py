@@ -1,4 +1,6 @@
 #!/usr/bin/env python
+# smoke CLI: the console verdict is the product
+# graft: disable-file=lint-print
 # Session-load smoke (ISSUE 10): the open-loop arrival generator
 # driving the sharded SessionTable through a real runtime across
 # cardinality rungs, reporting sessions/s, lease churn, shard delta

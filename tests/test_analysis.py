@@ -801,4 +801,10 @@ class TestCLI:
         findings = self_check_findings()
         errors = [f for f in findings if f.severity == "error"]
         assert not errors, "\n".join(str(f) for f in errors)
-        assert main(["--self-check"]) == 0
+
+    def test_graft_gate_strict_baseline_exits_zero_at_head(self):
+        # the scripts/graft_gate.sh invocation: every analysis layer in
+        # strict mode against the committed findings baseline must be
+        # clean at HEAD — only NEW findings may fail this
+        assert main(["--self-check", "--strict",
+                     "--baseline", "analysis/baseline.json"]) == 0

@@ -1,0 +1,427 @@
+# Interprocedural effect analysis, drift checkers, and the findings
+# baseline (ISSUE 18): provenance chains, waiver severing at every
+# frame, metric/wire drift, baseline round-trips, CLI gating.
+
+import json
+from pathlib import Path
+
+import pytest
+
+from aiko_services_tpu.analysis import (
+    ERROR, WARNING, Finding, apply_baseline, effect_findings,
+    fingerprint, format_findings, lint_source, load_baseline, main,
+    metric_drift_findings, wire_schema_findings, write_baseline,
+    write_wire_lock,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _effects(tmp_path, source, name="element.py"):
+    (tmp_path / name).write_text(source)
+    return effect_findings([tmp_path], root=tmp_path)
+
+
+BLOCKING = """\
+import time
+
+class Element:
+    def process_frame(self, stream, frame):
+        self._flush(frame)
+
+    def _flush(self, frame):
+        self._write(frame)
+
+    def _write(self, frame):
+        time.sleep(0.1)
+"""
+
+
+# ---------------------------------------------------------------------------
+# provenance chains: every interprocedural rule, >= 2 calls deep
+# ---------------------------------------------------------------------------
+
+class TestEffectChains:
+    def test_blocking_two_deep_with_chain(self, tmp_path):
+        findings = _effects(tmp_path, BLOCKING)
+        assert [f.rule for f in findings] == ["lint-blocking-call"]
+        finding = findings[0]
+        assert finding.severity == ERROR
+        assert "process_frame" in finding.message
+        assert "2 call(s) deep" in finding.message
+        # root -> _flush -> _write(time.sleep) frames, in that order
+        assert len(finding.chain) == 3
+        assert "process_frame" in finding.chain[0]
+        assert "_flush" in finding.chain[1]
+        assert "time.sleep" in finding.chain[2]
+
+    def test_transfer_two_deep(self, tmp_path):
+        findings = _effects(tmp_path, """\
+import jax
+
+class Element:
+    def process_frame(self, stream, frame):
+        self._emit(frame)
+
+    def _emit(self, frame):
+        return self._pull(frame)
+
+    def _pull(self, frame):
+        return jax.device_get(frame)
+""")
+        assert [f.rule for f in findings] == ["lint-host-transfer"]
+        assert len(findings[0].chain) == 3
+        assert "jax.device_get" in findings[0].chain[-1]
+
+    def test_wall_clock_two_deep(self, tmp_path):
+        findings = _effects(tmp_path, """\
+import time
+
+class Element:
+    def start_stream(self, stream, stream_id):
+        self._stamp()
+
+    def _stamp(self):
+        return self._now()
+
+    def _now(self):
+        return time.time()
+""")
+        assert [f.rule for f in findings] == ["lint-wall-clock"]
+        assert len(findings[0].chain) == 3
+        assert "time.time" in findings[0].chain[-1]
+
+    def test_hot_alloc_two_deep(self, tmp_path):
+        findings = _effects(tmp_path, """\
+import numpy as np
+
+class Decoder:
+    # graft: hot-path
+    def pump(self):
+        self._stage()
+
+    def _stage(self):
+        return self._gather()
+
+    def _gather(self):
+        return np.zeros((4, 4))
+""")
+        assert [f.rule for f in findings] == ["lint-hot-alloc"]
+        assert "hot path" in findings[0].message
+        assert len(findings[0].chain) == 3
+        assert "np.zeros" in findings[0].chain[-1]
+
+    def test_handler_registration_makes_a_root(self, tmp_path):
+        findings = _effects(tmp_path, """\
+import time
+
+class Service:
+    def __init__(self, engine):
+        engine.add_timer_handler(self._tick, 0.1)
+
+    def _tick(self):
+        self._drain()
+
+    def _drain(self):
+        time.sleep(0.5)
+""")
+        assert [f.rule for f in findings] == ["lint-blocking-call"]
+        assert "_tick" in findings[0].message
+
+    def test_depth_zero_left_to_syntactic_rule(self, tmp_path):
+        # a direct leaf in the root is the syntactic lint's finding;
+        # the interprocedural pass must not duplicate it
+        findings = _effects(tmp_path, """\
+import time
+
+class Element:
+    def process_frame(self, stream, frame):
+        time.sleep(0.1)
+""")
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# waivers sever at any frame
+# ---------------------------------------------------------------------------
+
+class TestEffectWaivers:
+    def test_leaf_waiver_kills_effect_at_source(self, tmp_path):
+        source = BLOCKING.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # graft: disable=lint-blocking-call")
+        assert _effects(tmp_path, source) == []
+
+    def test_call_site_waiver_severs_edge(self, tmp_path):
+        source = BLOCKING.replace(
+            "self._flush(frame)",
+            "self._flush(frame)  # graft: disable=lint-blocking-call")
+        assert _effects(tmp_path, source) == []
+
+    def test_root_def_waiver_silences_root(self, tmp_path):
+        source = BLOCKING.replace(
+            "def process_frame(self, stream, frame):",
+            "def process_frame(self, stream, frame):"
+            "  # graft: disable=lint-blocking-call")
+        assert _effects(tmp_path, source) == []
+
+    def test_waiver_for_other_rule_does_not_sever(self, tmp_path):
+        source = BLOCKING.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # graft: disable=lint-hot-alloc")
+        findings = _effects(tmp_path, source)
+        assert [f.rule for f in findings] == ["lint-blocking-call"]
+
+    def test_multiline_statement_waiver_extent(self):
+        # the finding is reported on the continuation line carrying
+        # .result(); a trailing waiver on the statement's FIRST
+        # physical line must still suppress it (statement extent, not
+        # line equality)
+        wrapped = (
+            "class Element:\n"
+            "    def process_frame(self, stream, frame):\n"
+            "        value = frame.get({}\n"
+            "            'x',\n"
+            "            future.result())\n"
+            "        return value\n")
+        findings = lint_source(wrapped.format(""), "element.py")
+        assert [f.rule for f in findings] == ["lint-blocking-call"]
+        assert findings[0].line == 5
+        waived = wrapped.format("  # graft: disable=lint-blocking-call")
+        assert lint_source(waived, "element.py") == []
+
+
+# ---------------------------------------------------------------------------
+# lint-metric-drift
+# ---------------------------------------------------------------------------
+
+def _drift(tmp_path, creator, consumer):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "metrics_mod.py").write_text(creator)
+    (tmp_path / "bench.py").write_text(consumer)
+    files = [pkg / "metrics_mod.py", tmp_path / "bench.py"]
+    return metric_drift_findings(files, tmp_path)
+
+
+class TestMetricDrift:
+    def test_renamed_family_consumed_by_bench_is_an_error(self,
+                                                          tmp_path):
+        findings = _drift(
+            tmp_path,
+            'def build(registry):\n'
+            '    return registry.counter("asr_frames_seen_total")\n',
+            'def report(registry):\n'
+            '    return registry.value("asr_frames_total")\n')
+        errors = [f for f in findings if f.severity == ERROR]
+        assert len(errors) == 1
+        assert "asr_frames_total" in errors[0].message
+        assert errors[0].path.endswith("bench.py")
+        # the orphaned creation side surfaces as the dead-family warning
+        warnings = [f for f in findings if f.severity == WARNING]
+        assert any("asr_frames_seen_total" in f.message
+                   for f in warnings)
+
+    def test_matched_family_is_clean(self, tmp_path):
+        findings = _drift(
+            tmp_path,
+            'def build(registry):\n'
+            '    return registry.counter("asr_frames_total")\n',
+            'def report(registry):\n'
+            '    return registry.value("asr_frames_total")\n')
+        assert findings == []
+
+    def test_waiver_suppresses_consumption_site(self, tmp_path):
+        findings = _drift(
+            tmp_path,
+            'def build(registry):\n'
+            '    return None\n',
+            'def report(registry):\n'
+            '    # external exporter owns this family:'
+            ' graft: disable=lint-metric-drift\n'
+            '    return registry.value("scraped_only_total")\n')
+        assert [f for f in findings if f.severity == ERROR] == []
+
+
+# ---------------------------------------------------------------------------
+# lint-wire-schema
+# ---------------------------------------------------------------------------
+
+class TestWireSchema:
+    def test_fresh_lock_is_clean(self, tmp_path):
+        lock = write_wire_lock(tmp_path / "wire_schema.lock")
+        assert wire_schema_findings(REPO_ROOT, lock_path=lock) == []
+
+    def test_unlocked_field_change_fails(self, tmp_path):
+        lock = write_wire_lock(tmp_path / "wire_schema.lock")
+        document = json.loads(lock.read_text())
+        document["buffer_marker_arity"] = 8
+        lock.write_text(json.dumps(document))
+        findings = wire_schema_findings(REPO_ROOT, lock_path=lock)
+        assert [f.severity for f in findings] == [ERROR]
+        assert "buffer_marker_arity" in findings[0].message
+
+    def test_field_missing_from_lock_fails(self, tmp_path):
+        lock = write_wire_lock(tmp_path / "wire_schema.lock")
+        document = json.loads(lock.read_text())
+        del document["kv_transfer"]
+        lock.write_text(json.dumps(document))
+        # the subtree flattens to one finding per dropped key, so the
+        # failure names every field that moved
+        findings = wire_schema_findings(REPO_ROOT, lock_path=lock)
+        assert findings
+        assert all(f.severity == ERROR for f in findings)
+        assert all("not in the lock" in f.message for f in findings)
+        assert any("kv_transfer" in f.message for f in findings)
+
+    def test_missing_lock_is_an_error(self, tmp_path):
+        findings = wire_schema_findings(
+            REPO_ROOT, lock_path=tmp_path / "absent.lock")
+        assert [f.severity for f in findings] == [ERROR]
+        assert "--update-wire-lock" in findings[0].message
+
+    def test_committed_lock_matches_runtime(self):
+        # the acceptance invariant: wire.py and the committed lock
+        # agree at HEAD
+        assert wire_schema_findings(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# findings baseline
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _finding(self, tmp_path, line=3, message=None):
+        return Finding(
+            "lint-print", ERROR, str(tmp_path / "a.py"), line,
+            message or f"bare print( at a.py:{line}")
+
+    def test_round_trip_suppresses_exactly(self, tmp_path):
+        finding = self._finding(tmp_path)
+        path = tmp_path / "baseline.json"
+        write_baseline(path, [finding], tmp_path)
+        entries = load_baseline(path)
+        assert apply_baseline([finding], entries, tmp_path, path) == []
+
+    def test_line_shift_still_matches(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        write_baseline(path, [self._finding(tmp_path, line=3)],
+                       tmp_path)
+        shifted = self._finding(tmp_path, line=9)
+        entries = load_baseline(path)
+        assert apply_baseline([shifted], entries, tmp_path, path) == []
+
+    def test_new_finding_survives(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        write_baseline(path, [self._finding(tmp_path)], tmp_path)
+        new = Finding("lint-assert", ERROR, str(tmp_path / "a.py"), 5,
+                      "assert used for validation")
+        entries = load_baseline(path)
+        survivors = apply_baseline(
+            [self._finding(tmp_path), new], entries, tmp_path, path)
+        assert survivors == [new]
+
+    def test_extra_occurrence_survives(self, tmp_path):
+        finding = self._finding(tmp_path)
+        path = tmp_path / "baseline.json"
+        write_baseline(path, [finding], tmp_path)
+        entries = load_baseline(path)
+        survivors = apply_baseline([finding, finding], entries,
+                                   tmp_path, path)
+        assert survivors == [finding]
+
+    def test_paid_down_entry_reports_stale(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        write_baseline(path, [self._finding(tmp_path)], tmp_path)
+        entries = load_baseline(path)
+        survivors = apply_baseline([], entries, tmp_path, path)
+        assert [f.rule for f in survivors] == ["baseline-stale"]
+        assert survivors[0].severity == WARNING
+
+    def test_chain_not_part_of_fingerprint(self, tmp_path):
+        bare = self._finding(tmp_path)
+        chained = Finding(bare.rule, bare.severity, bare.path,
+                          bare.line, bare.message,
+                          chain=("a.py:1 f", "a.py:3 g"))
+        assert fingerprint(bare, tmp_path) == \
+            fingerprint(chained, tmp_path)
+
+    def test_malformed_baseline_raises(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text('{"entries": [1, 2]}')
+        with pytest.raises(ValueError):
+            load_baseline(path)
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit-code matrix, JSON schema, baseline flow
+# ---------------------------------------------------------------------------
+
+DEAD_OUTPUT_PIPELINE = {
+    "version": 0, "name": "p", "runtime": "python",
+    "graph": ["(PE_A PE_B)"],
+    "elements": [
+        {"name": "PE_A",
+         "output": [{"name": "x"}, {"name": "unused"}]},
+        {"name": "PE_B", "input": [{"name": "x"}]}]}
+
+
+class TestCLIMatrix:
+    def test_strict_promotes_warnings(self, tmp_path):
+        pathname = tmp_path / "dead.json"
+        pathname.write_text(json.dumps(DEAD_OUTPUT_PIPELINE))
+        assert main(["--pipeline", str(pathname)]) == 0
+        assert main(["--pipeline", str(pathname), "--strict"]) == 1
+
+    def test_json_schema_is_stable(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\nlock = threading.Lock()\n")
+        assert main(["--lint", str(bad), "--format", "json"]) == 1
+        document = json.loads(capsys.readouterr().out)
+        assert document
+        for record in document:
+            assert set(record) == {"rule", "severity", "path", "line",
+                                   "message", "chain"}
+
+    def test_effect_findings_serialize_chain(self, tmp_path):
+        findings = _effects(tmp_path, BLOCKING)
+        document = json.loads(format_findings(findings, "json"))
+        assert document[0]["rule"] == "lint-blocking-call"
+        assert len(document[0]["chain"]) == 3
+
+    def test_baseline_cli_round_trip(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\nlock = threading.Lock()\n")
+        baseline = tmp_path / "baseline.json"
+        assert main(["--lint", str(bad)]) == 1
+        assert main(["--lint", str(bad), "--baseline", str(baseline),
+                     "--update-baseline"]) == 0
+        capsys.readouterr()
+        assert main(["--lint", str(bad),
+                     "--baseline", str(baseline)]) == 0
+        # debt paid down: the stale entry warns, and gates under strict
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main(["--lint", str(good),
+                     "--baseline", str(baseline)]) == 0
+        assert main(["--lint", str(good), "--baseline", str(baseline),
+                     "--strict"]) == 1
+        assert "baseline-stale" in capsys.readouterr().out
+
+    def test_malformed_baseline_is_usage_error(self, tmp_path):
+        bad = tmp_path / "baseline.json"
+        bad.write_text("not json")
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert main(["--lint", str(clean),
+                     "--baseline", str(bad)]) == 2
+
+    def test_update_baseline_needs_baseline(self):
+        assert main(["--update-baseline"]) == 2
+
+    def test_rules_catalog(self, capsys):
+        assert main(["--rules"]) == 0
+        out = capsys.readouterr().out
+        assert "lint-blocking-call" in out
+        assert "lint-metric-drift" in out
+        assert "lint-wire-schema" in out

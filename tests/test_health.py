@@ -481,6 +481,12 @@ class TestFlightRecorder:
         runtime.publish(f"{runtime.topic_path}/0/flight",
                         f"(dump {target})")
         settle_virtual(engine, 0.5)
+        # the dump itself runs on a real-time worker thread (the RPC
+        # handler must not block the event loop on file I/O) — join it,
+        # then settle again so the queued reply drains through the loop
+        assert recorder._dump_worker is not None
+        recorder._dump_worker.join(timeout=10.0)
+        settle_virtual(engine, 0.5)
         assert target.exists()
         assert replies and "dumped" in str(replies[0])
         recorder.close()

@@ -1,3 +1,5 @@
+# A/B harness: the console comparison table is the product
+# graft: disable-file=lint-print
 # In-program A/B of the cross-KV modes at the bench's chip geometry
 # (whisper-small bf16, batch 256, 5 s chunks, 24 tokens): bf16 vs
 # int8 per-position (r4's memory lever, measured −24%) vs int8

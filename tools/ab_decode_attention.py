@@ -1,3 +1,5 @@
+# A/B harness: the console comparison table is the product
+# graft: disable-file=lint-print
 # A/B the decode-attention inner loop IN-PROGRAM (serving._build_step,
 # the exact compiled step the ContinuousDecoder runs): two_pass
 # (score/weight einsums) vs online (flash-style single sweep) vs vpu

@@ -1,3 +1,5 @@
+# A/B harness: the console comparison table is the product
+# graft: disable-file=lint-print
 # In-program A/B of weight-only int8 serving (W8A16,
 # layers.quantize_linear_tree) at the bench's llama geometry: 1b bf16,
 # 256 slots, closed loop.  Decode serving streams the full weight set
@@ -107,7 +109,8 @@ def parity(params, config, n=32):
             if len(done) == n:
                 break
             decoder.pump()
-        assert len(done) == n, f"only {len(done)}/{n} completed"
+        if len(done) != n:
+            raise RuntimeError(f"only {len(done)}/{n} completed")
         outs[wq] = done
         del decoder
     total = match = 0

@@ -1,4 +1,6 @@
 #!/usr/bin/env python3
+# conversion CLI: progress goes to the console by design
+# graft: disable-file=lint-print
 """Convert a HuggingFace Llama checkpoint directory to this framework's
 flat-npz weight scheme.
 

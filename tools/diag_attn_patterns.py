@@ -1,3 +1,5 @@
+# diagnostic harness: the console readout is the product
+# graft: disable-file=lint-print
 # Which decode-attention pattern reaches this chip's real bandwidth
 # ceiling, and does int8 KV with a PURE CONVERT dequant (per-tensor
 # scale folded into the softmax scale) fuse into the dot?

@@ -1,3 +1,5 @@
+# diagnostic harness: the console readout is the product
+# graft: disable-file=lint-print
 # What HBM streaming bandwidth can THIS chip actually reach?  The
 # 819 GB/s v5e spec is the roofline denominator the bench uses;
 # "bandwidth-bound" claims are only meaningful against the best

@@ -1,3 +1,5 @@
+# diagnostic harness: the console readout is the product
+# graft: disable-file=lint-print
 # Diagnose the whisper decode tail's HBM efficiency (r5, verdict item 3
 # follow-through) with the same slope method that cracked the llama
 # decode scan (serving.py KV_WRITE="block" — see its header comment):

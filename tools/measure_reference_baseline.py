@@ -1,4 +1,6 @@
 #!/usr/bin/env python3
+# measurement CLI: the console readout is the product
+# graft: disable-file=lint-print
 """Measure the reference's aloha-honua pass-through rate on this host.
 
 BASELINE.md needs a MEASURED reference number (not an assumed 1.0) to

@@ -1,3 +1,5 @@
+# experiment harness: the console readout is the product
+# graft: disable-file=lint-print
 # Vocoder data-scaling experiment (r5, the residual of VERDICT r4 item
 # 8): the vocoder measured 23.88 dB held-out MCD vs Griffin-Lim-32's
 # 22.72, and the preset note recorded that model scaling plateaued —
@@ -84,7 +86,7 @@ def main():
                                               basis=96), 9000, 96),
     ]
     for name, texts, config, steps, window in runs:
-        t0 = time.time()
+        t0 = time.perf_counter()
         params, config = test_tts.train_vocoder(
             HELD_OUT, vocoder_config=config, texts=texts, steps=steps,
             window=window)
@@ -92,7 +94,7 @@ def main():
         print(f"{name:6s} ({len(texts):2d} utts) "
               f"channels={config.channels} basis={config.basis} "
               f"steps={steps} held-out MCD={mcd:.2f} dB "
-              f"({time.time() - t0:.0f}s)", flush=True)
+              f"({time.perf_counter() - t0:.0f}s)", flush=True)
     print("reference: GL-16 31.58; GL-32 22.72; pre-r5 vocoder 23.88",
           flush=True)
 
