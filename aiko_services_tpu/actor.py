@@ -181,6 +181,17 @@ class Actor(Service):
         self.ec_producer.update("lifecycle", "stopped")
         self.stop()
 
+    def control_drain(self, drain_s="0") -> None:
+        """Graceful wind-down request (ISSUE 19): the lifecycle
+        manager's planned retirements publish `(control_drain N)`
+        instead of `(control_stop)`.  The base actor has nothing to
+        drain, so the default degrades to an immediate stop; serving
+        actors override this to drain their decoder, migrate session
+        KV, and stop themselves when (or before) the deadline the
+        manager holds as the crash-path fallback."""
+        del drain_s
+        self.control_stop()
+
     def stop(self) -> None:
         if self._transport_log_handler is not None:
             # loggers are global by name — leaked handlers would double-

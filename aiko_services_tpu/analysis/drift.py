@@ -329,6 +329,16 @@ def wire_schema_snapshot() -> dict:
                        sorted(wire.KV_TRANSFER_DTYPES.items())},
             "rank": dict(sorted(wire.KV_TRANSFER_RANK.items())),
         },
+        # session migration control legs (ISSUE 19): the offer + its
+        # ack/done replies; the KV payload itself rides kv_transfer
+        # above, so only the new commands and the offer arity lock
+        "kv_migrate": {
+            "command": wire.KV_MIGRATE_COMMAND,
+            "ack_command": wire.KV_MIGRATE_ACK_COMMAND,
+            "done_command": wire.KV_MIGRATE_DONE_COMMAND,
+            "required_params": wire.KV_MIGRATE_PARAMS,
+            "arrays": ["tokens", "history"],
+        },
     }
 
 

@@ -65,7 +65,7 @@ PROTOCOL_AUTOSCALER = ServiceProtocol("autoscaler")
 # scaling with fleet size)
 _SIGNAL_FAMILIES = ("event_mailbox_depth", "pipeline_hop_seconds",
                     "batch_mean_wait_ms", "admission_queue_depth",
-                    "prefill_queue_depth")
+                    "prefill_queue_depth", "serving_active_slots")
 
 
 @dataclass(frozen=True)
@@ -130,12 +130,21 @@ class Autoscaler(Actor):
 
     def __init__(self, runtime, name: str = "autoscaler", manager=None,
                  policy: ScalePolicy | None = None,
-                 interval: float = 2.0, topic_filter: str | None = None):
+                 interval: float = 2.0, topic_filter: str | None = None,
+                 drain_s: float | None = None):
         super().__init__(runtime, name, PROTOCOL_AUTOSCALER)
         self.logger = get_logger(f"autoscaler.{name}")
         self.manager = manager
         self.policy = policy or ScalePolicy()
         self.interval = float(interval)
+        # graceful-drain arming (ISSUE 19): with drain_s set, every
+        # shrink routes through LifeCycleManager.scale_to(...,
+        # drain_s=) — retired runtimes drain and migrate instead of
+        # being killed.  Unarmed, a shrink whose victims still report
+        # live decode slots (the serving_active_slots gauge) is
+        # REFUSED and counted: the pre-drain behaviour silently
+        # dropped that work.
+        self.drain_s = None if drain_s is None else float(drain_s)
         # topic_path is {namespace}/{host}/{pid}; metrics snapshots ride
         # {topic_path}/0/metrics
         self._filter = topic_filter or \
@@ -325,6 +334,16 @@ class Autoscaler(Actor):
         return self._last_action_at is not None and \
             now - self._last_action_at < self.policy.cooldown
 
+    def live_slots(self) -> float:
+        """Worst serving_active_slots gauge (live decode slots +
+        queued requests) across every process with evidence inside
+        the policy window — the shrink-safety signal.  0.0 when no
+        decoder publishes the gauge (non-serving fleets keep the
+        pre-ISSUE-19 shrink behaviour)."""
+        now = self.runtime.event.clock.now()
+        return self._worst("serving_active_slots",
+                           lambda r: r.latest(now, self.policy.window))
+
     def _act(self, delta: int, reason: str, now: float,
              signals: dict) -> None:
         action = "up" if delta > 0 else "down"
@@ -335,8 +354,25 @@ class Autoscaler(Actor):
             # the floor — it would trigger a below-floor respawn next
             # tick and flap forever
             target = max(target, self.policy.min_clients)
+            live = self.live_slots()
+            if live > 0 and self.drain_s is None:
+                # ISSUE 19 bugfix: shrink used to fire scale_to with
+                # no in-flight check — the newest-first victim's live
+                # generations died cold.  Without drain armed the
+                # shrink is refused (and counted) until the fleet
+                # reports zero live slots.
+                self._count_decision("hold", "in-flight")
+                self.logger.warning(
+                    "autoscaler %s: shrink refused — %d live slot(s) "
+                    "reported and drain is not armed", self.name,
+                    int(live))
+                return
         started = time.perf_counter()
-        applied = self.manager.scale_to(target)
+        if delta < 0 and self.drain_s is not None:
+            applied = self.manager.scale_to(target,
+                                            drain_s=self.drain_s)
+        else:
+            applied = self.manager.scale_to(target)
         if applied == 0:
             return
         self._last_action_at = now

@@ -356,9 +356,10 @@ class PE_LlamaAgent(PipelineElement):
             # release the pinned KV handles through the table's hooks.
             sessions, _ = self.get_parameter("sessions", False)
             self._session_table = None
+            self._session_view = None
             if parse_bool(sessions, False) and \
                     self.prefix_cache is not None:
-                from ..state.sessions import SessionTable
+                from ..state.sessions import SessionTable, SessionView
                 session_lease, _ = self.get_parameter(
                     "session_lease", 300.0)
                 session_shards, _ = self.get_parameter(
@@ -375,6 +376,20 @@ class PE_LlamaAgent(PipelineElement):
                     on_expired=self.prefix_cache.demote_sessions,
                     on_demoted=self.prefix_cache.demote_sessions,
                     demote_idle=float(session_idle) or None)
+                # crash re-materialization source (ISSUE 19):
+                # parameter `session_mirror` names ANOTHER runtime's
+                # SessionTable topic root; its shard deltas replicate
+                # into a SessionView here, so when that runtime dies
+                # and callers fail over to this pipeline, the
+                # conversation history is already local — the turn's
+                # full-history re-submit re-prefills (chunked) and
+                # the continuation is BIT-IDENTICAL to a never-crashed
+                # decode, no KV bytes required
+                mirror, _ = self.get_parameter("session_mirror", "")
+                if str(mirror or ""):
+                    self._session_view = SessionView(
+                        self.runtime, str(mirror),
+                        int(session_shards))
             # disaggregated serving (ISSUE 14): parameter `disagg`
             # routes prompts through a PrefillClient — a role=prefill
             # runtime computes the prompt KV and ships it over the
@@ -462,6 +477,9 @@ class PE_LlamaAgent(PipelineElement):
         if getattr(self, "_prefill_client", None) is not None:
             self._prefill_client.stop()
             self._prefill_client = None
+        if getattr(self, "_session_view", None) is not None:
+            self._session_view.terminate()
+            self._session_view = None
         if self._session_table is not None:
             self._session_table.stop()
         if self.prefix_cache is not None and \
@@ -511,6 +529,17 @@ class PE_LlamaAgent(PipelineElement):
                 if isinstance(payload, dict):
                     history = [int(t) for t in
                                payload.get("history", ())]
+                elif getattr(self, "_session_view", None) is not None:
+                    # failover turn (ISSUE 19): the local table has
+                    # never seen this session but the mirrored state
+                    # plane has — adopt its history; on_done below
+                    # re-creates the session locally, so ONE turn
+                    # re-materializes it completely
+                    mirrored = self._session_view.get(tenant,
+                                                      session_id)
+                    if isinstance(mirrored, dict):
+                        history = [int(t) for t in
+                                   mirrored.get("history", ())]
             tokens = (history + turn)[-cap:] if history else turn[-cap:]
             if history and self.prefix_cache is not None and \
                     self.prefix_cache.tiered:

@@ -220,7 +220,17 @@ class LifeCycleManager(Actor):
                                                 topic)
 
     # -- deletion ----------------------------------------------------------
-    def delete_client(self, client_id: str) -> None:
+    def delete_client(self, client_id: str,
+                      drain_s: float | None = None) -> None:
+        """Retire one client.  Default: polite `(control_stop)` now,
+        deletion lease force-kills stragglers.  With `drain_s`
+        (ISSUE 19) the retirement routes through graceful drain
+        instead of kill: the client gets `(control_drain drain_s)` —
+        a serving actor winds its decoder down, migrates session KV,
+        then stops itself — and only a Lease at the HARD deadline
+        falls back to the stop/terminate crash path.  Either way the
+        record pops NOW: the client's eventual LWT must read as a
+        planned exit, never as a death the restart policy respawns."""
         record = self.clients.pop(str(client_id), None)
         if record is None:
             return
@@ -231,13 +241,28 @@ class LifeCycleManager(Actor):
             record.consumer.terminate()
         if record.state_topic:
             self._unwatch_state(record.state_topic, str(client_id))
+        drain = drain_s is not None and drain_s > 0 \
+            and bool(record.topic_path)
         if record.topic_path:
-            # polite ask first; the deletion lease force-kills stragglers
-            self.runtime.publish(f"{record.topic_path}/in",
-                                 "(control_stop)")
+            if drain:
+                self.runtime.publish(f"{record.topic_path}/in",
+                                     f"(control_drain {drain_s})")
+                # the hard deadline: a client that did not finish its
+                # drain inside the window gets the crash path after all
+                Lease(self.runtime.event, float(drain_s), client_id,
+                      lease_expired_handler=lambda cid,
+                      topic=record.topic_path:
+                          self.runtime.publish(f"{topic}/in",
+                                               "(control_stop)"))
+            else:
+                # polite ask first; the deletion lease force-kills
+                # stragglers
+                self.runtime.publish(f"{record.topic_path}/in",
+                                     "(control_stop)")
         handle = self._handles.pop(str(client_id), None)
         if self.terminator:
-            Lease(self.runtime.event, _DELETION_LEASE, client_id,
+            grace = (float(drain_s) if drain else 0.0) + _DELETION_LEASE
+            Lease(self.runtime.event, grace, client_id,
                   lease_expired_handler=lambda cid, h=handle:
                       self.terminator(str(cid), h))
         if self.client_change_handler:
@@ -257,13 +282,15 @@ class LifeCycleManager(Actor):
                        if record.state == "ready"), key=int)
 
     # -- elastic capacity (ISSUE 9: the autoscaler's actuator) --------------
-    def scale_to(self, count: int) -> int:
+    def scale_to(self, count: int, drain_s: float | None = None) -> int:
         """Grow or shrink the fleet to `count` clients.  Growth spawns
         through the normal create path (handshake-leased, supervised
         under the restart policy); shrink retires the NEWEST ready
         clients first — the oldest capacity is the warmest (compiled
-        programs, filled caches), so it is the last to go.  Returns the
-        signed delta actually applied."""
+        programs, filled caches), so it is the last to go.  With
+        `drain_s` (ISSUE 19) each retirement routes through graceful
+        drain (see delete_client) instead of an immediate stop.
+        Returns the signed delta actually applied."""
         count = max(0, int(count))
         current = len(self.clients)
         if count > current:
@@ -273,7 +300,7 @@ class LifeCycleManager(Actor):
         for client_id in reversed(self.ready_ids()):
             if current - removed <= count:
                 break
-            self.delete_client(client_id)
+            self.delete_client(client_id, drain_s=drain_s)
             removed += 1
         return -removed
 

@@ -976,6 +976,40 @@ class PrefixKVCache:
         for tenant, sid in keys:
             self.session_release(tenant, sid)
 
+    def sessions(self) -> list:
+        """Live session handles, oldest-pinned first:
+        [(tenant, sid), ...] — the drain migrator's enumeration
+        surface (ISSUE 19)."""
+        return list(self._sessions)
+
+    def purge(self, demote: bool = True) -> int:
+        """Evict everything evictable: release every session pin,
+        then strip the tree leaf-first until only request-pinned
+        nodes remain.  The drain endgame (ISSUE 19): after migration
+        shipped the chains, the source purges with demote=False — a
+        host-tier copy of state another runtime now owns would be
+        dead weight — and the drain leak audit asserts the pool
+        reaches zero live blocks.  Returns nodes evicted."""
+        for tenant, sid in list(self._sessions):
+            self.session_release(tenant, sid)
+        host = self._host
+        if not demote:
+            self._host = None
+        evicted = 0
+        try:
+            progress = True
+            while progress:
+                progress = False
+                for node in list(self._nodes.values()):
+                    if node.refs or node.children:
+                        continue
+                    self._evict(node)
+                    evicted += 1
+                    progress = True
+        finally:
+            self._host = host
+        return evicted
+
 
 def _slot_attention(layer, config: LlamaConfig, x, cos, sin,
                     k_cache, v_cache, lengths, write_mask):
@@ -1984,7 +2018,13 @@ class ContinuousDecoder:
              # into a SHARED block copies it first)
              "prefix_copy_bytes": 0, "harvest_copy_bytes": 0,
              "cow_copies": 0, "cow_copy_bytes": 0,
-             "install_misaligned": 0},
+             "install_misaligned": 0,
+             # graceful drain (ISSUE 19): submissions refused while
+             # draining, requests handed back for re-routing, and
+             # deadline checkpoints that harvested a live slot's
+             # chain instead of letting it finish
+             "drain_refused": 0, "drain_evacuated": 0,
+             "drain_checkpoints": 0},
             metric="serving_decoder_total",
             help="continuous-decoder events by kind",
             # levels and time-sums stay dict-only: a high-water mark or
@@ -2005,6 +2045,22 @@ class ContinuousDecoder:
         # EWMA of recent working-round wall time (alpha 0.3), fed by
         # pump(): the deadline-aware admission estimate's time base
         self._round_ewma: float | None = None
+        # graceful drain (ISSUE 19): armed by drain() — submit()
+        # refuses new work, pump() checkpoints in-flight slots when
+        # the deadline passes, and the completion callback fires once
+        # when the decoder reaches idle with every live chain
+        # harvested.  The gauge is the autoscaler's shrink-safety
+        # signal: live slots + queued requests, published per decoder
+        # so a fleet shrink can refuse a victim that still holds work.
+        self._draining = False
+        self._drained = False
+        self._drain_deadline: float | None = None
+        self._drain_evacuate = None
+        self._drain_complete = None
+        self._gauge_active = self._registry.gauge(
+            "serving_active_slots",
+            "live decode slots + queued requests (the drain/shrink "
+            "in-flight safety signal)", labels={"decoder": name})
 
     # -- public API --------------------------------------------------------
     def estimated_admit_wait(self, prompt=None,
@@ -2138,6 +2194,16 @@ class ContinuousDecoder:
             admission_verdict=(note or {}).get("verdict", ""),
             admission_wait_s=(note or {}).get("queue_wait_s"),
             prompt_tokens=len(prompt))
+        if self._draining:
+            # drain armed (ISSUE 19): no new admissions — the caller
+            # re-routes to a healthy runtime (pipeline failover) or
+            # the drain destination.  Counted AND journeyed so the
+            # soak can assert the refusal path and a trace shows why
+            # this request bounced.
+            self.stats["drain_refused"] += 1
+            self.journeys.finish(journey, time.monotonic(),
+                                 outcome="drained")
+            return False
         # keep the TAIL on overflow (recent context matters most).
         # Without chunked prefill the largest bucket is a hard cap (an
         # oversized prompt would blow up _admit's scatter); with it,
@@ -2220,6 +2286,7 @@ class ContinuousDecoder:
                 request.prefix_hit = keep * block
                 request.prefix_probed = True
         self._pending.append(request)
+        self._note_active()
         return True
 
     def attach(self, engine, period: float = 0.002) -> int:
@@ -2246,6 +2313,144 @@ class ContinuousDecoder:
     @property
     def idle(self) -> bool:
         return self.active_count == 0 and not self._pending
+
+    def _note_active(self) -> None:
+        self._gauge_active.set(self.active_count + len(self._pending))
+
+    # -- graceful drain (ISSUE 19) -----------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._drained
+
+    def drain(self, deadline: float | None = None,
+              on_evacuate=None, on_complete=None) -> list:
+        """Arm a graceful wind-down: stop admitting, let in-flight
+        slots finish (or checkpoint them at the first round boundary
+        past `deadline`, relative seconds), harvest every live chain
+        into the prefix cache, and fire `on_complete(self)` once when
+        the decoder is idle.  Queued (never-admitted) requests are
+        evacuated NOW and returned as plain descriptors — request_id,
+        prompt, generated-so-far, max_new_tokens, callback, deadline,
+        tenant — for the caller to re-submit elsewhere; checkpointed
+        in-flight slots evacuate the same way through `on_evacuate`.
+        Without an evacuation route a checkpointed request's callback
+        is invoked with whatever generated so far — degraded, never
+        silently dropped.  Idempotent: re-arming tightens the deadline
+        but never un-drains (resume() does that)."""
+        now = time.monotonic()
+        self._draining = True
+        self._drained = False
+        self._drain_deadline = None if deadline is None \
+            else now + float(deadline)
+        if on_evacuate is not None:
+            self._drain_evacuate = on_evacuate
+        if on_complete is not None:
+            self._drain_complete = on_complete
+        pending, self._pending = self._pending, []
+        evacuated = [self._evacuate(request, now) for request in pending]
+        if self.idle:
+            self._drain_finish()
+        self._note_active()
+        return evacuated
+
+    def resume(self) -> None:
+        """Re-open admission after a drain (planned-restart rollback,
+        tests): clears the drain latch; the decoder serves again."""
+        self._draining = False
+        self._drained = False
+        self._drain_deadline = None
+        self._drain_evacuate = None
+        self._drain_complete = None
+
+    def _evacuate(self, request: DecodeRequest, now: float) -> dict:
+        """Close one request's journey as evacuated and hand back a
+        re-submittable descriptor (prompt + generated so far: the
+        continuation's prompt on the next runtime)."""
+        if request.inflight_key and \
+                self._inflight_chains.get(request.inflight_key) \
+                is request:
+            # a queued dedup leader leaves with its registration —
+            # otherwise a post-resume duplicate waits forever on a
+            # chain nobody is prefilling
+            self._inflight_chains.pop(request.inflight_key, None)
+            request.inflight_key = ""
+        self.stats["drain_evacuated"] += 1
+        if request.journey is not None:
+            self.journeys.finish(request.journey, now,
+                                 outcome="evacuated")
+            request.journey = None
+        return {"request_id": request.request_id,
+                "prompt": list(request.prompt),
+                "generated": list(request.generated or []),
+                "max_new_tokens": int(request.max_new_tokens),
+                "callback": request.callback,
+                "deadline": request.deadline,
+                "tenant": request.tenant}
+
+    def _drain_checkpoint(self) -> None:
+        """Deadline checkpoint, at a round boundary: every live slot
+        harvests the complete blocks of its written context into the
+        prefix cache (mid-prefill slots harvest [0, prefill_pos); the
+        decode slots drop the LAST generated token — its KV row is
+        only written when it is fed back next round), then evacuates
+        with its partial generation.  The re-submitted continuation
+        prefix-hits the harvested chain instead of re-prefilling."""
+        now = time.monotonic()
+        for slot in range(self.max_slots):
+            request = self._slots[slot]
+            if request is None:
+                continue
+            if self.prefix_cache is not None:
+                try:
+                    if request.prefilling:
+                        self.harvest_progress(request)
+                    else:
+                        context = list(request.prompt) + \
+                            list(request.generated or [])
+                        self._harvest_rows(slot, request.tenant,
+                                           context[:-1])
+                except Exception:
+                    self.logger.exception(
+                        "drain checkpoint harvest failed for %s",
+                        request.request_id)
+                if request.prefix_nodes:
+                    self.prefix_cache.release(request.prefix_nodes)
+                    request.prefix_nodes = []
+            if self.paged:
+                self._release_slot_blocks(slot)
+            self._slots[slot] = None
+            self.stats["drain_checkpoints"] += 1
+            descriptor = self._evacuate(request, now)
+            if self._drain_evacuate is not None:
+                try:
+                    self._drain_evacuate(descriptor)
+                except Exception:
+                    self.logger.exception(
+                        "drain evacuation failed for %s",
+                        request.request_id)
+            else:
+                try:
+                    request.callback(request.request_id,
+                                     descriptor["generated"])
+                except Exception:
+                    self.logger.exception("callback failed for %s",
+                                          request.request_id)
+        self._note_active()
+
+    def _drain_finish(self) -> None:
+        self._drained = True
+        self._drain_deadline = None
+        callback, self._drain_complete = self._drain_complete, None
+        if callback is not None:
+            try:
+                callback(self)
+            except Exception:
+                self.logger.exception("drain completion callback "
+                                      "failed")
 
     # -- scheduling --------------------------------------------------------
     def _bucket_for(self, length: int) -> int:
@@ -3106,9 +3311,22 @@ class ContinuousDecoder:
             # all-null and their writes drop inside the program
             nbb = -(-bucket // self.kv_block)
             tables_rows = self._tables_scratch[:width, :nbb]
-            for j, slot in enumerate(slots):
-                self._ensure_coverage(slot, nbb * self.kv_block)
-                tables_rows[j] = self._tables_np[slot, :nbb]
+            try:
+                for j, slot in enumerate(slots):
+                    self._ensure_coverage(slot, nbb * self.kv_block)
+                    tables_rows[j] = self._tables_np[slot, :nbb]
+            except Exception:
+                # pool growth refused (HBM exhaustion, injected chaos
+                # fault) before any slot was assigned: release what
+                # the aborted wave already claimed and put the chunk
+                # back at the HEAD of the queue — the escalation path
+                # (alert -> drain) then evacuates these requests as
+                # descriptors instead of silently losing them
+                for slot in slots:
+                    self._release_slot_blocks(slot)
+                free[:0] = slots
+                self._pending[:0] = chunk
+                raise
             tables_rows[len(slots):] = 0  # pad rows must stay null
             (firsts, k_pools, v_pools, self._tokens, self._lengths,
              self._context) = self._admit_fn(bucket, width)(
@@ -3187,6 +3405,7 @@ class ContinuousDecoder:
             # asserts this reaches zero live blocks)
             self._release_slot_blocks(slot)
         self._slots[slot] = None
+        self._note_active()
         self.stats["completed"] += 1
         count = len(request.generated)
         if count >= 2 and request.last_time > request.first_time:
@@ -3268,6 +3487,17 @@ class ContinuousDecoder:
         tokens of slots admitted in EARLIER rounds resolve from their
         stashed admit outputs (device-complete by now), then this
         round's scan emissions deliver, then retirements fire."""
+        if self._draining and not self._drained:
+            # drain tick (ISSUE 19), at the round boundary: past the
+            # deadline every live slot checkpoints (harvest + evacuate)
+            # instead of decoding on; once idle the drain completes —
+            # exactly once, before any new round is planned
+            if self._drain_deadline is not None and \
+                    time.monotonic() >= self._drain_deadline and \
+                    self.active_count:
+                self._drain_checkpoint()
+            if self.idle:
+                self._drain_finish()
         self._round_prefill_tokens = 0
         profiler = self.profiler
         profiler.begin_round()

@@ -1,0 +1,211 @@
+# Serving-plane fault injection (ISSUE 19, robustness tentpole).
+#
+# The chaos layer (transport/chaos.py) injects WIRE faults — drops,
+# duplication, partitions, crashes.  This module injects the serving
+# plane's own failure modes, the ones a TPU fleet actually sees:
+#
+#   * preemption — the scheduler reclaims the device at a round
+#     boundary (GKE spot / Borg preemption lands as a SIGTERM with a
+#     grace window): the armed round never runs, the watchdog fires an
+#     alert, and the decoder drains — in-flight slots checkpoint into
+#     the prefix cache so the evacuated requests resume elsewhere with
+#     their progress intact;
+#   * pool-growth refusal — HBM exhaustion: the paged BlockPool's free
+#     list runs dry and growth is refused for a window, modelling a
+#     device that cannot take another retrace/allocation.  The refusal
+#     surfaces as a caught fault, an alert, and a drain — never a
+#     wedged pump;
+#   * hung scan — a compiled step stops returning in budget (driver
+#     stall, thermal throttle): the watchdog compares each pump round's
+#     wall time against a threshold and escalates the same way.
+#
+# Every fault ends in the SAME escalation — on_alert callbacks then
+# ContinuousDecoder.drain — because that is the production invariant
+# worth testing: no fault class loses a request; they all route through
+# checkpoint-evacuate-migrate (chaos_soak --migrate drives this end to
+# end).  Deterministic by construction: faults arm at explicit round
+# numbers and the clock is injectable.
+
+from __future__ import annotations
+
+import time
+
+from .observe.metrics import MirroredStats, default_registry
+from .utils import get_logger
+
+__all__ = ["ChaosPoolRefusal", "ChaosDecoder"]
+
+
+class ChaosPoolRefusal(RuntimeError):
+    """Injected HBM-exhaustion fault: the pool refused to grow."""
+
+
+class ChaosDecoder:
+    """Fault-injection wrapper around a ContinuousDecoder's pump.
+
+    Register `chaos.pump` with the engine wherever `decoder.pump`
+    would go (flatout handler / timer).  Unarmed, it is a transparent
+    pass-through — the decoder's behavior is bit-identical.  Armed
+    faults fire at deterministic round numbers, count themselves,
+    invoke every `on_alert(kind, detail)` callback, and arm the
+    decoder's graceful drain with the configured deadline."""
+
+    def __init__(self, decoder, name: str = "chaos", clock=None,
+                 drain_deadline: float = 0.0, registry=None):
+        self.decoder = decoder
+        self.name = str(name)
+        self.logger = get_logger(f"serving.chaos.{name}")
+        # injectable wall clock (tests substitute a fake so the hung-
+        # scan threshold is deterministic); the ENGINE clock is wrong
+        # here — a hung scan hangs wall time, not virtual time
+        self._clock = clock or time.perf_counter
+        # deadline handed to decoder.drain on escalation: 0.0 means
+        # "checkpoint at the next round boundary" (preemption grace
+        # windows are short; anything in flight checkpoints NOW)
+        self.drain_deadline = float(drain_deadline)
+        self.on_alert: list = []          # callbacks (kind, detail)
+        # evacuation route for the drained requests: descriptors land
+        # here, and in on_evacuate's hands when set (a migrator, a
+        # re-router) — otherwise each request's own callback delivers
+        # the partial generation (degraded, never silently dropped)
+        self.on_evacuate = None
+        self.evacuated: list = []
+        self.round = 0
+        self._preempt_round: int | None = None
+        self._refuse_until_round: int | None = None
+        self._hung_threshold: float | None = None
+        self._wrapped_alloc = None
+        self.stats = MirroredStats(
+            {"rounds": 0, "preemptions": 0, "alloc_refusals": 0,
+             "hung_scans": 0, "alerts": 0, "drains": 0},
+            metric="chaos_decoder_events_total",
+            help="injected serving-plane faults by kind",
+            registry=registry or default_registry(),
+            labels={"chaos": self.name})
+
+    # -- arming ------------------------------------------------------------
+    def arm_preemption(self, at_round: int) -> None:
+        """Preempt the device at pump round `at_round` (1-based): the
+        round does not run, the alert fires, the decoder drains."""
+        self._preempt_round = int(at_round)
+
+    def arm_alloc_refusal(self, rounds: int) -> None:
+        """Refuse pool GROWTH for the next `rounds` pump rounds: an
+        alloc the free list can satisfy proceeds, one that would grow
+        the device arrays raises ChaosPoolRefusal — caught by pump(),
+        alerted, escalated to drain.  Paged decoders only."""
+        pool = getattr(self.decoder, "pool", None)
+        if pool is None:
+            raise ValueError("alloc refusal needs a paged decoder "
+                             "(dense caches have no block pool)")
+        self._refuse_until_round = self.round + max(1, int(rounds))
+        if self._wrapped_alloc is None:
+            self._wrapped_alloc = pool.alloc_blocks
+
+            def refusing_alloc(count):
+                count = int(count)
+                if self._refusing() and count > len(pool._free):
+                    self.stats["alloc_refusals"] += 1
+                    raise ChaosPoolRefusal(
+                        f"chaos {self.name}: pool growth refused "
+                        f"({count} blocks wanted, "
+                        f"{len(pool._free)} free)")
+                return self._wrapped_alloc(count)
+
+            pool.alloc_blocks = refusing_alloc
+
+    def arm_hung_scan(self, threshold_s: float) -> None:
+        """Escalate when one pump round's wall time exceeds
+        `threshold_s` — the compiled step stopped returning in
+        budget."""
+        self._hung_threshold = float(threshold_s)
+
+    def disarm(self) -> None:
+        """Drop every armed fault and restore the wrapped pool."""
+        self._preempt_round = None
+        self._hung_threshold = None
+        self._refuse_until_round = None
+        self._restore_alloc()
+
+    def _refusing(self) -> bool:
+        return self._refuse_until_round is not None and \
+            self.round <= self._refuse_until_round
+
+    def _restore_alloc(self) -> None:
+        if self._wrapped_alloc is not None:
+            self.decoder.pool.alloc_blocks = self._wrapped_alloc
+            self._wrapped_alloc = None
+
+    # -- escalation --------------------------------------------------------
+    def _alert(self, kind: str, detail: dict) -> None:
+        self.stats["alerts"] += 1
+        self.logger.warning("chaos %s: %s fault at round %d: %r",
+                            self.name, kind, self.round, detail)
+        for callback in list(self.on_alert):
+            try:
+                callback(kind, detail)
+            except Exception:
+                self.logger.exception(
+                    "chaos %s: on_alert callback raised", self.name)
+
+    def _evacuated(self, descriptor: dict) -> None:
+        self.evacuated.append(descriptor)
+        route = self.on_evacuate
+        if route is not None:
+            try:
+                route(descriptor)
+            except Exception:
+                self.logger.exception(
+                    "chaos %s: on_evacuate route failed for %s",
+                    self.name, descriptor["request_id"])
+            return
+        try:
+            descriptor["callback"](descriptor["request_id"],
+                                   descriptor["generated"])
+        except Exception:
+            self.logger.exception(
+                "chaos %s: degraded delivery failed for %s",
+                self.name, descriptor["request_id"])
+
+    def _escalate(self, kind: str, detail: dict) -> None:
+        self._alert(kind, detail)
+        if not self.decoder.draining:
+            self.stats["drains"] += 1
+        # queued (never-admitted) requests come back as the drain's
+        # return value — route them like the checkpointed ones; a
+        # dropped descriptor would be a lost request
+        for descriptor in self.decoder.drain(
+                deadline=self.drain_deadline,
+                on_evacuate=self._evacuated):
+            self._evacuated(descriptor)
+
+    # -- the wrapped pump --------------------------------------------------
+    def pump(self) -> None:
+        self.round += 1
+        self.stats["rounds"] += 1
+        if self._preempt_round is not None and \
+                self.round >= self._preempt_round:
+            # the device is gone for this round; the grace window is
+            # exactly long enough to checkpoint at the next boundary
+            self._preempt_round = None
+            self.stats["preemptions"] += 1
+            self._escalate("preemption", {"round": self.round})
+            return
+        started = self._clock()
+        try:
+            self.decoder.pump()
+        except ChaosPoolRefusal as exc:
+            self._escalate("pool_refusal",
+                           {"round": self.round, "error": str(exc)})
+            return
+        finally:
+            if self._refuse_until_round is not None and \
+                    self.round >= self._refuse_until_round:
+                self._refuse_until_round = None
+                self._restore_alloc()
+        elapsed = self._clock() - started
+        if self._hung_threshold is not None and \
+                elapsed > self._hung_threshold:
+            self.stats["hung_scans"] += 1
+            self._escalate("hung_scan", {"round": self.round,
+                                         "elapsed_s": elapsed})

@@ -60,7 +60,8 @@ from .utils import get_logger
 
 __all__ = ["PROTOCOL_PREFILL", "ROLE_PREFILL", "ROLE_DECODE",
            "ROLE_COLOCATED", "role_tag", "tag_role", "PrefillRuntime",
-           "PrefillClient", "two_pool_autoscalers", "DisaggHarness"]
+           "PrefillClient", "SessionMigrator", "two_pool_autoscalers",
+           "DisaggHarness"]
 
 PROTOCOL_PREFILL = ServiceProtocol("prefill")
 ROLE_PREFILL = "prefill"
@@ -315,20 +316,7 @@ class PrefillRuntime(Actor):
         self._post(reply_topic, payload)
 
     def _wire_blocks(self, keys) -> list:
-        cache = self.cache
-        blocks = []
-        for node in cache.nodes(keys):
-            # block_rows reads the node's storage home — its own rows
-            # in dense mode, the block POOL in paged mode (ISSUE 15:
-            # harvest left the rows in pool blocks, so shipping is the
-            # first and only host copy they ever pay)
-            k_rows, v_rows = cache.block_rows(node)
-            layers = []
-            for k_leaf, v_leaf in zip(k_rows, v_rows):
-                layers.append({"k": _to_host(k_leaf),
-                               "v": _to_host(v_leaf)})
-            blocks.append(layers)
-        return blocks
+        return _chain_wire_blocks(self.cache, keys)
 
     def _post(self, reply_topic: str, payload: bytes) -> None:
         """Ship one finished transfer: immediately, or coalesced with
@@ -372,6 +360,23 @@ class PrefillRuntime(Actor):
         else:
             self.decoder.detach(self.runtime.event)
         super().stop()
+
+
+def _chain_wire_blocks(cache, keys) -> list:
+    """Cached chain blocks -> wire block payloads (host ndarrays)."""
+    blocks = []
+    for node in cache.nodes(keys):
+        # block_rows reads the node's storage home — its own rows
+        # in dense mode, the block POOL in paged mode (ISSUE 15:
+        # harvest left the rows in pool blocks, so shipping is the
+        # first and only host copy they ever pay)
+        k_rows, v_rows = cache.block_rows(node)
+        layers = []
+        for k_leaf, v_leaf in zip(k_rows, v_rows):
+            layers.append({"k": _to_host(k_leaf),
+                           "v": _to_host(v_leaf)})
+        blocks.append(layers)
+    return blocks
 
 
 def _prompt_cap(decoder) -> int:
@@ -1013,6 +1018,357 @@ class PrefillClient:
             self.runtime.peer.unregister_reply_topic(self.reply_topic)
         self.runtime.remove_message_handler(self._on_reply,
                                             self.reply_topic)
+
+
+class SessionMigrator:
+    """Both halves of graceful-drain session KV migration (ISSUE 19).
+
+    A retiring serving runtime's sessions — pinned prefix-cache chains
+    plus their SessionTable records — ship to a drain destination so a
+    migrated conversation's NEXT turn is a prefix hit there, not a full
+    re-prefill.  One instance serves both roles over one binary topic
+    ({runtime.topic_path}/migrate):
+
+      source:  migrate(dest) offers each live session
+               (wire.encode_kv_migrate: tokens the pinned chain covers
+               + the table's history), and on the destination's ack
+               ships the chain as ordinary chunk-streamed KV_TRANSFER
+               envelopes — blocks the destination already holds
+               (content-addressed) cross as handles, host-tier rows are
+               promoted first (promote_for), and the done leg releases
+               the local pin and table record;
+      dest:    probes its cache for the offered chain, acks with its
+               resident-block count, installs arriving chunks with the
+               ordered-cursor guard, and on the final envelope re-pins
+               the chain under the session handle, re-creates the table
+               record, and sends done.
+
+    Failure degrades, never corrupts: a timed-out transfer keeps the
+    session at the source (crash re-materialization from the state
+    plane still covers it), a shed destination table.create releases
+    the freshly-taken pin and withholds done, and a layout/gap anomaly
+    drops chunks — the destination then lands history-only and the
+    first turn there re-prefills.  Single-threaded on the owning
+    runtime's engine, like everything else in this plane."""
+
+    def __init__(self, runtime, cache, table=None, name: str = "migrate",
+                 chunk_blocks: int = 8, transfer_timeout: float = 5.0,
+                 registry=None):
+        if cache is None:
+            raise ValueError("SessionMigrator needs a PrefixKVCache "
+                             "(the pinned chains ARE the cargo)")
+        self.runtime = runtime
+        self.cache = cache
+        self.table = table
+        self.name = str(name)
+        self.logger = get_logger(f"disagg.migrate.{name}")
+        self.chunk_blocks = max(1, int(chunk_blocks))
+        self.transfer_timeout = float(transfer_timeout)
+        self._registry = registry or default_registry()
+        self.topic = f"{runtime.topic_path}/migrate"
+        runtime.add_message_handler(self._on_message, self.topic,
+                                    binary=True)
+        self._outbound: dict[str, dict] = {}     # source-role transfers
+        self._inbound: dict[str, dict] = {}      # destination-role
+        self._done_callback = None
+        self.stats = MirroredStats(
+            {"offers": 0, "received": 0, "acks": 0, "chunks": 0,
+             "shipped_blocks": 0, "handle_blocks": 0,
+             "installed_blocks": 0, "landed": 0, "migrated": 0,
+             "refused": 0, "expired": 0, "dropped_chunks": 0,
+             "corrupt": 0},
+            metric="kv_migrate_events_total",
+            help="session KV migration events by kind",
+            registry=self._registry, labels={"migrator": self.name})
+
+    # -- source role -------------------------------------------------------
+    def migrate(self, dest_topic: str, on_done=None) -> int:
+        """Offer every live session to the migrator at `dest_topic`
+        (a peer's .topic).  Returns the number of offers sent;
+        `on_done(self)` fires once when every offer has settled (done
+        leg or timeout) — with zero sessions it fires immediately."""
+        self._done_callback = on_done
+        sessions = self.table.items() if self.table is not None else []
+        sent = 0
+        for tenant, sid, payload in sessions:
+            history, kv_tokens = [], 0
+            if isinstance(payload, dict):
+                history = [int(t) for t in payload.get("history", ())]
+                kv_tokens = max(0, int(payload.get("kv_tokens", 0)))
+            # the pinned chain covers a history prefix (session_store
+            # matched the history and recorded the hit length)
+            tokens = history[:kv_tokens]
+            transfer_id = f"mig-{uuid.uuid4().hex[:12]}"
+            entry = {"tenant": str(tenant), "sid": str(sid),
+                     "tokens": tokens, "history": history,
+                     "dest": str(dest_topic)}
+            entry["timer"] = self.runtime.event.add_oneshot_handler(
+                lambda tid=transfer_id: self._expired(tid),
+                self.transfer_timeout)
+            self._outbound[transfer_id] = entry
+            context = tracing.current_trace()
+            self.runtime.publish(str(dest_topic), wire.encode_kv_migrate(
+                transfer_id, str(tenant), str(sid), self.topic,
+                np.asarray(tokens, np.int32),
+                np.asarray(history, np.int32),
+                trace=context.to_fields(self.runtime.event.clock.now())
+                if context is not None else None))
+            self.stats["offers"] += 1
+            sent += 1
+        if sent == 0:
+            self._maybe_finished()
+        return sent
+
+    def _restart_timer(self, entry: dict, transfer_id: str,
+                       inbound: bool = False) -> None:
+        timer = entry.pop("timer", None)
+        if timer is not None:
+            self.runtime.event.remove_timer_handler(timer)
+        entry["timer"] = self.runtime.event.add_oneshot_handler(
+            lambda: self._expired(transfer_id, inbound=inbound),
+            self.transfer_timeout)
+
+    def _expired(self, transfer_id: str, inbound: bool = False) -> None:
+        table = self._inbound if inbound else self._outbound
+        entry = table.pop(transfer_id, None)
+        if entry is None:
+            return
+        entry.pop("timer", None)
+        self.stats["expired"] += 1
+        self.logger.warning(
+            "migrate %s: transfer %s (%s/%s) timed out; the session "
+            "stays %s", self.name, transfer_id, entry["tenant"],
+            entry["sid"], "unlanded" if inbound else "at the source")
+        if inbound:
+            # a half-streamed chain is cached (content-addressed, no
+            # harm) but the session never landed — no pin, no record
+            return
+        self._maybe_finished()
+
+    def _maybe_finished(self) -> None:
+        if self._outbound or self._done_callback is None:
+            return
+        callback, self._done_callback = self._done_callback, None
+        callback(self)
+
+    def _settle(self, transfer_id: str, inbound: bool = False):
+        table = self._inbound if inbound else self._outbound
+        entry = table.pop(transfer_id, None)
+        if entry is None:
+            return None
+        timer = entry.pop("timer", None)
+        if timer is not None:
+            self.runtime.event.remove_timer_handler(timer)
+        return entry
+
+    # -- wire dispatch -----------------------------------------------------
+    def _on_message(self, _topic, payload) -> None:
+        try:
+            command, params = wire.decode_envelope(payload)
+        except wire.WireError as exc:
+            self.stats["corrupt"] += 1
+            self.logger.warning("migrate %s: corrupt envelope dropped: "
+                                "%s", self.name, exc)
+            return
+        try:
+            if command == wire.KV_MIGRATE_COMMAND:
+                self._on_offer(command, params)
+            elif command == wire.KV_MIGRATE_ACK_COMMAND:
+                self._on_ack(command, params)
+            elif command == wire.KV_MIGRATE_DONE_COMMAND:
+                self._on_done_leg(command, params)
+            elif command == wire.KV_TRANSFER_COMMAND:
+                self._on_transfer(command, params)
+            else:
+                self.stats["corrupt"] += 1
+                self.logger.warning("migrate %s: unexpected command %r "
+                                    "dropped", self.name, command)
+        except wire.WireError as exc:
+            self.stats["corrupt"] += 1
+            self.logger.warning("migrate %s: malformed %s dropped: %s",
+                                self.name, command, exc)
+
+    # -- destination role --------------------------------------------------
+    def _on_offer(self, command, params) -> None:
+        out = wire.validate_kv_migrate_params(command, params)
+        transfer_id = out["transfer_id"]
+        tenant = out["tenant"]
+        tokens = [int(t) for t in np.asarray(out["tokens"])]
+        self.stats["received"] += 1
+        have = 0
+        if tokens:
+            if self.cache.tiered:
+                # an earlier migration/demotion may have left this
+                # chain host-resident HERE — promote before probing so
+                # the ack's have mark spares those blocks the wire.
+                # promote_for uses admit semantics ((len-1)//block: the
+                # last position's KV is recomputed at admit) but the
+                # migrator moves WHOLE chains — extend by a sentinel so
+                # the final block promotes too
+                self.cache.promote_for(tenant, tokens + tokens[-1:])
+            _, have = self.cache.match(tenant, tokens)
+        block = self.cache.block_tokens
+        entry = {"tenant": tenant, "sid": out["sid"], "tokens": tokens,
+                 "history": [int(t) for t in np.asarray(out["history"])],
+                 "reply_topic": out["reply_topic"],
+                 "cursor": None, "installed": 0}
+        self._inbound[transfer_id] = entry
+        self._restart_timer(entry, transfer_id, inbound=True)
+        context = tracing.current_trace()
+        self.runtime.publish(
+            out["reply_topic"],
+            wire.encode_kv_migrate_reply(
+                wire.KV_MIGRATE_ACK_COMMAND, transfer_id, have // block,
+                trace=context.to_fields(self.runtime.event.clock.now())
+                if context is not None else None))
+
+    def _on_transfer(self, command, params) -> None:
+        out = wire.validate_kv_transfer_params(command, params)
+        transfer_id = out["transfer_id"]
+        entry = self._inbound.get(transfer_id)
+        if entry is None:
+            return              # late chunk after timeout
+        cache = self.cache
+        installed = 0
+        usable = not out["blocks"] or \
+            tuple(str(f) for f in out["layout"]) == cache.wire_layout()
+        if usable and out["blocks"] and entry["cursor"] is not None \
+                and out["start_block"] != entry["cursor"]:
+            # ordered-cursor guard: a lost sibling left a gap — later
+            # chunks no longer extend the landed prefix
+            usable = False
+        if usable and out["blocks"]:
+            try:
+                installed = cache.install_chain(
+                    entry["tenant"], out["tokens"], out["start_block"],
+                    self._landing(out["blocks"]))
+                entry["cursor"] = out["start_block"] + len(out["blocks"])
+                entry["installed"] += installed
+                self.stats["installed_blocks"] += installed
+            except (ValueError, TypeError, IndexError) as exc:
+                self.stats["dropped_chunks"] += 1
+                self.logger.warning(
+                    "migrate %s: transfer %s chunk refused at install "
+                    "(%s); dropped", self.name, transfer_id, exc)
+        elif out["blocks"]:
+            self.stats["dropped_chunks"] += 1
+        if not out["final"]:
+            self._restart_timer(entry, transfer_id, inbound=True)
+            return
+        self._land(transfer_id, self._settle(transfer_id, inbound=True))
+
+    def _land(self, transfer_id: str, entry: dict) -> None:
+        """Final envelope arrived: pin the (partially or fully) landed
+        chain under the session handle, re-create the table record, and
+        send done.  A shed create withholds done — the source's timeout
+        then keeps the session there instead of deleting the only
+        surviving copy."""
+        cache = self.cache
+        tenant, sid = entry["tenant"], entry["sid"]
+        leaf, kv_tokens = cache.session_store(tenant, sid,
+                                              entry["history"])
+        if self.table is not None and not self.table.create(
+                tenant, sid, {"history": entry["history"],
+                              "kv": leaf or "",
+                              "kv_tokens": kv_tokens}):
+            cache.session_release(tenant, sid)
+            self.stats["refused"] += 1
+            self.logger.warning(
+                "migrate %s: transfer %s refused — destination table "
+                "shed (%s/%s); withholding done", self.name,
+                transfer_id, tenant, sid)
+            return
+        self.stats["landed"] += 1
+        context = tracing.current_trace()
+        self.runtime.publish(
+            entry["reply_topic"],
+            wire.encode_kv_migrate_reply(
+                wire.KV_MIGRATE_DONE_COMMAND, transfer_id,
+                entry["installed"],
+                trace=context.to_fields(self.runtime.event.clock.now())
+                if context is not None else None))
+
+    def _landing(self, wire_blocks) -> list:
+        if not self.cache.paged:
+            # dense cache: owned host copies (see PrefillClient — the
+            # admit-time concat device-puts once per layer)
+            return [{"k": [_copy_host(layer["k"]) for layer in block],
+                     "v": [_copy_host(layer["v"]) for layer in block]}
+                    for block in wire_blocks]
+        return [{"k": [layer["k"] for layer in block],
+                 "v": [layer["v"] for layer in block]}
+                for block in wire_blocks]
+
+    # -- source role, reply legs -------------------------------------------
+    def _on_ack(self, command, params) -> None:
+        transfer_id, have_blocks = \
+            wire.validate_kv_migrate_reply(command, params)
+        entry = self._outbound.get(transfer_id)
+        if entry is None:
+            return
+        self.stats["acks"] += 1
+        cache = self.cache
+        block = cache.block_tokens
+        tenant, tokens = entry["tenant"], entry["tokens"]
+        if cache.tiered and tokens:
+            # demoted session rows must be pool-resident before
+            # block_rows can ship them — sync whole-chain promotion
+            # (the admit-semantics sentinel again: ship the final
+            # block as well, not just the probe-relevant prefix)
+            cache.promote_for(tenant, tokens + tokens[-1:])
+        keys, hit = cache.match(tenant, tokens)
+        start = min(max(0, int(have_blocks)), hit // block)
+        end = hit // block
+        self.stats["handle_blocks"] += start
+        self.stats["shipped_blocks"] += end - start
+        context = tracing.current_trace()
+        trace = context.to_fields(self.runtime.event.clock.now()) \
+            if context is not None else None
+        # chunk-streamed ship: every envelope carries the full token
+        # list (install_chain re-keys from it), blocks in chunk_blocks
+        # strides; the final flag rides the last envelope — always
+        # sent, even with zero blocks to move, because it is what
+        # triggers the destination's land
+        cursor = start
+        while True:
+            upto = min(end, cursor + self.chunk_blocks)
+            final = upto >= end
+            self.runtime.publish(entry["dest"], wire.encode_kv_transfer(
+                transfer_id, tenant, tokens, cursor, block,
+                cache.wire_layout(),
+                _chain_wire_blocks(cache, keys[cursor:upto]),
+                trace=trace, final=final))
+            self.stats["chunks"] += 1
+            cursor = upto
+            if final:
+                break
+        self._restart_timer(entry, transfer_id)
+
+    def _on_done_leg(self, command, params) -> None:
+        transfer_id, _installed = \
+            wire.validate_kv_migrate_reply(command, params)
+        entry = self._settle(transfer_id)
+        if entry is None:
+            return
+        # the destination owns the session now: drop the local pin and
+        # the table record (its demotion hook must NOT fire — remove,
+        # not demote)
+        self.cache.session_release(entry["tenant"], entry["sid"])
+        if self.table is not None:
+            self.table.remove(entry["tenant"], entry["sid"],
+                              reason="migrated")
+        self.stats["migrated"] += 1
+        self._maybe_finished()
+
+    def pending_count(self) -> int:
+        return len(self._outbound) + len(self._inbound)
+
+    def stop(self) -> None:
+        for transfer_id in list(self._outbound):
+            self._settle(transfer_id)       # sessions stay local
+        for transfer_id in list(self._inbound):
+            self._settle(transfer_id, inbound=True)
+        self.runtime.remove_message_handler(self._on_message, self.topic)
 
 
 def two_pool_autoscalers(runtime, prefill_manager, decode_manager,

@@ -287,12 +287,22 @@ class AsyncPromoter:
 
     def __init__(self, cache, store: HostBlockStore,
                  name: str | None = None, registry=None,
-                 wait_s: float = 2.0):
+                 wait_s: float = 2.0, max_batch_blocks: int = 16,
+                 max_inflight: int = 4):
         self.cache = cache
         self.store = store
         self.name = str(name or f"{store.name}.promote")
         self.wait_s = float(wait_s)
-        self.logger = get_logger(f"serving.{self.name}")
+        # staging bounds (ISSUE 19 satellite, ROADMAP item 3 residue
+        # d): one prefetch stages at most `max_batch_blocks` blocks
+        # and at most `max_inflight` chains stage concurrently — a
+        # 100-block history cannot park an admit round behind one
+        # whole-chain H2D.  The deferred remainder re-kicks on the
+        # next touch/probe (both paths call prefetch again), and the
+        # admit-time promote_for fallback stays uncapped: by then the
+        # chain is needed NOW, not opportunistically.
+        self.max_batch_blocks = max(1, int(max_batch_blocks))
+        self.max_inflight = max(1, int(max_inflight))
         self._jobs: dict = {}           # first key -> _PromoteJob
         self._ready: list = []          # staged, awaiting install
         self._lock = Lock(f"{self.name}._ready")
@@ -307,6 +317,11 @@ class AsyncPromoter:
             metric="kv_promote_events_total",
             help="host-tier KV promotion events by kind",
             registry=self._registry,
+            labels={"promoter": self.name})
+        self._deferred = self._registry.counter(
+            "kv_promote_deferred_total",
+            "prefetch blocks deferred by the staging depth cap or "
+            "the in-flight chain limit",
             labels={"promoter": self.name})
 
     # -- event-loop side ---------------------------------------------------
@@ -336,15 +351,26 @@ class AsyncPromoter:
     def prefetch(self, tenant: str, tokens) -> int:
         """Kick an async promotion for the host-resident tail of this
         prompt's chain; returns the tokens being promoted (0: nothing
-        host-resident, already device-resident, or already in
-        flight).  Non-blocking — safe from admission probes and
-        session touches on the event loop."""
+        host-resident, already device-resident, already in flight, or
+        deferred by the staging bounds).  Non-blocking — safe from
+        admission probes and session touches on the event loop.
+        Bounded (ISSUE 19 satellite): at most max_batch_blocks stage
+        per kick and max_inflight chains stage concurrently; the
+        remainder counts kv_promote_deferred_total and re-kicks on
+        the chain's next probe (the leading run is then device-
+        resident, so staging resumes exactly where it stopped)."""
         keys, device, nodes = self._segment(tenant, tokens)
         if not nodes:
             return 0
         first = keys[device]
         if first in self._jobs:
             return 0                     # already staging/staged
+        if len(self._jobs) >= self.max_inflight:
+            self._deferred.inc(len(nodes))
+            return 0
+        if len(nodes) > self.max_batch_blocks:
+            self._deferred.inc(len(nodes) - self.max_batch_blocks)
+            nodes = nodes[:self.max_batch_blocks]
         job = _PromoteJob(
             first, str(tenant or "default"),
             keys[device:device + len(nodes)],

@@ -232,6 +232,13 @@ class SessionTable:
     def tenant_sessions(self, tenant: str) -> int:
         return len(self._by_tenant.get(tenant, ()))
 
+    def items(self) -> list:
+        """Live sessions as [(tenant, sid, payload), ...] — the drain
+        migrator's enumeration surface (ISSUE 19): everything a
+        retiring runtime must ship before it stops."""
+        return [(tenant, sid, session.payload)
+                for (tenant, sid), session in self._sessions.items()]
+
     def tenant_bytes(self, tenant: str) -> int:
         return self._tenant_bytes.get(tenant, 0)
 

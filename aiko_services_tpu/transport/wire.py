@@ -60,7 +60,10 @@ __all__ = [
     "kv_leaf_legal", "encode_kv_transfer", "decode_kv_transfer",
     "BUFFER_MARKER_ARITY", "TRACE_FIELDS_ARITY", "TENANT_FIELDS_ARITY",
     "HOP_ENTRY_FIELDS", "HOP_ENTRY_OPTIONAL", "KV_TRANSFER_PARAMS",
-    "BUFFER_MARKER",
+    "BUFFER_MARKER", "KV_MIGRATE_COMMAND", "KV_MIGRATE_ACK_COMMAND",
+    "KV_MIGRATE_DONE_COMMAND", "KV_MIGRATE_PARAMS",
+    "encode_kv_migrate", "validate_kv_migrate_params",
+    "encode_kv_migrate_reply", "validate_kv_migrate_reply",
 ]
 
 MAGIC = b"AIKW"
@@ -727,6 +730,97 @@ def validate_kv_transfer_params(command, params):
         "final": not (len(params) > KV_TRANSFER_PARAMS
                       and str(params[KV_TRANSFER_PARAMS]) == "chunk"),
     }
+
+
+# -- session KV migration (ISSUE 19) -----------------------------------------
+# Graceful drain ships a session's pinned prefix chain to a drain
+# destination as ordinary chunk-streamed KV_TRANSFER envelopes; the
+# control legs around those transfers are three tiny envelopes of their
+# own.  The offer carries BOTH token lists a session owns — the pinned
+# chain's tokens (what the KV blocks cover) and the conversation
+# history (what the SessionTable payload holds) — so the destination
+# can re-pin AND re-create the session record in one landing.
+#
+#   offer (source -> destination /migrate):
+#     [transfer_id, tenant, sid, reply_topic,
+#      {"tokens": i32[*]}, {"history": i32[*]}]
+#   ack (destination -> reply_topic): [transfer_id, have_blocks]
+#     — have_blocks leading chain blocks are already resident at the
+#     destination (content-addressed), so the source ships handles
+#     below that mark, bytes above it
+#   done (destination -> reply_topic): [transfer_id, installed_blocks]
+
+KV_MIGRATE_COMMAND = "kv_migrate"
+KV_MIGRATE_ACK_COMMAND = "kv_migrate_ack"
+KV_MIGRATE_DONE_COMMAND = "kv_migrate_done"
+KV_MIGRATE_PARAMS = 6       # offer's required param count
+
+
+def encode_kv_migrate(transfer_id: str, tenant: str, sid: str,
+                      reply_topic: str, tokens, history,
+                      trace=None) -> bytes:
+    """One session-migration offer envelope (see layout above)."""
+    tokens = np.asarray(tokens, np.int32)
+    history = np.asarray(history, np.int32)
+    if tokens.ndim != 1 or history.ndim != 1:
+        raise WireError(
+            f"kv_migrate tokens/history must be rank 1, got "
+            f"{tokens.ndim}/{history.ndim}")
+    return encode_envelope(
+        KV_MIGRATE_COMMAND,
+        [str(transfer_id), str(tenant), str(sid), str(reply_topic),
+         {"tokens": tokens}, {"history": history}], trace=trace)
+
+
+def validate_kv_migrate_params(command, params):
+    """Decode-side twin of encode_kv_migrate: returns {transfer_id,
+    tenant, sid, reply_topic, tokens, history} with both arrays
+    schema-checked, or raises WireError."""
+    if command != KV_MIGRATE_COMMAND:
+        raise WireError(f"not a kv_migrate envelope: {command!r}")
+    if len(params) < KV_MIGRATE_PARAMS:
+        raise WireError(
+            f"kv_migrate envelope short: {len(params)} params")
+    (transfer_id, tenant, sid, reply_topic,
+     token_box, history_box) = params[:KV_MIGRATE_PARAMS]
+    arrays = {}
+    for name, box in (("tokens", token_box), ("history", history_box)):
+        value = (box or {}).get(name) if isinstance(box, dict) else None
+        if value is None or not _is_nd_value(value) or \
+                not kv_leaf_legal("tokens", value.dtype, value.ndim):
+            raise WireError(f"kv_migrate {name} missing or not i32[*]")
+        arrays[name] = value
+    return {"transfer_id": str(transfer_id), "tenant": str(tenant),
+            "sid": str(sid), "reply_topic": str(reply_topic),
+            "tokens": arrays["tokens"], "history": arrays["history"]}
+
+
+def encode_kv_migrate_reply(command: str, transfer_id: str,
+                            blocks: int, trace=None) -> bytes:
+    """Ack/done control leg: [transfer_id, blocks] under `command`
+    (KV_MIGRATE_ACK_COMMAND or KV_MIGRATE_DONE_COMMAND)."""
+    if command not in (KV_MIGRATE_ACK_COMMAND, KV_MIGRATE_DONE_COMMAND):
+        raise WireError(f"not a kv_migrate reply command: {command!r}")
+    return encode_envelope(command,
+                           [str(transfer_id), str(int(blocks))],
+                           trace=trace)
+
+
+def validate_kv_migrate_reply(command, params) -> tuple:
+    """(transfer_id, blocks) of an ack/done leg, or WireError."""
+    if command not in (KV_MIGRATE_ACK_COMMAND, KV_MIGRATE_DONE_COMMAND):
+        raise WireError(f"not a kv_migrate reply envelope: {command!r}")
+    if len(params) < 2:
+        raise WireError(
+            f"kv_migrate reply short: {len(params)} params")
+    try:
+        blocks = int(str(params[1]))
+    except (TypeError, ValueError) as exc:
+        raise WireError(
+            f"kv_migrate reply blocks malformed: {exc}") from exc
+    if blocks < 0:
+        raise WireError(f"kv_migrate reply blocks negative: {blocks}")
+    return str(params[0]), blocks
 
 
 def encode_rpc(command: str, parameters=(), transport=None,

@@ -811,6 +811,292 @@ def run_tenant_soak(seed: int = 11, polite_frames: int = 6,
     return report
 
 
+def run_migrate_soak(seed: int = 11, sessions: int = 2,
+                     victim_new: int = 32) -> dict:
+    """Serving-plane fault-tolerance acceptance (ISSUE 19): two paged
+    serving runtimes on one wire.  Conversations pin their KV under
+    session handles on A; a SEEDED preemption lands mid-conversation,
+    so the chaos seam alerts, drains, and checkpoints the in-flight
+    victim at a round boundary.  The evacuated descriptor resumes on
+    the standby B and the stitched output must be BIT-IDENTICAL to a
+    never-preempted decode (zero lost requests).  A then migrates every
+    pinned session to B over chunk-streamed kv_transfer envelopes —
+    turn 2 on B is a pure prefix hit (zero re-prefill) — and the leak
+    audit walks A to zero: no table entries, no cache nodes, no live
+    pool blocks, no pending transfers on either side."""
+    import dataclasses
+    import random
+    from types import SimpleNamespace
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiko_services_tpu.event import EventEngine
+    from aiko_services_tpu.models.llama import (LLAMA_PRESETS,
+                                                llama_greedy_decode,
+                                                llama_init)
+    from aiko_services_tpu.process import ProcessRuntime
+    from aiko_services_tpu.serving import (ContinuousDecoder,
+                                           PrefixKVCache)
+    from aiko_services_tpu.serving_chaos import ChaosDecoder
+    from aiko_services_tpu.serving_disagg import SessionMigrator
+    from aiko_services_tpu.state.sessions import SessionTable
+    from aiko_services_tpu.transport.memory import (MemoryBroker,
+                                                    MemoryMessage)
+
+    wall_start = time.monotonic()
+    rng = random.Random(seed)
+    config = dataclasses.replace(LLAMA_PRESETS["tiny"], max_seq_len=96)
+    params = llama_init(jax.random.PRNGKey(0), config)
+    block = 8
+
+    def oracle(prompt, count):
+        out = llama_greedy_decode(params, config,
+                                  jnp.asarray([prompt], jnp.int32),
+                                  max_tokens=count)
+        return [int(t) for t in np.asarray(out)[0]]
+
+    # REAL clock: drains, chunk transfers, and the chaos watchdog all
+    # run on wall time here — this is the scenario the virtual-clock
+    # unit tests cannot exercise
+    engine = EventEngine()
+    broker = MemoryBroker()
+    seq = [0]
+
+    def make_side(name):
+        def factory(on_message, lwt_topic, lwt_payload, lwt_retain):
+            return MemoryMessage(
+                on_message=on_message, broker=broker,
+                lwt_topic=lwt_topic, lwt_payload=lwt_payload,
+                lwt_retain=lwt_retain, client_id=name)
+        runtime = ProcessRuntime(name=name, engine=engine,
+                                 transport_factory=factory).initialize()
+        seq[0] += 1
+        tag = f"migsoak{seed}_{seq[0]}"
+        cache = PrefixKVCache(block_tokens=block, max_bytes=64 << 20,
+                              name=tag)
+        decoder = ContinuousDecoder(
+            params, config, paged_kv=True, kv_block=block,
+            prefix_cache=cache, max_slots=4, prefill_buckets=(64,),
+            steps_per_sync=4, name=tag)
+        table = SessionTable(
+            SimpleNamespace(runtime=runtime,
+                            topic_path=runtime.topic_path),
+            num_shards=1)
+        migrator = SessionMigrator(runtime, cache, table=table,
+                                   name=tag, chunk_blocks=2,
+                                   transfer_timeout=30.0)
+        return SimpleNamespace(rt=runtime, cache=cache,
+                               decoder=decoder, table=table,
+                               mig=migrator)
+
+    a = make_side("migsoak_a")
+    b = make_side("migsoak_b")
+    alerts: list = []
+    chaos = ChaosDecoder(a.decoder, name=f"migsoak{seed}")
+    chaos.on_alert.append(lambda kind, detail: alerts.append(kind))
+    # A pumps THROUGH the fault seam; B pumps clean
+    engine.add_flatout_handler(chaos.pump)
+    engine.add_flatout_handler(b.decoder.pump)
+
+    def turn(side, rid, prompt, count, timeout=120.0):
+        done = {}
+        if not side.decoder.submit(rid, prompt, count,
+                                   lambda rid, t: done.update({rid: t})):
+            raise RuntimeError(f"migrate soak: {rid} refused")
+        if not engine.run_until(lambda: rid in done, timeout=timeout):
+            raise RuntimeError(f"migrate soak: {rid} timed out")
+        return done[rid]
+
+    def prompt_tokens(count):
+        return [rng.randrange(1, 50) for _ in range(count)]
+
+    # phase 1: conversations land on A; each finished turn pins its
+    # chain under a session handle (41 prompt + 8 generated = 49
+    # tokens -> exactly six full blocks of session KV)
+    histories = {}
+    for index in range(max(1, int(sessions))):
+        sid = f"conv{index}"
+        prompt = prompt_tokens(5 * block + 1)
+        history = prompt + turn(a, f"{sid}.t1", prompt, block)
+        leaf, kv_tokens = a.cache.session_store("default", sid, history)
+        if not a.table.create("default", sid,
+                              {"history": history, "kv": leaf or "",
+                               "kv_tokens": kv_tokens}):
+            raise RuntimeError(f"migrate soak: create {sid} shed")
+        histories[sid] = (history, kv_tokens)
+    blocks_pinned = sum(kv // block for _, kv in histories.values())
+
+    # phase 2: the seeded kill — preemption fires a few rounds into
+    # the victim's generation, the chaos seam escalates (alert +
+    # drain), and the checkpointed victim evacuates as a descriptor
+    victim_prompt = prompt_tokens(40)
+    victim_done: dict = {}
+    chaos.arm_preemption(at_round=chaos.round + 4)
+    if not a.decoder.submit(
+            "victim", victim_prompt, victim_new,
+            lambda rid, t: victim_done.update({rid: t})):
+        raise RuntimeError("migrate soak: victim refused")
+    if not engine.run_until(lambda: a.decoder.drained, timeout=120.0):
+        raise RuntimeError("migrate soak: drain never completed")
+    chaos.disarm()
+    evacuated = list(chaos.evacuated)
+    # zero-loss ledger: the victim must come back exactly once, as an
+    # evacuated descriptor whose degraded delivery also ran
+    lost = 0 if (len(evacuated) == 1 and "victim" in victim_done) else 1
+    partial = list(victim_done.get("victim", ()))
+
+    # phase 3: resume on the standby — prompt + partial re-prefills on
+    # B (prefix miss is fine; the KV migrates next) and the stitched
+    # stream must equal the never-preempted oracle
+    resume_parity = False
+    if evacuated and len(partial) < victim_new:
+        context = victim_prompt + partial
+        out2 = turn(b, "victim.resume", context,
+                    victim_new - len(partial))
+        resume_parity = \
+            partial + out2 == oracle(victim_prompt, victim_new)
+
+    # phase 4: drain done, now evacuate the STATE — every pinned
+    # session ships to B over the kv_migrate wire
+    migrate_done: list = []
+    offered = a.mig.migrate(b.mig.topic,
+                            on_done=lambda m: migrate_done.append(m))
+    if not engine.run_until(lambda: bool(migrate_done), timeout=60.0):
+        raise RuntimeError("migrate soak: migration timed out")
+
+    # phase 5: destination proof — the migrated chain is a pure
+    # prefix hit, and a turn 2 on B continues bit-identically
+    prefix_hits = []
+    for sid, (history, kv_tokens) in histories.items():
+        _, hit = b.cache.match("default", history[:kv_tokens])
+        prefix_hits.append(hit)
+    sid0, (history0, _) = next(iter(histories.items()))
+    prompt2 = history0 + prompt_tokens(3)
+    turn2_parity = turn(b, f"{sid0}.t2", prompt2, block) == \
+        oracle(prompt2, block)
+
+    # phase 6: the CONTROL-plane trigger — an autoscaler shrink
+    # verdict must route through drain, never kill.  While the victim
+    # fleet reports live slots and no drain budget is armed, the
+    # shrink is REFUSED; arming drain_s lets the same verdict through,
+    # and the manager drains B gracefully — the straggling in-flight
+    # request checkpoints and degraded-delivers (zero loss), exactly
+    # the pre-ISSUE-19 silent-drop this path exists to prevent
+    from aiko_services_tpu.autoscaler import Autoscaler, ScalePolicy
+
+    class _Fleet:
+        def __init__(self):
+            self.clients = {"a": object(), "b": object()}
+            self.drains = 0
+
+        def scale_to(self, count, drain_s=None):
+            delta = count - len(self.clients)
+            if delta < 0:
+                if drain_s is not None:
+                    self.drains += 1
+                    b.decoder.drain(deadline=0.0)
+                self.clients.popitem()
+            return delta
+
+        def ready_count(self):
+            return len(self.clients)
+
+    fleet = _Fleet()
+    scaler = Autoscaler(a.rt, name=f"migsoak{seed}_as", manager=fleet,
+                        policy=ScalePolicy(min_clients=1,
+                                           max_clients=4),
+                        interval=1000.0)        # timer parked
+    straggler_done: dict = {}
+    if not b.decoder.submit(
+            "straggler", prompt_tokens(24), 64,
+            lambda rid, t: straggler_done.update({rid: t})):
+        raise RuntimeError("migrate soak: straggler refused")
+    gauge_topic = f"{a.rt.namespace}/host/migsoak/0/metrics"
+    a.rt.publish(gauge_topic, json.dumps({
+        "topic_path": f"{a.rt.namespace}/host/migsoak",
+        "snapshot": {"serving_active_slots": {
+            "type": "gauge",
+            "series": [{"labels": {}, "value": 1.0}]}}}))
+    if not engine.run_until(lambda: scaler.live_slots() >= 1.0,
+                            timeout=30.0):
+        raise RuntimeError("migrate soak: slot gauge never landed")
+    scaler._act(-1, "soak-shrink", engine.clock.now(), {})
+    shrink_refused = len(fleet.clients) == 2 and fleet.drains == 0
+    scaler.drain_s = 1.0
+    scaler._act(-1, "soak-shrink", engine.clock.now(), {})
+    if not engine.run_until(lambda: b.decoder.drained, timeout=60.0):
+        raise RuntimeError("migrate soak: autoscaler drain hung")
+    scaler.stop()
+    autoscaler_block = {
+        "shrink_refused_without_drain": shrink_refused,
+        "drains": fleet.drains,
+        "clients": len(fleet.clients),
+        "straggler_delivered": "straggler" in straggler_done,
+        "straggler_partial_tokens":
+            len(straggler_done.get("straggler", ())),
+    }
+
+    # phase 7: leak audit — the source walks to ZERO
+    a.cache.purge(demote=False)
+    leaks = {
+        "source_sessions": len(a.table),
+        "source_cache_nodes": len(a.cache),
+        "source_pool_blocks": a.decoder.pool.used_blocks(),
+        "pending_source": a.mig.pending_count(),
+        "pending_dest": b.mig.pending_count(),
+    }
+
+    report = {
+        "seed": seed,
+        "sessions": len(histories),
+        "alerts": alerts,
+        "chaos": {key: chaos.stats[key]
+                  for key in ("rounds", "preemptions", "alerts",
+                              "drains")},
+        "victim": {
+            "evacuated": len(evacuated),
+            "partial_tokens": len(partial),
+            "resume_parity": resume_parity,
+            "lost_requests": lost,
+        },
+        "migration": {
+            "offered": offered,
+            "migrated": a.mig.stats["migrated"],
+            "shipped_blocks": a.mig.stats["shipped_blocks"],
+            "handle_blocks": a.mig.stats["handle_blocks"],
+            "chunks": a.mig.stats["chunks"],
+            "installed_blocks": b.mig.stats["installed_blocks"],
+            "dropped_chunks": b.mig.stats["dropped_chunks"],
+            "refused": b.mig.stats["refused"],
+            "blocks_pinned": blocks_pinned,
+        },
+        "dest": {
+            "prefix_hit_tokens": min(prefix_hits) if prefix_hits else 0,
+            "turn2_parity": turn2_parity,
+        },
+        "autoscaler": autoscaler_block,
+        "leaks": leaks,
+        "wall_seconds": round(time.monotonic() - wall_start, 2),
+    }
+    report["ok"] = (
+        lost == 0 and resume_parity and turn2_parity
+        and alerts == ["preemption"]
+        and report["migration"]["migrated"] == len(histories)
+        and report["migration"]["shipped_blocks"] == blocks_pinned
+        and min(prefix_hits or [0]) == 5 * block + block
+        and shrink_refused and fleet.drains == 1
+        and autoscaler_block["straggler_delivered"]
+        and all(value == 0 for value in leaks.values()))
+
+    for side in (a, b):
+        side.mig.stop()
+        side.table.stop()
+        side.rt.terminate()
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="chaos soak: speech pipeline across two runtimes "
@@ -841,6 +1127,13 @@ def main(argv=None) -> int:
     parser.add_argument("--tenants", action="store_true",
                         help="run the flooding-tenant admission "
                              "scenario instead of the chaos soak")
+    parser.add_argument("--migrate", action="store_true",
+                        help="run the serving fault-tolerance "
+                             "scenario (ISSUE 19): seeded preemption "
+                             "mid-conversation, checkpoint-evacuate-"
+                             "resume on the standby, then session KV "
+                             "migration over the kv_transfer wire "
+                             "with a zero-leak source audit")
     parser.add_argument("--health-dump-dir", default=None,
                         metavar="DIR",
                         help="arm the fleet health plane: SLO "
@@ -848,6 +1141,10 @@ def main(argv=None) -> int:
                              "+ a flight-recorder dump into DIR on "
                              "breach (ISSUE 11)")
     args = parser.parse_args(argv)
+    if args.migrate:
+        report = run_migrate_soak(seed=args.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
     if args.tenants:
         report = run_tenant_soak(seed=args.seed)
         print(json.dumps(report, indent=2))

@@ -202,3 +202,46 @@ def test_tenant_baseline_zero_alerts():
     assert health["alerts_fired"] == 0
     assert health["alerts"] == {}
     assert health["autoscaler"]["scale_ups"] == 0
+
+
+def test_migrate_soak_zero_loss_and_clean_source():
+    # ISSUE 19 capstone: seeded preemption mid-conversation on the
+    # serving plane — the chaos seam alerts + drains, the checkpointed
+    # victim evacuates and resumes on the standby BIT-IDENTICALLY
+    # (zero lost requests), every pinned session migrates over the
+    # kv_transfer wire (turn 2 on the standby is a pure prefix hit),
+    # and the source audits to zero: no sessions, no cache nodes, no
+    # live pool blocks, no pending transfers
+    from chaos_soak import run_migrate_soak
+
+    report = run_migrate_soak(seed=11, sessions=2)
+    assert report["ok"], report
+    assert report["alerts"] == ["preemption"]
+    assert report["chaos"]["drains"] == 1
+    victim = report["victim"]
+    assert victim["lost_requests"] == 0
+    assert victim["evacuated"] == 1
+    assert 0 < victim["partial_tokens"] < 32
+    assert victim["resume_parity"]
+    migration = report["migration"]
+    assert migration["offered"] == 2
+    assert migration["migrated"] == 2
+    # cold standby: every pinned block ships (none as handles), and
+    # all of them install
+    assert migration["shipped_blocks"] == migration["blocks_pinned"] \
+        == 12
+    assert migration["handle_blocks"] == 0
+    assert migration["installed_blocks"] == 12
+    assert migration["dropped_chunks"] == 0
+    assert migration["refused"] == 0
+    assert report["dest"]["prefix_hit_tokens"] == 48
+    assert report["dest"]["turn2_parity"]
+    # the control-plane trigger: shrink refused while slots are live
+    # and no drain budget armed; with drain_s the SAME verdict drains
+    # gracefully and the straggler degraded-delivers (zero loss)
+    scaler = report["autoscaler"]
+    assert scaler["shrink_refused_without_drain"]
+    assert scaler["drains"] == 1
+    assert scaler["straggler_delivered"]
+    assert all(value == 0 for value in report["leaks"].values()), \
+        report["leaks"]

@@ -350,3 +350,48 @@ class TestTieredCapacity:
         # its host-tier history must replay bit-identically
         out2 = run(decoder, {"s0r": (prompts["s0"], 4)})
         assert out2["s0r"] == outs["s0"]
+
+
+# -- promoter staging bounds (ISSUE 19 satellite) ---------------------------
+
+class TestPromoterStagingBounds:
+    def test_batch_cap_defers_remainder_and_counts(self, params):
+        """One prefetch stages at most max_batch_blocks; the deferred
+        tail counts kv_promote_deferred_total, and the sync fallback
+        still revives the WHOLE chain for the admit that needs it."""
+        decoder, cache, store = tiered(params)
+        out = run(decoder, REQUESTS)
+        demote_all(cache, out)
+        promoter = cache.promoter
+        promoter.max_batch_blocks = 2
+        history = PROMPT + out["a"]         # 50 tokens: six host blocks
+        before = promoter._deferred.value
+        staged = cache.prefetch("default", history)
+        assert staged == 2 * cache.block_tokens
+        assert promoter._deferred.value - before == 4
+        # a re-kick while the first batch stages is dedup'd, not
+        # double-counted
+        assert cache.prefetch("default", history) == 0
+        hit = 0
+        for _ in range(5):                  # each pass stages a batch
+            cache.promote_for("default", history)
+            _, hit = cache.match("default", history)
+            if hit == 48:
+                break
+        assert hit == 48
+
+    def test_inflight_cap_defers_whole_kick(self, params):
+        decoder, cache, store = tiered(params)
+        out = run(decoder, REQUESTS)
+        demote_all(cache, out)
+        promoter = cache.promoter
+        promoter.max_inflight = 0           # every staging slot busy
+        history = PROMPT + out["a"]
+        before = promoter._deferred.value
+        assert cache.prefetch("default", history) == 0
+        assert promoter._deferred.value - before == 6
+        # nothing staged: the chain is still fully host-resident, and
+        # the revived run must replay bit-identically regardless
+        promoter.max_inflight = 4
+        out2 = run(decoder, {"a_rev": (PROMPT, 10)})
+        assert out2["a_rev"] == out["a"]
