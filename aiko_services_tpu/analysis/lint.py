@@ -815,6 +815,43 @@ class HostTransferRule(LintRule):
                     f"site with `graft: disable=lint-host-transfer`")
 
 
+# modules whose pool/host seam calls report into the KV memory ledger
+# (ISSUE 20) — a direct seam call anywhere else bypasses attribution
+_LEDGER_SEAM_TAILS = ("alloc_blocks", "release_blocks",
+                      "put_from_device", "pop_promoted")
+_LEDGER_SEAM_MODULES = ("serving.py", "serving_paged.py",
+                        "serving_tiered.py", "serving_disagg.py",
+                        "serving_chaos.py", "ledger.py")
+
+
+@rule
+class LedgerSeamRule(LintRule):
+    id = "lint-ledger-seam"
+    doc = ("direct BlockPool alloc_blocks/release_blocks or host-store "
+           "put_from_device/pop_promoted call outside the "
+           "ledger-instrumented serving modules: bytes moved there "
+           "never reach the KV memory ledger, so per-tenant "
+           "attribution silently under-counts")
+    example = "pool.alloc_blocks(n)  # graft: disable=lint-ledger-seam"
+
+    def module_call(self, ctx, node):
+        tail = _func_tail(node.func)
+        if tail not in _LEDGER_SEAM_TAILS or \
+                not isinstance(node.func, ast.Attribute):
+            return
+        if ctx.is_test or Path(ctx.path).name in _LEDGER_SEAM_MODULES:
+            return
+        receiver = ast.unparse(node.func.value)
+        ctx.report(
+            self.id, node,
+            f"{receiver}.{tail}() outside the ledger-instrumented "
+            f"serving modules: this block/byte movement bypasses the "
+            f"KV memory ledger — route it through the instrumented "
+            f"seams (serving/serving_paged/serving_tiered/"
+            f"serving_disagg) or waive an audited site with "
+            f"`graft: disable=lint-ledger-seam`")
+
+
 # stable public rule-id table, in registration (catalog) order —
 # lint-parse (the syntax-failure pseudo-rule) and lint-stale-waiver
 # (the self-check audit) are emitted outside the registry

@@ -108,6 +108,17 @@ class ScalePolicy:
     prefill_queue_down: float = 1.0
     itl_p95_up: float | None = None
     itl_p95_down: float = 0.005
+    # capacity-pressure signals (ISSUE 20, KV memory ledger): worst
+    # KV block-pool occupancy fraction (kv_pool_occupancy gauge) and
+    # worst host-tier pressure (kv_ledger_host_pressure — host store
+    # bytes_used/max_bytes).  A fleet near pool exhaustion preempts
+    # and sheds long before latency signals notice; host pressure
+    # rising means demoted prefixes are about to start falling off the
+    # bottom tier.  Both default OFF.
+    pool_occupancy_up: float | None = None
+    pool_occupancy_down: float = 0.25
+    host_pressure_up: float | None = None
+    host_pressure_down: float = 0.25
     # staleness/evidence window: a process silent longer than this
     # stops voting (replaces the old _SNAPSHOT_HORIZON), and the
     # underload veto considers the window's worst value
@@ -192,12 +203,23 @@ class Autoscaler(Actor):
                 "autoscaler_signal_itl_p95_s",
                 "fleet-merged serving ITL p95 seconds (sketch)",
                 labels),
+            "pool_occupancy": registry.gauge(
+                "autoscaler_signal_pool_occupancy",
+                "worst KV block-pool occupancy fraction", labels),
+            "host_pressure": registry.gauge(
+                "autoscaler_signal_host_pressure",
+                "worst host KV tier pressure (bytes_used/max_bytes)",
+                labels),
         }
         self._families = set(_SIGNAL_FAMILIES)
         if self.policy.ttft_p95_up is not None:
             self._families.add("serving_ttft_seconds")
         if self.policy.itl_p95_up is not None:
             self._families.add("serving_itl_seconds")
+        if self.policy.pool_occupancy_up is not None:
+            self._families.add("kv_pool_occupancy")
+        if self.policy.host_pressure_up is not None:
+            self._families.add("kv_ledger_host_pressure")
         runtime.add_message_handler(self._metrics_handler, self._filter)
         self._timer = runtime.event.add_timer_handler(self.evaluate,
                                                       self.interval)
@@ -272,6 +294,12 @@ class Autoscaler(Actor):
             "itl_p95": self._merged_p95(
                 "serving_itl_seconds", self.policy.itl_p95_up,
                 now, window),
+            "pool_occupancy": self._worst(
+                "kv_pool_occupancy",
+                lambda r: r.latest(now, window)),
+            "host_pressure": self._worst(
+                "kv_ledger_host_pressure",
+                lambda r: r.latest(now, window)),
         }
 
     def _merged_p95(self, family: str, armed: float | None,
@@ -306,6 +334,10 @@ class Autoscaler(Actor):
                                   lambda r: r.maximum(now, window))
         worst_prefill = self._worst("prefill_queue_depth",
                                     lambda r: r.maximum(now, window))
+        worst_occupancy = self._worst("kv_pool_occupancy",
+                                      lambda r: r.maximum(now, window))
+        worst_host = self._worst("kv_ledger_host_pressure",
+                                 lambda r: r.maximum(now, window))
         return (worst_mailbox <= policy.mailbox_depth_down
                 and signals["hop_p95"] <= policy.hop_p95_down
                 and worst_batch <= policy.batch_wait_down
@@ -315,7 +347,11 @@ class Autoscaler(Actor):
                 and (policy.prefill_queue_up is None
                      or worst_prefill <= policy.prefill_queue_down)
                 and (policy.itl_p95_up is None
-                     or signals["itl_p95"] <= policy.itl_p95_down))
+                     or signals["itl_p95"] <= policy.itl_p95_down)
+                and (policy.pool_occupancy_up is None
+                     or worst_occupancy <= policy.pool_occupancy_down)
+                and (policy.host_pressure_up is None
+                     or worst_host <= policy.host_pressure_down))
 
     # -- the scale loop -----------------------------------------------------
     def _count_decision(self, action: str, reason: str) -> None:
@@ -413,6 +449,10 @@ class Autoscaler(Actor):
         self._signal_gauges["prefill_queue"].set(
             signals["prefill_queue"])
         self._signal_gauges["itl_p95"].set(signals["itl_p95"])
+        self._signal_gauges["pool_occupancy"].set(
+            signals["pool_occupancy"])
+        self._signal_gauges["host_pressure"].set(
+            signals["host_pressure"])
         total = len(self.manager.clients)
         self._clients_gauge.set(total)
 
@@ -438,7 +478,12 @@ class Autoscaler(Actor):
             or (policy.prefill_queue_up is not None
                 and signals["prefill_queue"] >= policy.prefill_queue_up)
             or (policy.itl_p95_up is not None
-                and signals["itl_p95"] >= policy.itl_p95_up))
+                and signals["itl_p95"] >= policy.itl_p95_up)
+            or (policy.pool_occupancy_up is not None
+                and signals["pool_occupancy"] >=
+                policy.pool_occupancy_up)
+            or (policy.host_pressure_up is not None
+                and signals["host_pressure"] >= policy.host_pressure_up))
         underload = not overload and self._windowed_quiet(signals, now)
         if overload:
             self._up_streak += 1

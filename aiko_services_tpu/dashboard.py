@@ -217,6 +217,7 @@ class DashboardState:
                     f"    {row['tenant']:16.16s} met={attainment} "
                     f"ttft_p95={ttft} itl_p95={itl} "
                     f"shed={row['shed']} rejected={row['rejected']}")
+        lines.extend(self._memory_lines(snapshot, rows))
         for name in sorted(snapshot):
             entry = snapshot[name]
             for series in entry.get("series", []):
@@ -234,6 +235,54 @@ class DashboardState:
                 else:
                     lines.append(f"  {shown:46.46s} "
                                  f"{series.get('value', 0)}")
+        return lines
+
+    def _memory_lines(self, snapshot: dict, rows: list) -> list:
+        """KV memory section (ISSUE 20): per-tier occupancy, top
+        tenants by attributed bytes, and firing ledger-violation
+        alerts — empty when the snapshot carries no ledger families."""
+        lines = []
+        occupancy = []
+        for series in snapshot.get("kv_pool_occupancy",
+                                   {}).get("series", []):
+            labels = series.get("labels", {}) or {}
+            occupancy.append(
+                f"pool {labels.get('pool', '?')} "
+                f"{float(series.get('value', 0)):.0%}")
+        for series in snapshot.get("kv_ledger_host_pressure",
+                                   {}).get("series", []):
+            occupancy.append(
+                f"host {float(series.get('value', 0)):.0%}")
+        by_bytes = sorted(
+            (row for row in rows
+             if row.get("device_bytes") or row.get("host_bytes")),
+            key=lambda r: -(r["device_bytes"] + r["host_bytes"]))
+        violations = sum(
+            float(series.get("value", 0))
+            for series in snapshot.get("kv_ledger_violations",
+                                       {}).get("series", []))
+        if not (occupancy or by_bytes or violations):
+            return lines
+        lines.append("  KV memory (ledger):")
+        if occupancy:
+            lines.append("    occupancy: " + "  ".join(occupancy))
+        for row in by_bytes[:4]:
+            lines.append(
+                f"    {row['tenant']:16.16s} "
+                f"device={row['device_bytes']:,d}B "
+                f"host={row['host_bytes']:,d}B "
+                f"byte_s={row['byte_seconds']:,.0f} "
+                f"demote/promote={row['demotions']}/"
+                f"{row['promotions']}")
+        if violations:
+            lines.append(f"    VIOLATIONS: {int(violations)} "
+                         f"(kv_ledger_violations latched)")
+        for rule in sorted(self.alerts):
+            record = self.alerts[rule]
+            if record.get("state") == "firing" and \
+                    "ledger" in rule.lower():
+                lines.append(f"    ALERT {rule} firing — "
+                             f"{record.get('description', '')}")
         return lines
 
     # -- registrar history (reference: dashboard.py:279-509 history table) --

@@ -27,6 +27,9 @@ from .journey import (                                      # noqa: F401
     JourneyLog, RequestJourney, note_admission, take_admission_note,
     tenant_slo_rows,
 )
+from .ledger import (                                       # noqa: F401
+    KVMemoryLedger, assert_ledger_clean, seed_ledger_leak,
+)
 from .profiler import PhaseProfiler, arm_trace              # noqa: F401
 from .flight import (                                       # noqa: F401
     DumpOnAlert, FLIGHT_TOPIC_SUFFIX, FlightLogHandler, FlightRecorder,

@@ -325,7 +325,13 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
 
       [{"tenant", "completed", "deadline_met", "deadline_missed",
         "attainment" (None without deadlines), "ttft_p50_ms"...,
-        "itl_p95_ms"..., "shed", "rejected", "exemplars", "met"}, ...]
+        "itl_p95_ms"..., "shed", "rejected", "device_bytes",
+        "host_bytes", "byte_seconds", "demotions", "promotions",
+        "exemplars", "met"}, ...]
+
+    The memory columns read the KV ledger families (kv_ledger_bytes
+    by tier, kv_ledger_byte_seconds, kv_ledger_moves_total by dir) —
+    zero when no ledger is attached (ISSUE 20).
 
     TTFT sketches carrying the serving prefill label (ISSUE 13) are
     ADDITIONALLY merged per population into ttft_{cached,cold}_p50_ms /
@@ -342,6 +348,9 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
     split_ttft: dict[tuple, list] = {}    # (tenant, prefill) -> [Sketch]
     shed: dict[str, float] = {}
     rejected: dict[str, float] = {}
+    mem_bytes: dict[tuple, float] = {}    # (tenant, tier) -> bytes
+    byte_seconds: dict[str, float] = {}
+    moves: dict[tuple, float] = {}        # (tenant, dir) -> count
 
     def tenant_of(labels: dict) -> str:
         return str(labels.get("tenant") or "default")
@@ -376,9 +385,25 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
                     tenant = tenant_of(labels)
                     rejected[tenant] = rejected.get(tenant, 0) + \
                         float(series.get("value", 0))
+                elif family == "kv_ledger_bytes":
+                    key = (tenant_of(labels),
+                           str(labels.get("tier") or ""))
+                    mem_bytes[key] = mem_bytes.get(key, 0) + \
+                        float(series.get("value", 0))
+                elif family == "kv_ledger_byte_seconds":
+                    tenant = tenant_of(labels)
+                    byte_seconds[tenant] = \
+                        byte_seconds.get(tenant, 0) + \
+                        float(series.get("value", 0))
+                elif family == "kv_ledger_moves_total":
+                    key = (tenant_of(labels),
+                           str(labels.get("dir") or ""))
+                    moves[key] = moves.get(key, 0) + \
+                        float(series.get("value", 0))
 
     tenants = sorted(set(outcomes) | {t for t, _ in sketches}
-                     | set(shed) | set(rejected))
+                     | set(shed) | set(rejected)
+                     | {t for t, _ in mem_bytes})
     rows = []
     for tenant in tenants:
         counts = outcomes.get(tenant, {})
@@ -393,6 +418,13 @@ def tenant_slo_rows(snapshots, objective: float | None = None) -> list:
             "attainment": attainment,
             "shed": int(shed.get(tenant, 0)),
             "rejected": int(rejected.get(tenant, 0)),
+            # KV memory ledger attribution (ISSUE 20): live bytes per
+            # tier, integrated footprint, and tier-move counts
+            "device_bytes": int(mem_bytes.get((tenant, "device"), 0)),
+            "host_bytes": int(mem_bytes.get((tenant, "host"), 0)),
+            "byte_seconds": float(byte_seconds.get(tenant, 0.0)),
+            "demotions": int(moves.get((tenant, "demote"), 0)),
+            "promotions": int(moves.get((tenant, "promote"), 0)),
             "exemplars": [],
         }
         for family, prefix in (("serving_ttft_seconds", "ttft"),

@@ -433,6 +433,50 @@ class AdmissionGate:
         self.queue._count("rejected", str(tenant or DEFAULT_TENANT),
                           int(tier), reason)
 
+    # -- byte-budget verdict (ISSUE 20) ------------------------------------
+    def set_byte_policy(self, ledger, budget_bytes: int | None = None,
+                        tenant_budgets: dict | None = None,
+                        default_estimate: int = 0) -> None:
+        """Arm capacity-aware shedding against a KV memory ledger.
+
+        `budget_bytes` caps any single tenant's attributed device bytes
+        (tenant_budgets overrides per tenant).  A request whose
+        projected footprint — the tenant's live ledger balance plus the
+        caller's per-request byte estimate — breaches its budget is
+        shed UNLESS the pool-wide occupancy trend is already relieving
+        fast enough to clear the overage inside the request's remaining
+        deadline budget.  Disarmed (ledger or every budget None) the
+        verdict never sheds."""
+        self._byte_ledger = ledger
+        self._byte_budget = None if budget_bytes is None else int(budget_bytes)
+        self._tenant_budgets = dict(tenant_budgets or {})
+        self._byte_estimate = max(0, int(default_estimate))
+
+    def shed_on_bytes(self, tenant: str, estimate_bytes: int | None = None,
+                      remaining: float | None = None):
+        """(shed?, projected_bytes): True when admitting `tenant`'s next
+        request would breach its byte budget with no relief in sight.
+        Pairs with shed_early — callers reject with reason
+        "byte-budget" via count_rejected when this verdict fires."""
+        ledger = getattr(self, "_byte_ledger", None)
+        if ledger is None:
+            return False, None
+        key = str(tenant or DEFAULT_TENANT)
+        budget = self._tenant_budgets.get(key, self._byte_budget)
+        if budget is None:
+            return False, None
+        estimate = self._byte_estimate if estimate_bytes is None \
+            else max(0, int(estimate_bytes))
+        projected = int(ledger.device_bytes(key)) + estimate
+        if projected <= budget:
+            return False, projected
+        trend = ledger.device_trend()
+        if trend is not None and trend < 0 and remaining is not None:
+            relief = (projected - budget) / -trend
+            if relief < remaining:
+                return False, projected
+        return True, projected
+
     # -- fair-queue passage ------------------------------------------------
     def offer(self, tenant: str, item, shed: Callable | None = None,
               tier: int | None = None,

@@ -454,6 +454,12 @@ class PrefixKVCache:
         # prefetch/promote seam online — see attach_host_store
         self._host = None
         self._promoter = None
+        # KV memory ledger (ISSUE 20): when attached, dense inserts/
+        # evictions charge the device tier directly (paged bytes are
+        # the pool's to report), double-releases become recorded
+        # violations, and the auditor reads this cache for the
+        # pinned-vs-evictable split
+        self._ledger = None
         from .observe.metrics import MirroredStats, default_registry
         self._registry = registry or default_registry()
         self.stats = MirroredStats(
@@ -535,6 +541,23 @@ class PrefixKVCache:
                 f"prefix cache {self.name!r} holds dense blocks; "
                 f"cannot switch to paged storage mid-flight")
         self._pool = pool
+        if self._ledger is not None:
+            pool.attach_ledger(self._ledger)
+
+    def attach_ledger(self, ledger) -> None:
+        """Wire the KV memory ledger through every tier this cache
+        fronts: the pool reports device transitions, the host store
+        reports demote/evict/promote, and the cache itself reports
+        dense bytes + double-release violations.  One call covers the
+        whole stack whichever attach order the caller used."""
+        self._ledger = ledger
+        if ledger is None:
+            return
+        ledger.attach_cache(self)
+        if self._pool is not None:
+            self._pool.attach_ledger(ledger)
+        if self._host is not None:
+            self._host.attach_ledger(ledger)
 
     def attach_host_store(self, store, promoter=None) -> None:
         """Bring the host KV tier online (ISSUE 17): pool-resident
@@ -553,6 +576,8 @@ class PrefixKVCache:
                 f"prefix cache {self.name!r} already has host store "
                 f"{self._host.name!r}")
         self._host = store
+        if self._ledger is not None:
+            store.attach_ledger(self._ledger)
         if self._promoter is None:
             if promoter is None:
                 from .serving_tiered import AsyncPromoter
@@ -687,7 +712,8 @@ class PrefixKVCache:
             # the end leaves cache-held blocks at refs 1 and refused
             # ones free.
             entries = blocks[:count - start_block]
-            ids = self._pool.alloc_blocks(len(entries))
+            ids = self._pool.alloc_blocks(len(entries),
+                                          tenant=tenant)
             layers = int(self._layout[0])
             k_layers = [_stack_block_leaves(
                 [entry["k"][i] for entry in entries])
@@ -702,7 +728,9 @@ class PrefixKVCache:
                     break
                 installed += 1
                 parent = keys[j]
-            self._pool.release_blocks(ids)
+            self._pool.release_blocks(ids, tenant=tenant)
+            if installed and self._ledger is not None:
+                self._ledger.event("install", installed)
             return installed
         for j in range(start_block, count):
             entry = blocks[j - start_block]
@@ -765,8 +793,28 @@ class PrefixKVCache:
     def release(self, keys) -> None:
         for key in keys:
             node = self._nodes.get(key)
-            if node is not None and node.refs > 0:
+            if node is None:
+                continue        # evicted/purged since pin: legitimate
+            if node.refs > 0:
                 node.refs -= 1
+            elif self._ledger is not None:
+                # an unpin of an unpinned resident block is a paired-
+                # release bug somewhere upstream — record it with the
+                # chain key so the postmortem names the chain
+                self._ledger.violation(
+                    "double-release", tenant=node.tenant,
+                    chain_key=key,
+                    detail=f"cache {self.name}: refs already 0")
+
+    def evictable_bytes(self, tenant=None) -> int:
+        """Bytes held by unpinned (refs == 0) cached blocks — the
+        ledger's pinned-vs-evictable split reads this lazily (interior
+        blocks count too: they become evictable leaves as their
+        subtrees drain)."""
+        tenant = None if tenant is None else str(tenant or "default")
+        return sum(node.nbytes for node in self._nodes.values()
+                   if node.refs == 0 and
+                   (tenant is None or node.tenant == tenant))
 
     def hit_rate(self) -> float:
         total = self.stats["hit_tokens"] + self.stats["miss_tokens"]
@@ -809,6 +857,10 @@ class PrefixKVCache:
         self._tenant_bytes[tenant] = \
             self._tenant_bytes.get(tenant, 0) + nbytes
         self.stats["inserts"] += 1
+        if self._ledger is not None:
+            # dense mode: the cache IS the device-tier truth source
+            # (paged bytes are charged by the pool at alloc)
+            self._ledger.device_delta(tenant, nbytes, "cache_insert")
         self._evict_to_budget(tenant)
         if key not in self._nodes:      # budget evicted the newcomer
             self.stats["insert_refused"] += 1
@@ -888,6 +940,8 @@ class PrefixKVCache:
                 continue
             self.release(keys)
             self.stats["session_released"] += 1
+            if self._ledger is not None:
+                self._ledger.event("session_demote")
             if self._host is None:
                 continue
             for key in reversed(keys):
@@ -916,7 +970,11 @@ class PrefixKVCache:
                     self.stats["demoted"] += 1
             # paged: the cache's ref goes; the pool block frees when
             # no slot table still aliases it
-            self._pool.release_blocks([node.pool_id])
+            self._pool.release_blocks([node.pool_id],
+                                      tenant=node.tenant)
+        elif self._ledger is not None:
+            self._ledger.device_delta(node.tenant, -node.nbytes,
+                                      "cache_evict")
         parent = self._nodes.get(node.parent)
         if parent is not None:
             parent.children.discard(node.key)
@@ -952,6 +1010,8 @@ class PrefixKVCache:
             self._nodes.move_to_end(key)
         self._sessions[(str(tenant or "default"), str(sid))] = keys
         self.stats["session_handles"] += 1
+        if self._ledger is not None:
+            self._ledger.event("session_pin")
         return keys[-1], hit
 
     def session_release(self, tenant: str, sid: str) -> bool:
@@ -1824,6 +1884,7 @@ class ContinuousDecoder:
         # when several decoders share one cache.  Harvest at retire,
         # longest-match at admit, copy-in via _prefix_copy_fn_for.
         self.prefix_cache = prefix_cache
+        self._ledger = None             # KV memory ledger (ISSUE 20)
         item = jnp.dtype(config.dtype).itemsize
         # the layout tuple is the geometry handshake for binding AND
         # for the disaggregated wire — a cacheless paged decoder still
@@ -2274,20 +2335,37 @@ class ContinuousDecoder:
                     "exceeds the admit cap %d (%d-token cover); "
                     "cold prefill", request_id, limit, covered)
                 self.stats["install_misaligned"] += 1
-                self.pool.release_blocks(ids)
+                self.pool.release_blocks(ids, tenant=journey.tenant)
             else:
                 block = self.kv_block
                 usable = min(covered, len(ids) * block,
                              ((len(prompt) - 1) // block) * block)
                 keep = max(0, usable // block)
                 if len(ids) > keep:
-                    self.pool.release_blocks(ids[keep:])
+                    self.pool.release_blocks(ids[keep:],
+                                             tenant=journey.tenant)
                 request.kv_block_ids = ids[:keep]
                 request.prefix_hit = keep * block
                 request.prefix_probed = True
         self._pending.append(request)
         self._note_active()
         return True
+
+    def attach_ledger(self, ledger) -> None:
+        """Wire the KV memory ledger (ISSUE 20) through this
+        decoder's storage stack: the prefix cache fans it out to its
+        pool and host tiers; a cacheless paged decoder attaches the
+        pool directly.  Dense slot caches are preallocated arrays —
+        nothing per-tenant to account without a prefix cache."""
+        self._ledger = ledger
+        if self.prefix_cache is not None:
+            self.prefix_cache.attach_ledger(ledger)
+        elif self.paged and ledger is not None:
+            self.pool.attach_ledger(ledger)
+
+    @property
+    def ledger(self):
+        return self._ledger
 
     def attach(self, engine, period: float = 0.002) -> int:
         # idempotent: re-attaching while already pumping (e.g. a stream
@@ -2690,17 +2768,25 @@ class ContinuousDecoder:
         return 1 << max(0, (n - 1).bit_length())
 
     # -- paged block tables (ISSUE 15) -------------------------------------
-    def _ensure_coverage(self, slot: int, upto: int) -> None:
+    def _ensure_coverage(self, slot: int, upto: int,
+                         tenant: str | None = None) -> None:
         """Extend `slot`'s block table to cover positions [0, upto):
         allocate fresh pool blocks for the uncovered tail.  A no-op
         when already covered — the common decode round allocates one
-        block only when the context crosses a block boundary."""
+        block only when the context crosses a block boundary.
+        `tenant` attributes the allocation in the KV ledger; it
+        defaults from the slot's request (admit-group callers pass it
+        explicitly — the slot is not assigned yet there)."""
         block = self.kv_block
         need = min(-(-max(0, upto) // block), self._table_blocks)
         owned = self._slot_blocks[slot]
         if len(owned) >= need:
             return
-        fresh = self.pool.alloc_blocks(need - len(owned))
+        if tenant is None:
+            request = self._slots[slot]
+            tenant = request.tenant if request is not None else ""
+        fresh = self.pool.alloc_blocks(need - len(owned),
+                                       tenant=tenant)
         row = self._tables_np[slot]
         for j, block_id in enumerate(fresh, start=len(owned)):
             row[j] = block_id
@@ -2720,17 +2806,19 @@ class ContinuousDecoder:
         block = self.kv_block
         owned = self._slot_blocks[slot]
         row = self._tables_np[slot]
+        request = self._slots[slot]
+        tenant = request.tenant if request is not None else ""
         pairs = []
         for j in range(start // block,
                        min(-(-stop // block), len(owned))):
             old = owned[j]
             if self.pool.refs(old) <= 1:
                 continue
-            new = self.pool.alloc_blocks(1)[0]
+            new = self.pool.alloc_blocks(1, tenant=tenant)[0]
             pairs.append((old, new))
             owned[j] = new
             row[j] = new
-            self.pool.release_blocks([old])
+            self.pool.release_blocks([old], tenant=tenant)
             self._tables_dirty = True
         return pairs
 
@@ -2762,13 +2850,20 @@ class ContinuousDecoder:
             self._tables_dirty = False
         return self._tables_dev
 
-    def _release_slot_blocks(self, slot: int) -> None:
+    def _release_slot_blocks(self, slot: int,
+                             tenant: str | None = None) -> None:
         """Drop the slot's refs on every table block at retire.
         Blocks the harvest registered stay alive through the cache's
-        own refs; purely-owned blocks return to the free list."""
+        own refs; purely-owned blocks return to the free list.
+        `tenant` attributes the release in the KV ledger; it defaults
+        from the slot's request (the admit-group unwind passes it —
+        the slot was never assigned there)."""
         owned = self._slot_blocks[slot]
         if owned:
-            self.pool.release_blocks(owned)
+            if tenant is None:
+                request = self._slots[slot]
+                tenant = request.tenant if request is not None else ""
+            self.pool.release_blocks(owned, tenant=tenant)
             self._slot_blocks[slot] = []
             self._tables_np[slot, :len(owned)] = 0
             self._tables_dirty = True
@@ -2780,7 +2875,7 @@ class ContinuousDecoder:
         return tuple(str(f) for f in self._kv_layout)
 
     def install_shipped_blocks(self, tokens, start_block: int,
-                               blocks) -> tuple:
+                               blocks, tenant: str = "") -> tuple:
         """Direct slot-table install (ISSUE 15 satellite): write
         shipped chain blocks straight into fresh pool blocks and hand
         the ids to the caller for submit(..) via DecodeRequest
@@ -2807,7 +2902,7 @@ class ContinuousDecoder:
             check_block_geometry(self._kv_layout, block, entry)
         if not entries:
             return 0, []
-        ids = self.pool.alloc_blocks(len(entries))
+        ids = self.pool.alloc_blocks(len(entries), tenant=tenant)
         layers = self.config.num_layers
         self.pool.write_blocks(
             ids,
@@ -3313,7 +3408,8 @@ class ContinuousDecoder:
             tables_rows = self._tables_scratch[:width, :nbb]
             try:
                 for j, slot in enumerate(slots):
-                    self._ensure_coverage(slot, nbb * self.kv_block)
+                    self._ensure_coverage(slot, nbb * self.kv_block,
+                                          tenant=chunk[j].tenant)
                     tables_rows[j] = self._tables_np[slot, :nbb]
             except Exception:
                 # pool growth refused (HBM exhaustion, injected chaos
@@ -3322,8 +3418,9 @@ class ContinuousDecoder:
                 # back at the HEAD of the queue — the escalation path
                 # (alert -> drain) then evacuates these requests as
                 # descriptors instead of silently losing them
-                for slot in slots:
-                    self._release_slot_blocks(slot)
+                for slot, request in zip(slots, chunk):
+                    self._release_slot_blocks(slot,
+                                              tenant=request.tenant)
                 free[:0] = slots
                 self._pending[:0] = chunk
                 raise

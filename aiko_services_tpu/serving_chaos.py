@@ -102,7 +102,7 @@ class ChaosDecoder:
         if self._wrapped_alloc is None:
             self._wrapped_alloc = pool.alloc_blocks
 
-            def refusing_alloc(count):
+            def refusing_alloc(count, tenant=""):
                 count = int(count)
                 if self._refusing() and count > len(pool._free):
                     self.stats["alloc_refusals"] += 1
@@ -110,7 +110,7 @@ class ChaosDecoder:
                         f"chaos {self.name}: pool growth refused "
                         f"({count} blocks wanted, "
                         f"{len(pool._free)} free)")
-                return self._wrapped_alloc(count)
+                return self._wrapped_alloc(count, tenant=tenant)
 
             pool.alloc_blocks = refusing_alloc
 

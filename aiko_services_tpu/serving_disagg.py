@@ -649,7 +649,8 @@ class PrefillClient:
         entry.pop("chunk_base", None)
         ids = entry.pop("direct_ids", None)
         if ids:
-            self.decoder.pool.release_blocks(ids)
+            self.decoder.pool.release_blocks(
+                ids, tenant=str(entry.get("tenant") or ""))
 
     # -- the fallback ladder ----------------------------------------------
     def _transfer_expired(self, transfer_id: str) -> None:
@@ -813,7 +814,8 @@ class PrefillClient:
                 start = out["start_block"]
                 if prior and entry.get("chunk_next") == start:
                     _, ids = self.decoder.install_shipped_blocks(
-                        out["tokens"], start, blocks)
+                        out["tokens"], start, blocks,
+                        tenant=tenant_key)
                     direct_ids = prior + ids
                 elif prior:
                     self.stats["chunk_dropped"] += 1
@@ -826,7 +828,8 @@ class PrefillClient:
                             "prefix")
                     _, direct_ids = \
                         self.decoder.install_shipped_blocks(
-                            out["tokens"], 0, blocks)
+                            out["tokens"], 0, blocks,
+                            tenant=tenant_key)
                 entry.pop("direct_ids", None)
                 installed = len(direct_ids)
                 self.stats["direct_installs"] += 1
@@ -928,7 +931,8 @@ class PrefillClient:
             else:
                 _, ids = self.decoder.install_shipped_blocks(
                     out["tokens"], out["start_block"],
-                    self._landing_blocks(out["blocks"]))
+                    self._landing_blocks(out["blocks"]),
+                    tenant=str(entry["tenant"] or ""))
                 entry.setdefault("direct_ids", []).extend(ids)
                 installed = len(ids)
         except (ValueError, TypeError, IndexError) as exc:
@@ -985,7 +989,8 @@ class PrefillClient:
             if kv_blocks is not None and kv_blocks[1]:
                 # ownership never transferred: the shed request must
                 # not leak its pre-installed pool blocks
-                self.decoder.pool.release_blocks(kv_blocks[1])
+                self.decoder.pool.release_blocks(
+                    kv_blocks[1], tenant=str(entry["tenant"] or ""))
             if entry["on_refused"] is not None:
                 entry["on_refused"](entry["request_id"])
 
@@ -1245,6 +1250,9 @@ class SessionMigrator:
                 entry["cursor"] = out["start_block"] + len(out["blocks"])
                 entry["installed"] += installed
                 self.stats["installed_blocks"] += installed
+                ledger = getattr(cache, "_ledger", None)
+                if ledger is not None and installed:
+                    ledger.event("migrate_in", installed)
             except (ValueError, TypeError, IndexError) as exc:
                 self.stats["dropped_chunks"] += 1
                 self.logger.warning(
@@ -1321,6 +1329,9 @@ class SessionMigrator:
         end = hit // block
         self.stats["handle_blocks"] += start
         self.stats["shipped_blocks"] += end - start
+        ledger = getattr(cache, "_ledger", None)
+        if ledger is not None and end > start:
+            ledger.event("migrate_out", end - start)
         context = tracing.current_trace()
         trace = context.to_fields(self.runtime.event.clock.now()) \
             if context is not None else None

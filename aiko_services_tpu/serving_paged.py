@@ -124,11 +124,22 @@ class BlockPool:
             "used / capacity fraction of the paged KV pool",
             labels={"pool": self.name})
         self._used = 0
+        # KV memory ledger (ISSUE 20): when attached, every PHYSICAL
+        # transition (alloc, refs 1->0 release) reports tenant-
+        # attributed byte deltas — retains are ownership moves and
+        # stay invisible, so ledger totals conserve against
+        # used_blocks() * block_nbytes by construction
+        self._ledger = None
         # shrink floor: construction capacity, raised by reserve() —
         # maybe_shrink never retraces below what a caller declared as
         # steady state, so drain/refill cycles don't thrash shapes
         self._floor_blocks = n
         self._publish_gauges()
+
+    def attach_ledger(self, ledger) -> None:
+        self._ledger = ledger
+        if ledger is not None:
+            ledger.attach_pool(self)
 
     # -- device arrays -----------------------------------------------------
     def _zero_pools(self, n: int) -> list:
@@ -224,9 +235,11 @@ class BlockPool:
         return released
 
     # -- allocator ---------------------------------------------------------
-    def alloc_blocks(self, count: int) -> list:
+    def alloc_blocks(self, count: int, tenant: str = "") -> list:
         """`count` fresh block ids, each with refs=1 owned by the
-        caller.  Grows the device pools when the free list runs dry."""
+        caller.  Grows the device pools when the free list runs dry.
+        `tenant` attributes the bytes in the KV ledger (ISSUE 20) —
+        accounting only, allocation behavior is tenant-blind."""
         count = int(count)
         if count <= 0:
             return []
@@ -237,6 +250,9 @@ class BlockPool:
             self._refs[block_id] = 1
         self._used += count
         self.stats["allocs"] += count
+        if self._ledger is not None:
+            self._ledger.device_delta(
+                tenant, count * self.block_nbytes, "alloc")
         self._publish_gauges()
         return ids
 
@@ -249,9 +265,11 @@ class BlockPool:
                     f"{block_id}")
             self._refs[block_id] += 1
 
-    def release_blocks(self, ids) -> None:
+    def release_blocks(self, ids, tenant: str = "") -> None:
         """Drop one ref per id; refs hitting zero return the id to the
-        free list (contents stay — dead cells until reallocated)."""
+        free list (contents stay — dead cells until reallocated).
+        `tenant` attributes the freed bytes in the KV ledger — only
+        the refs 1->0 transitions are physical."""
         freed = 0
         for block_id in ids:
             if not 0 < block_id < self.num_blocks:
@@ -268,6 +286,9 @@ class BlockPool:
         if freed:
             self._used -= freed
             self.stats["frees"] += freed
+            if self._ledger is not None:
+                self._ledger.device_delta(
+                    tenant, -freed * self.block_nbytes, "release")
             self._publish_gauges()
 
     def refs(self, block_id: int) -> int:

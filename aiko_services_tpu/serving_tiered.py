@@ -124,6 +124,15 @@ class HostBlockStore:
             "kv_host_blocks", "host-tier resident KV blocks",
             labels={"store": self.name})
         self._tenant_gauges: dict = {}
+        # KV memory ledger (ISSUE 20): host-tier byte deltas report
+        # at exactly the points _tenant_bytes moves, so ledger host
+        # totals conserve against bytes_used by construction
+        self._ledger = None
+
+    def attach_ledger(self, ledger) -> None:
+        self._ledger = ledger
+        if ledger is not None:
+            ledger.attach_host(self)
 
     # -- residency ---------------------------------------------------------
     def has(self, key: str) -> bool:
@@ -162,11 +171,15 @@ class HostBlockStore:
             self._tenant_bytes.get(tenant, 0) + node.nbytes
         self.stats["demoted"] += 1
         self.stats["demote_bytes"] += node.nbytes
+        if self._ledger is not None:
+            self._ledger.host_delta(tenant, node.nbytes, "demote")
         self._evict_to_budget(tenant)
         if key not in self._nodes:      # budget evicted the newcomer
             self.stats["refused"] += 1
             self._publish_gauges(tenant)
             return False
+        if self._ledger is not None:
+            self._ledger.move(tenant, "demote")
         self._publish_gauges(tenant)
         return True
 
@@ -200,6 +213,10 @@ class HostBlockStore:
             tenants.add(node.tenant)
             self.stats["promoted"] += 1
             self.stats["promote_bytes"] += node.nbytes
+            if self._ledger is not None:
+                self._ledger.host_delta(node.tenant, -node.nbytes,
+                                        "promote")
+                self._ledger.move(node.tenant, "promote")
         for tenant in tenants:
             self._publish_gauges(tenant)
         return released
@@ -242,6 +259,9 @@ class HostBlockStore:
             del self._nodes[victim.key]
             self._drop_bytes(victim)
             self.stats["evicted"] += 1
+            if self._ledger is not None:
+                self._ledger.host_delta(victim.tenant,
+                                        -victim.nbytes, "host_evict")
             self._publish_gauges(victim.tenant)
 
     def _publish_gauges(self, tenant: str) -> None:
@@ -448,7 +468,7 @@ class AsyncPromoter:
         if skip:
             k_layers = [_slice_stack(s, skip) for s in k_layers]
             v_layers = [_slice_stack(s, skip) for s in v_layers]
-        ids = pool.alloc_blocks(len(keys))
+        ids = pool.alloc_blocks(len(keys), tenant=job.tenant)
         pool.write_blocks(ids, k_layers, v_layers)
         parent = job.keys[skip - 1] if skip else job.parent
         installed = 0
@@ -458,7 +478,7 @@ class AsyncPromoter:
                 break                   # device budget refused: stop
             parent = key
             installed += 1
-        pool.release_blocks(ids)
+        pool.release_blocks(ids, tenant=job.tenant)
         if installed:
             self.store.pop_promoted(keys[:installed])
             cache.stats["promoted"] += installed

@@ -219,7 +219,14 @@ class SessionTable:
             "state_expiry_batch_size", "sessions lapsed per wheel advance",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096,
                      16384, 65536))
+        # KV memory ledger (ISSUE 20): lease pins/demotions count as
+        # lifecycle events (the KV bytes they pin are charged by the
+        # prefix cache's session handles, not here)
+        self._ledger = None
         self._stopped = False
+
+    def attach_ledger(self, ledger) -> None:
+        self._ledger = ledger
 
     # -- introspection -----------------------------------------------------
     def __len__(self) -> int:
@@ -287,6 +294,8 @@ class SessionTable:
         self._wheel.schedule(session.due, (key, session.gen))
         self._publish(session)
         self.stats["created"] += 1
+        if self._ledger is not None:
+            self._ledger.event("lease_pin")
         self._gauge_sessions.inc()
         self._gauge_bytes.inc(nbytes)
         self._enforce_bytes(tenant)
@@ -399,6 +408,8 @@ class SessionTable:
         self._tenant_bytes[session.tenant] -= freed
         self._gauge_bytes.dec(freed)
         self.stats["demoted"] += 1
+        if self._ledger is not None:
+            self._ledger.event("lease_demote")
         self._publish(session)
         return freed
 

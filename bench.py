@@ -1609,6 +1609,11 @@ def bench_llama_conversation(window: float = 10.0):
                                 steps_per_sync=sps, prefill_chunk=64,
                                 prefix_cache=cache, name="bench_conv",
                                 paged_kv=LLAMA_PAGED, kv_block=block)
+    # KV memory ledger (ISSUE 20): per-tenant/per-tier attribution —
+    # the rung reports footprint beside throughput
+    from aiko_services_tpu.observe.ledger import KVMemoryLedger
+    ledger = KVMemoryLedger(name="bench_conv")
+    decoder.attach_ledger(ledger)
     rng = np.random.default_rng(31)
     sessions: dict = {}
     turns_done = [0]
@@ -1737,6 +1742,19 @@ def bench_llama_conversation(window: float = 10.0):
             (pstats["installs_async"] + pstats["installs_wait"]) /
             max(1, pstats["installs"]), 4)
         cache.promoter.stop()
+    # ledger attribution fields (ISSUE 20): live per-tier bytes at rung
+    # end, the pinned (non-evictable) share of the device tier, and the
+    # integrated footprint each session cost (byte-seconds amortised
+    # over every session the rung ran)
+    ledger.audit()
+    mem_device = ledger.device_bytes()
+    fields["lat_llama_conv_mem_device_bytes"] = mem_device
+    fields["lat_llama_conv_mem_host_bytes"] = ledger.host_bytes()
+    pinned = sum(ledger.pinned_bytes(t) for t in ledger.tenants())
+    fields["lat_llama_conv_mem_pinned_ratio"] = \
+        round(pinned / mem_device, 4) if mem_device else 0.0
+    fields["lat_llama_conv_mem_byteseconds_per_session"] = \
+        round(ledger.byte_seconds() / max(1, session_seq[0]), 1)
     return fields
 
 
@@ -1796,9 +1814,24 @@ def bench_llama_disagg(window: float = 8.0):
         disagg.stop()
         return {"lat_llama_disagg_error": "prefill pool never "
                                           "discovered"}
+    # KV memory ledger on the decode side (ISSUE 20): attribution of
+    # the landed transfers' device bytes
+    from aiko_services_tpu.observe.ledger import KVMemoryLedger
+    ledger = KVMemoryLedger(name="bench_disagg")
+    disagg.decoder.attach_ledger(ledger)
     disagg_probe = probe_tokens(disagg)
     disagg_out = disagg.measure(window=window, burst_every=0.4)
     transfers = dict(disagg.prefill.stats)
+    ledger.audit()
+    mem_fields = {
+        "lat_llama_disagg_mem_device_bytes": ledger.device_bytes(),
+        "lat_llama_disagg_mem_byte_seconds":
+            round(ledger.byte_seconds(), 1),
+        # every shipped chain lands through an instrumented alloc —
+        # the event count is the decode side's install traffic
+        "lat_llama_disagg_mem_alloc_events":
+            int(ledger.stats["alloc"]),
+    }
     disagg.stop()
 
     parity = disagg_probe == coloc_probe and disagg_probe is not None
@@ -1838,7 +1871,7 @@ def bench_llama_disagg(window: float = 8.0):
             disagg_out.get("chunk_dropped", 0),
         "lat_llama_disagg_transfer_overlap_s":
             disagg_out.get("transfer_overlap_s", 0.0),
-    }
+    } | mem_fields
     for key, label in (("transfer_p50_ms", "transfer_p50_ms"),
                        ("transfer_p95_ms", "transfer_p95_ms")):
         if disagg_out.get(key) is not None:

@@ -95,6 +95,19 @@ def render_report(rows: list, fmt: str = "text",
                 f"{ms(row.get('ttft_cached_p95_ms'))} ms, cold "
                 f"{ms(row.get('ttft_cold_p50_ms'))}/"
                 f"{ms(row.get('ttft_cold_p95_ms'))} ms")
+        # KV memory ledger attribution (ISSUE 20): present only when a
+        # runtime shipped kv_ledger_* families — what this tenant's KV
+        # footprint cost per tier, integrated over time, and how often
+        # its blocks moved between tiers
+        if row.get("device_bytes") or row.get("host_bytes") or \
+                row.get("byte_seconds"):
+            lines.append(
+                f"{'':16s} {'':>7s} memory: device "
+                f"{row['device_bytes']:,d} B, host "
+                f"{row['host_bytes']:,d} B, "
+                f"{row['byte_seconds']:,.0f} B*s, "
+                f"demote/promote {row['demotions']}/"
+                f"{row['promotions']}")
     if objective is not None:
         missed = [row["tenant"] for row in rows if not row["met"]]
         lines.append(

@@ -52,6 +52,18 @@ def engine():
 
 
 @pytest.fixture
+def assert_ledger_clean():
+    """Shared KV leak audit (ISSUE 20): delegate to
+    observe.ledger.assert_ledger_clean so every suite's drain check
+    asserts the SAME invariants (pool refcount conservation, free-list
+    integrity, cache/store byte bookkeeping, ledger audit findings)
+    instead of each test hand-rolling used_blocks() == 0."""
+    from aiko_services_tpu.observe.ledger import assert_ledger_clean \
+        as check
+    return check
+
+
+@pytest.fixture
 def broker():
     """A fresh in-memory broker per test."""
     return MemoryBroker()

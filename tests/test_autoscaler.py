@@ -46,12 +46,22 @@ class StubManager:
 
 
 def snapshot_payload(topic_path, mailbox=0.0, batch_wait=0.0,
-                     hop_counts=None):
+                     hop_counts=None, occupancy=None,
+                     host_pressure=None):
     snapshot = {}
     if mailbox:
         snapshot["event_mailbox_depth"] = {
             "type": "gauge",
             "series": [{"labels": {}, "value": mailbox}]}
+    if occupancy is not None:
+        snapshot["kv_pool_occupancy"] = {
+            "type": "gauge",
+            "series": [{"labels": {"pool": "p"}, "value": occupancy}]}
+    if host_pressure is not None:
+        snapshot["kv_ledger_host_pressure"] = {
+            "type": "gauge",
+            "series": [{"labels": {"ledger": "lg"},
+                        "value": host_pressure}]}
     if batch_wait:
         snapshot["batch_mean_wait_ms"] = {
             "type": "gauge",
@@ -252,6 +262,82 @@ class TestWindowedSignals:
             publish_snapshot(rt, "p1", mailbox=1)
             settle_virtual(engine, 1.0)
         assert len(manager.clients) == 1
+        autoscaler.stop()
+        rt.terminate()
+
+
+class TestMemoryPressureSignals:
+    """ISSUE 20: capacity pressure from the KV memory ledger plane —
+    kv_pool_occupancy and kv_ledger_host_pressure scale the fleet up
+    before latency degrades, and a still-warm tier vetoes shrinking."""
+
+    def _policy(self, **kwargs):
+        defaults = dict(min_clients=1, max_clients=4,
+                        mailbox_depth_up=1e9, hop_p95_up=1e9,
+                        batch_wait_up=1e9, hysteresis=2,
+                        cooldown=30.0)
+        defaults.update(kwargs)
+        return ScalePolicy(**defaults)
+
+    def test_pool_occupancy_scales_up(self, engine):
+        rt = make_runtime(engine, "occ_rt")
+        manager = StubManager(1)
+        autoscaler = Autoscaler(
+            rt, name="occ", manager=manager,
+            policy=self._policy(pool_occupancy_up=0.85),
+            interval=1.0)
+        publish_snapshot(rt, "p1", occupancy=0.95)
+        settle_virtual(engine, 5.0)
+        assert autoscaler.signals()["pool_occupancy"] == \
+            pytest.approx(0.95)
+        assert manager.actions.count(1) >= 1
+        # the extracted signals export for the dashboard, like every
+        # other autoscaler input
+        snap = default_registry().snapshot()
+        assert "autoscaler_signal_pool_occupancy" in snap
+        assert "autoscaler_signal_host_pressure" in snap
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_host_pressure_scales_up_and_vetoes_shrink(self, engine):
+        rt = make_runtime(engine, "hp_rt")
+        manager = StubManager(1)
+        autoscaler = Autoscaler(
+            rt, name="hp", manager=manager,
+            policy=self._policy(host_pressure_up=0.8,
+                                host_pressure_down=0.25,
+                                window=5.0, cooldown=0.5),
+            interval=1.0)
+        publish_snapshot(rt, "p1", host_pressure=0.9)
+        settle_virtual(engine, 5.0)
+        assert manager.actions.count(1) >= 1
+        grown = len(manager.clients)
+        # pressure eases but stays above the down floor: still-warm
+        # host tier blocks the shrink
+        for _ in range(8):
+            publish_snapshot(rt, "p1", host_pressure=0.5)
+            settle_virtual(engine, 1.0)
+        assert len(manager.clients) == grown
+        # fully cold: shrink proceeds
+        for _ in range(12):
+            publish_snapshot(rt, "p1", host_pressure=0.05)
+            settle_virtual(engine, 1.0)
+        assert len(manager.clients) == 1
+        autoscaler.stop()
+        rt.terminate()
+
+    def test_unarmed_memory_signals_never_scale(self, engine):
+        """The defaults leave both memory thresholds None: a saturated
+        pool alone must not grow the fleet of a latency-policy
+        deployment."""
+        rt = make_runtime(engine, "unarm_rt")
+        manager = StubManager(1)
+        autoscaler = Autoscaler(
+            rt, name="unarm", manager=manager,
+            policy=self._policy(), interval=1.0)
+        publish_snapshot(rt, "p1", occupancy=1.0, host_pressure=1.0)
+        settle_virtual(engine, 5.0)
+        assert manager.actions.count(1) == 0
         autoscaler.stop()
         rt.terminate()
 

@@ -414,27 +414,39 @@ class TestRequestJourney:
         decoder = make_decoder(tiny_llama, "jparity", registry)
         done = []
         for index in range(8):
-            decoder.submit(f"p{index}", [1 + index % 5, 2, 3], 4,
+            decoder.submit(f"p{index}", [1 + index % 5, 2, 3], 10,
                            lambda rid, toks: done.append(rid))
-        for _ in range(40):
+        for _ in range(120):
             decoder.pump()
             if len(done) == 8:
                 break
         assert len(done) == 8
         adhoc = decoder.slo_stats()
         sketchy = decoder.slo_sketch_stats()
+        ordered_ms = {
+            "ttft": sorted(s * 1000.0 for s in decoder.ttft_samples),
+            "itl": sorted(s * 1000.0 for s in decoder.itl_samples)}
         for kind in ("ttft", "itl"):
-            for suffix in ("p50", "p95"):
+            samples = ordered_ms[kind]
+            for q, suffix in ((0.5, "p50"), (0.95, "p95")):
                 exact = adhoc[f"{kind}_{suffix}_ms"]
                 approx = sketchy[f"{kind}_{suffix}_ms"]
                 if exact is None:
                     continue
-                # 10%: at n=8 the np.percentile rank INTERPOLATION
-                # between adjacent order stats dominates, not the
-                # sketch's 1% bucket error (the bench smoke compares
-                # at thousands of samples)
-                assert approx == pytest.approx(exact, rel=0.1), \
-                    f"{kind} {suffix}"
+                # the sketch guarantees a value WITHIN the order
+                # stats bracketing the rank (1% bucket error); the
+                # np.percentile number INTERPOLATES between them, and
+                # at small n over a bimodal ITL population (within- vs
+                # cross-sync-burst gaps) the midpoint can sit far from
+                # both brackets — so accept the bracket interval, not
+                # the midpoint (the bench smoke pins the midpoint at
+                # thousands of samples)
+                rank = q * (len(samples) - 1)
+                lo = samples[int(np.floor(rank))]
+                hi = samples[int(np.ceil(rank))]
+                assert lo * 0.95 <= approx <= hi * 1.05, \
+                    f"{kind} {suffix}: {approx} outside " \
+                    f"[{lo}, {hi}] (np interp {exact})"
         assert sketchy["ttft_exemplars"]
 
     def test_decoder_shed_closes_journey(self, tiny_llama):

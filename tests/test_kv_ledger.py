@@ -1,0 +1,413 @@
+# KV memory ledger tests (ISSUE 20): cross-tier byte attribution must
+# CONSERVE against the component truth sources through the whole chain
+# lifecycle (serve -> demote -> promote -> migrate -> drain, int8 and
+# fp, paged + tiered), the always-on auditor must turn a seeded leak
+# into a HealthAggregator alert that ships exactly ONE flight-recorder
+# dump naming the offending chain key, and the capacity-pressure
+# signals must let the admission gate shed an over-budget tenant on
+# PROJECTED bytes while a polite tenant keeps attainment 1.0.
+#
+# Families under test (drift-checker mention corpus): kv_ledger_bytes,
+# kv_ledger_pinned_bytes, kv_ledger_byte_seconds,
+# kv_ledger_events_total, kv_ledger_moves_total, kv_ledger_violations,
+# kv_ledger_violations_total, kv_ledger_host_pressure.
+
+import dataclasses
+import json
+
+import jax
+import pytest
+
+from aiko_services_tpu.event import EventEngine, settle_virtual
+from aiko_services_tpu.models.llama import LLAMA_PRESETS, llama_init
+from aiko_services_tpu.observe import (DumpOnAlert, FlightRecorder,
+                                       HealthAggregator, KVMemoryLedger,
+                                       MetricsPublisher, SLORule,
+                                       default_registry,
+                                       seed_ledger_leak)
+from aiko_services_tpu.ops.admission import AdmissionGate
+from aiko_services_tpu.serving import ContinuousDecoder, PrefixKVCache
+from aiko_services_tpu.serving_tiered import HostBlockStore
+from aiko_services_tpu.transport.memory import MemoryBroker
+
+CONFIG = dataclasses.replace(LLAMA_PRESETS["tiny"], max_seq_len=96)
+# 41-token prompts + 8 generated = 49 tokens: six FULL blocks at
+# block=8 and (49 - 1) // 8 == 6, so promote_for covers the whole
+# chain — the exact-drain geometry the conservation walk needs
+PROMPT_A = [(i * 13) % 50 + 1 for i in range(40)] + [5]
+PROMPT_B = [(i * 7) % 50 + 1 for i in range(40)] + [9]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama_init(jax.random.PRNGKey(0), CONFIG)
+
+
+_SEQ = [0]
+
+
+def ledgered(params, block=8, host_mb=64, **kwargs):
+    """Paged decoder + prefix cache + host tier with a KV memory
+    ledger wired through the whole stack; returns
+    (decoder, cache, store, ledger)."""
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("prefill_buckets", (64,))
+    kwargs.setdefault("steps_per_sync", 4)
+    _SEQ[0] += 1
+    name = f"lg{_SEQ[0]}"
+    cache = PrefixKVCache(block_tokens=block, max_bytes=64 << 20,
+                          name=name)
+    store = HostBlockStore(max_bytes=host_mb << 20, name=f"{name}h")
+    cache.attach_host_store(store)
+    decoder = ContinuousDecoder(params, CONFIG, paged_kv=True,
+                                kv_block=block, prefix_cache=cache,
+                                **kwargs)
+    ledger = KVMemoryLedger(name=name)
+    decoder.attach_ledger(ledger)
+    return decoder, cache, store, ledger
+
+
+def run(decoder, requests, rounds=400):
+    """requests: {rid: (prompt, max_new, tenant)}."""
+    done = {}
+    for rid, (prompt, max_new, tenant) in requests.items():
+        assert decoder.submit(
+            rid, prompt, max_new,
+            lambda rid, t: done.update({rid: t}), tenant=tenant)
+    for _ in range(rounds):
+        decoder.pump()
+        if len(done) == len(requests):
+            break
+    assert len(done) == len(requests), \
+        f"{len(done)}/{len(requests)} completed"
+    return done
+
+
+def move_counts(ledger, direction):
+    """Per-tenant kv_ledger_moves_total readings for one ledger."""
+    out = {}
+    for labels, metric in default_registry().series(
+            "kv_ledger_moves_total"):
+        if labels.get("ledger") == ledger.name and \
+                labels.get("dir") == direction:
+            out[labels["tenant"]] = metric.value
+    return out
+
+
+# -- conservation across the chain lifecycle --------------------------------
+
+class TestLedgerConservation:
+    @pytest.mark.parametrize("extra", [{}, {"kv_cache_dtype": "int8"}],
+                             ids=["fp", "int8"])
+    def test_serve_demote_promote_drain(self, params, extra,
+                                        assert_ledger_clean):
+        """Two tenants serve, demote to host, promote back, rerun,
+        drain: at every stage the ledger's per-tenant split sums to the
+        component truth source, and the final drain leaves EVERY tier
+        at zero."""
+        decoder, cache, store, ledger = ledgered(params, **extra)
+        reqs = {"a": (PROMPT_A, 8, "tA"), "b": (PROMPT_B, 8, "tB")}
+        out = run(decoder, reqs)
+
+        # live attribution: both tenants hold device bytes, the split
+        # sums to the pool's physical count (kv_ledger_bytes tier
+        # gauges mirror these balances)
+        assert ledger.device_bytes("tA") > 0
+        assert ledger.device_bytes("tB") > 0
+        assert ledger.device_bytes() == sum(
+            ledger.device_bytes(t) for t in ledger.tenants())
+        assert ledger.device_bytes() == \
+            decoder.pool.used_blocks() * decoder.pool.block_nbytes
+        assert ledger.audit() == [] and not ledger._open
+        # pinned-vs-evictable split: post-harvest chains are refs==0,
+        # so kv_ledger_pinned_bytes reads below the tenant total
+        for tenant in ("tA", "tB"):
+            assert 0 <= ledger.pinned_bytes(tenant) <= \
+                ledger.device_bytes(tenant)
+
+        # demote every session: device tier empties INTO the host tier
+        pairs = []
+        for rid, (prompt, _, tenant) in reqs.items():
+            leaf, hit = cache.session_store(tenant, rid,
+                                            prompt + out[rid])
+            assert hit > 0
+            pairs.append((tenant, rid))
+        assert cache.demote_sessions(pairs) > 0
+        assert ledger.device_bytes() == 0
+        assert ledger.host_bytes() == store.bytes_used > 0
+        assert ledger.host_bytes("tA") > 0
+        assert ledger.host_bytes("tB") > 0
+        assert ledger.audit() == []
+        demotes = move_counts(ledger, "demote")
+        assert demotes.get("tA", 0) > 0 and demotes.get("tB", 0) > 0
+        # integrated footprint accrues while bytes are resident
+        # (kv_ledger_byte_seconds) — the decode held bytes for real
+        # wall-clock seconds
+        assert ledger.byte_seconds("tA") > 0
+
+        # promote every chain back: the host tier drains COMPLETELY
+        # (six full blocks, promote_for covers the whole chain)
+        for rid, (prompt, _, tenant) in reqs.items():
+            assert cache.promote_for(tenant, prompt + out[rid]) > 0
+        assert len(store) == 0
+        assert ledger.host_bytes() == 0
+        promotes = move_counts(ledger, "promote")
+        assert promotes.get("tA", 0) > 0 and promotes.get("tB", 0) > 0
+        assert ledger.audit() == []
+
+        # rerun on the promoted chains: bit-identical outputs, ledger
+        # still conserves
+        out2 = run(decoder, {rid + "x": spec
+                             for rid, spec in reqs.items()})
+        assert out2["ax"] == out["a"] and out2["bx"] == out["b"]
+        assert ledger.audit() == []
+
+        # drain: purge the cache and the shared audit proves every
+        # tier — pool, cache, store, ledger — is at zero
+        assert cache.purge(demote=False) > 0
+        assert_ledger_clean(cache=cache, ledger=ledger)
+
+    def test_conservation_across_migration(self, params,
+                                           assert_ledger_clean):
+        """Session migration between two ledgered serving sides: the
+        source drains to zero, the destination's ledger conserves
+        against ITS pool, and migrate_out/migrate_in lifecycle events
+        land on the respective ledgers."""
+        import test_drain_migrate as dm
+        engine = EventEngine()
+        broker = MemoryBroker()
+        a = dm._Side(engine, broker, params, "lma", chunk_blocks=2)
+        b = dm._Side(engine, broker, params, "lmb", chunk_blocks=2)
+        la = KVMemoryLedger(name="lma")
+        lb = KVMemoryLedger(name="lmb")
+        a.decoder.attach_ledger(la)
+        b.decoder.attach_ledger(lb)
+        try:
+            out = a.turn(engine, "t1", PROMPT_A, 8)
+            history = PROMPT_A + out
+            assert a.store("s1", history) == 48
+            assert la.device_bytes() == \
+                a.decoder.pool.used_blocks() * \
+                a.decoder.pool.block_nbytes
+            done = []
+            assert a.mig.migrate(
+                b.mig.topic, on_done=lambda m: done.append(1)) == 1
+            assert engine.run_until(lambda: bool(done), timeout=30.0)
+            # six blocks shipped: the destination's ledger conserves
+            # against its own pool, and the lifecycle events attribute
+            # the move on both sides
+            assert lb.device_bytes() == \
+                b.decoder.pool.used_blocks() * \
+                b.decoder.pool.block_nbytes > 0
+            assert la.stats["migrate_out"] == 6
+            assert lb.stats["migrate_in"] == 6
+            assert la.audit() == [] and lb.audit() == []
+            # the source released everything
+            a.cache.purge(demote=False)
+            assert_ledger_clean(cache=a.cache, ledger=la)
+            # destination drains clean too once the session releases
+            b.cache.release_sessions([("default", "s1")])
+            b.cache.purge(demote=False)
+            assert_ledger_clean(cache=b.cache, ledger=lb)
+        finally:
+            a.stop()
+            b.stop()
+
+
+# -- the always-on auditor --------------------------------------------------
+
+class TestLedgerAuditor:
+    def test_gauge_drift_detected_once(self, params):
+        """Tampering with the pool's incremental counter fires
+        gauge-drift + device-conservation ONCE; the standing finding
+        does not re-fire every sweep (kv_ledger_violations_total by
+        kind, kv_ledger_violations latched level)."""
+        decoder, cache, store, ledger = ledgered(params)
+        run(decoder, {"a": (PROMPT_A, 8, "tA")})
+        assert ledger.audit() == []
+        decoder.pool._used += 1
+        new = ledger.audit()
+        assert {record["kind"] for record in new} == {"gauge-drift"}
+        # persistence: the SAME standing drift is deduplicated
+        assert ledger.audit() == []
+        assert len(ledger.violations) == 1
+        # a ledger-side imbalance (the shape a missed release seam
+        # leaves) is a conservation breach against the pool scan
+        block_nbytes = decoder.pool.block_nbytes
+        ledger._device["tA"] += block_nbytes
+        kinds = {record["kind"] for record in ledger.audit()}
+        assert "device-conservation" in kinds
+        # the latched level gauge carries the count the
+        # HealthAggregator rule reads
+        (labels, gauge), = [
+            (lbls, m) for lbls, m
+            in default_registry().series("kv_ledger_violations")
+            if lbls.get("ledger") == ledger.name]
+        assert gauge.value == len(ledger.violations)
+        # repair clears the standing set; the next sweep is clean
+        decoder.pool._used -= 1
+        ledger._device["tA"] -= block_nbytes
+        assert ledger.audit() == []
+        assert not ledger._open
+
+    def test_orphan_host_names_the_chain(self, params):
+        """A host block registered past the store's byte accounting is
+        caught as host-orphan and the violation carries the orphan's
+        chain key."""
+        decoder, cache, store, ledger = ledgered(params)
+        out = run(decoder, {"a": (PROMPT_A, 8, "tA")})
+        cache.session_store("tA", "a", PROMPT_A + out["a"])
+        assert cache.demote_sessions([("tA", "a")]) > 0
+        assert ledger.audit() == []
+        key = seed_ledger_leak(store=store, kind="orphan-host")
+        new = ledger.audit()
+        orphans = [r for r in new if r["kind"] == "host-orphan"]
+        assert orphans and orphans[0]["chain_key"] == key
+
+    def test_device_trend_reads_the_drain(self):
+        """The occupancy ring's slope goes negative while the device
+        tier drains — the relief-rate input to byte-aware
+        admission."""
+        t = [0.0]
+        ledger = KVMemoryLedger(name="lgtrend", clock=lambda: t[0])
+        ledger.device_delta("tA", 4096, "alloc")
+        t[0] = 1.0
+        ledger.device_delta("tA", -1024, "release")
+        t[0] = 2.0
+        ledger.device_delta("tA", -1024, "release")
+        trend = ledger.device_trend()
+        assert trend is not None and trend < 0
+        assert ledger.device_bytes("tA") == 2048
+
+
+# -- seeded leak -> alert -> one postmortem dump ----------------------------
+
+class TestSeededLeakPipeline:
+    def test_leak_alerts_and_ships_one_dump(self, params, make_runtime,
+                                            engine, tmp_path):
+        """The full detection path: chaos-seeded double-release ->
+        auditor violation -> kv_ledger_violations level rule fires a
+        retained alert -> DumpOnAlert ships EXACTLY ONE flight dump
+        whose fault ring names the offending chain key.  A second
+        breach (orphan-host) raises the level further but ships no
+        second dump."""
+        decoder, cache, store, ledger = ledgered(params)
+        recorder_rt = make_runtime("lk_rec").initialize()
+        publisher_rt = make_runtime("lk_pub").initialize()
+        aggregator_rt = make_runtime("lk_agg").initialize()
+        watcher_rt = make_runtime("lk_watch").initialize()
+        recorder = FlightRecorder(recorder_rt)
+        publisher = MetricsPublisher(publisher_rt, interval=0.5)
+        rule = SLORule(
+            name="kv-ledger-violations", kind="level",
+            series=f"kv_ledger_violations{{ledger={ledger.name}}}",
+            threshold=1.0, window=60.0)
+        aggregator = HealthAggregator(aggregator_rt, rules=[rule],
+                                      interval=0.5)
+        trigger = DumpOnAlert(str(tmp_path))
+        aggregator.on_alert.append(trigger)
+        retained = []
+        watcher_rt.add_message_handler(
+            lambda topic, payload: retained.append((topic, payload)),
+            f"{watcher_rt.namespace}/alert/kv-ledger-violations")
+        # the always-on promotion of the test-time audit: the engine
+        # timer sweeps invariants continuously
+        ledger.attach_engine(engine)
+        try:
+            run(decoder, {"a": (PROMPT_A, 8, "tA")})
+            settle_virtual(engine, 2.0)
+            assert aggregator.firing() == []
+
+            key = seed_ledger_leak(cache=cache, kind="double-release")
+            settle_virtual(engine, 3.0)
+            assert aggregator.firing() == ["kv-ledger-violations"]
+            assert retained, "no retained alert published"
+            dumps = sorted(tmp_path.glob("*.json"))
+            assert len(dumps) == 1, [d.name for d in dumps]
+            document = json.loads(dumps[0].read_text())
+            text = dumps[0].read_text()
+            assert key in text, \
+                f"dump does not name the leaked chain {key}"
+            assert "ledger-double-release" in text
+            assert document["traceEvents"], "empty flight dump"
+
+            # second breach: the auditor records more violations but
+            # the per-rule latch ships NO second artifact
+            seed_ledger_leak(store=store, kind="orphan-host")
+            settle_virtual(engine, 3.0)
+            assert aggregator.firing() == ["kv-ledger-violations"]
+            assert aggregator.fired["kv-ledger-violations"] == 1
+            assert len(sorted(tmp_path.glob("*.json"))) == 1
+        finally:
+            ledger.detach_engine()
+            aggregator.stop()
+            publisher.stop()
+            recorder.close()
+
+
+# -- capacity pressure -> byte-aware admission ------------------------------
+
+class TestByteAwareAdmission:
+    def test_flood_tenant_shed_polite_tenant_served(self, params):
+        """A tenant whose projected footprint breaches its byte budget
+        is shed EARLY (reason byte-budget, admission_rejected_total);
+        the polite tenant admits every request — attainment 1.0."""
+        decoder, cache, store, ledger = ledgered(params)
+        gate = AdmissionGate()
+        block_nbytes = decoder.pool.block_nbytes
+        gate.set_byte_policy(
+            ledger,
+            tenant_budgets={"flood": 2 * block_nbytes},
+            default_estimate=block_nbytes)
+        # flood's first conversation lands six full blocks on device —
+        # well past its two-block budget
+        out = run(decoder, {"f1": (PROMPT_A, 8, "flood")})
+        cache.session_store("flood", "f1", PROMPT_A + out["f1"])
+        assert ledger.device_bytes("flood") > 2 * block_nbytes
+
+        shed, projected = gate.shed_on_bytes("flood")
+        assert shed
+        assert projected > 2 * block_nbytes
+        gate.count_rejected("flood", 0, "byte-budget")
+        rejected = [
+            m.value for labels, m in default_registry().series(
+                "admission_rejected_total")
+            if labels.get("tenant") == "flood"
+            and labels.get("reason") == "byte-budget"]
+        assert sum(rejected) >= 1
+
+        # the polite tenant is under budget (none set): every request
+        # admits and completes
+        polite = {f"p{i}": (PROMPT_B, 4, "polite") for i in range(3)}
+        admitted = 0
+        for rid, (prompt, max_new, tenant) in polite.items():
+            assert not gate.shed_on_bytes(tenant)[0]
+            admitted += 1
+        out2 = run(decoder, polite)
+        assert admitted == len(polite) == len(out2)   # attainment 1.0
+
+    def test_trend_relief_defers_the_shed(self):
+        """Over budget but the pool is DRAINING fast enough to clear
+        the overage within the request's deadline slack: admission
+        holds instead of shedding (shed-early stays for the hopeless
+        case)."""
+        t = [0.0]
+        ledger = KVMemoryLedger(name="lgrelief", clock=lambda: t[0])
+        gate = AdmissionGate()
+        gate.set_byte_policy(ledger, budget_bytes=4096,
+                             default_estimate=1024)
+        ledger.device_delta("tA", 8192, "alloc")
+        t[0] = 1.0
+        ledger.device_delta("tA", -2048, "release")
+        t[0] = 2.0
+        ledger.device_delta("tA", -2048, "release")
+        # draining at ~2 KiB/s; projected 4096 + 1024 = 5120, overage
+        # 1024 clears in ~0.5 s
+        shed, _ = gate.shed_on_bytes("tA", remaining=5.0)
+        assert not shed
+        shed, _ = gate.shed_on_bytes("tA", remaining=0.1)
+        assert shed
+
+    def test_disarmed_gate_never_sheds(self):
+        gate = AdmissionGate()
+        assert gate.shed_on_bytes("anyone") == (False, None)

@@ -125,46 +125,46 @@ def kernel_pair(params, block=8, cache=False, **kwargs):
 # -- parity matrix ----------------------------------------------------------
 
 class TestPagedParity:
-    def test_native_with_midstream_admit(self, params):
+    def test_native_with_midstream_admit(self, params, assert_ledger_clean):
         dense, paged = pair(params)
         out_d = run(dense, REQUESTS, midstream=MIDSTREAM)
         out_p = run(paged, REQUESTS, midstream=MIDSTREAM)
         assert out_d == out_p
         assert out_p["a"] == oracle(params, PROMPT, 10)
-        assert paged.pool.used_blocks() == 0      # drain audit
+        assert_ledger_clean(pool=paged.pool)      # drain audit
 
-    def test_int8(self, params):
+    def test_int8(self, params, assert_ledger_clean):
         dense, paged = pair(params, kv_cache_dtype="int8")
         assert run(dense, REQUESTS) == run(paged, REQUESTS)
-        assert paged.pool.used_blocks() == 0
+        assert_ledger_clean(pool=paged.pool)
 
-    def test_chunked_prefill(self, params):
+    def test_chunked_prefill(self, params, assert_ledger_clean):
         dense, paged = pair(params, prefill_chunk=16)
         long = {"long": ((PROMPT * 3)[:80], 8)} | REQUESTS
         assert run(dense, long) == run(paged, long)
-        assert paged.pool.used_blocks() == 0
+        assert_ledger_clean(pool=paged.pool)
 
     @pytest.mark.slow
-    def test_spec_int8_chunked_midstream(self, params):
+    def test_spec_int8_chunked_midstream(self, params, assert_ledger_clean):
         dense, paged = pair(params, speculate_k=2,
                             kv_cache_dtype="int8", prefill_chunk=16)
         out_d = run(dense, REQUESTS, midstream=MIDSTREAM)
         out_p = run(paged, REQUESTS, midstream=MIDSTREAM)
         assert out_d == out_p
-        assert paged.pool.used_blocks() == 0
+        assert_ledger_clean(pool=paged.pool)
 
-    def test_speculative(self, params):
+    def test_speculative(self, params, assert_ledger_clean):
         dense, paged = pair(params, speculate_k=2)
         assert run(dense, REQUESTS) == run(paged, REQUESTS)
-        assert paged.pool.used_blocks() == 0
+        assert_ledger_clean(pool=paged.pool)
 
-    def test_eos_retire_inside_round(self, params):
+    def test_eos_retire_inside_round(self, params, assert_ledger_clean):
         # a slot retiring mid-round (EOS) must release its blocks and
         # not corrupt its neighbours' tables
         dense, paged = pair(params, eos_token=3)
         reqs = {"a": (PROMPT, 30), "b": (PROMPT[:11], 30)}
         assert run(dense, reqs) == run(paged, reqs)
-        assert paged.pool.used_blocks() == 0
+        assert_ledger_clean(pool=paged.pool)
 
 
 # -- fused pallas kernel vs gather oracle (ISSUE 16) ------------------------

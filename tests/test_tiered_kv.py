@@ -201,12 +201,15 @@ class TestTieredAsync:
 # -- leak audit: both tiers drain to zero -----------------------------------
 
 class TestTieredAudit:
-    def test_leak_audit_both_tiers(self, params):
+    def test_leak_audit_both_tiers(self, params, assert_ledger_clean):
         decoder, cache, store = tiered(params)
         out1 = run(decoder, {"a": (PROMPT41, 8)})
         full = PROMPT41 + out1["a"]     # 49 tokens: 6 full blocks
         demote_all(cache, out1, {"a": (PROMPT41, 8)})
-        assert decoder.pool.used_blocks() == 0
+        # shared ISSUE 20 audit: pool refcount conservation + free-list
+        # integrity + cache byte bookkeeping (host tier holds the
+        # demoted chain, so only the device tier must be empty)
+        assert_ledger_clean(pool=decoder.pool)
         assert len(cache) == 0
         assert len(store) == 6
         assert store.bytes_used == 6 * decoder.pool.block_nbytes
@@ -225,9 +228,9 @@ class TestTieredAudit:
         assert hit == 48
         store.max_bytes = 0
         cache.demote_sessions([("default", "a")])
-        assert decoder.pool.used_blocks() == 0
-        assert len(cache) == 0
-        assert len(store) == 0 and store.bytes_used == 0
+        # both tiers at zero: the one-call leak audit covers pool,
+        # cache, and host store together
+        assert_ledger_clean(cache=cache)
         assert store.stats["refused"] >= 6
 
     def test_host_store_tenant_budget(self):
